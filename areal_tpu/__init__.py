@@ -1,0 +1,9 @@
+"""areal_tpu: a TPU-native asynchronous RL training framework for LLMs.
+
+A from-scratch JAX/XLA/Pallas re-design with the capabilities of AReaL
+(reference: /root/reference): decoupled streaming rollout on a TPU inference
+fleet + pjit trainer running decoupled PPO, connected by a staleness-controlled
+sample queue and a weight-sync channel.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,99 @@
+"""Process-global experiment context (≈ ``realhf/base/constants.py``).
+
+Holds the (experiment, trial) identity, filesystem roots, and debug env-var
+knobs. Unlike the reference there is no per-model 3D-parallel "model scope" —
+on TPU the parallel context is the ambient ``jax.sharding.Mesh`` managed by
+:mod:`areal_tpu.parallel.mesh`.
+"""
+
+import getpass
+import os
+from typing import Optional
+
+_experiment_name: Optional[str] = None
+_trial_name: Optional[str] = None
+
+# Env-var knobs (AREAL_* ≈ the reference's REAL_*).
+TRACE_ENV = "AREAL_DUMP_TRACE"          # jax.profiler traces per MFC
+RECORD_PERF_ENV = "AREAL_RECORD_PERFORMANCE"
+MEMORY_KILL_ENV = "AREAL_HBM_KILL_THRESHOLD"
+WEIGHT_SYNC_IMPL_ENV = "AREAL_WEIGHT_SYNC_IMPL"  # DISK (default) | DCN
+
+
+def set_experiment_trial_names(experiment_name: str, trial_name: str):
+    global _experiment_name, _trial_name
+    _experiment_name = experiment_name
+    _trial_name = trial_name
+
+
+def experiment_name() -> str:
+    if _experiment_name is None:
+        raise RuntimeError("experiment name not set")
+    return _experiment_name
+
+
+def trial_name() -> str:
+    if _trial_name is None:
+        raise RuntimeError("trial name not set")
+    return _trial_name
+
+
+def get_fileroot() -> str:
+    return os.environ.get(
+        "AREAL_FILEROOT", f"/tmp/areal_tpu/{getpass.getuser()}"
+    )
+
+
+def set_fileroot(path: str):
+    os.environ["AREAL_FILEROOT"] = path
+
+
+def get_log_root() -> str:
+    p = os.path.join(get_fileroot(), "logs", experiment_name(), trial_name())
+    os.makedirs(p, exist_ok=True)
+    return p
+
+
+def get_save_root() -> str:
+    p = os.path.join(get_fileroot(), "checkpoints", experiment_name(), trial_name())
+    os.makedirs(p, exist_ok=True)
+    return p
+
+
+def get_cache_root() -> str:
+    p = os.path.join(get_fileroot(), "cache", experiment_name(), trial_name())
+    os.makedirs(p, exist_ok=True)
+    return p
+
+
+def get_param_sync_root() -> str:
+    """Directory for trainer→generation weight-sync snapshots
+    (≈ the reference's param_realloc dir, ``model_worker.py:787-800``)."""
+    p = os.path.join(get_save_root(), "weight_sync")
+    os.makedirs(p, exist_ok=True)
+    return p
+
+
+def get_recover_root() -> str:
+    p = os.path.join(get_save_root(), "recover")
+    os.makedirs(p, exist_ok=True)
+    return p
+
+
+def get_env_vars(**extra) -> dict:
+    """Env vars to forward to spawned workers."""
+    keys = [
+        "AREAL_FILEROOT",
+        "AREAL_LOG_LEVEL",
+        "AREAL_NAME_RESOLVE_ROOT",
+        TRACE_ENV,
+        RECORD_PERF_ENV,
+        MEMORY_KILL_ENV,
+        WEIGHT_SYNC_IMPL_ENV,
+        "JAX_PLATFORMS",
+        "XLA_FLAGS",
+        "TPU_VISIBLE_DEVICES",
+    ]
+    out = {k: os.environ[k] for k in keys if k in os.environ}
+    out.update({k: str(v) for k, v in extra.items()})
+    return out

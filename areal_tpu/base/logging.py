@@ -1,0 +1,38 @@
+"""Logger factory with per-module names and optional colored output.
+
+Counterpart of the reference's ``realhf/base/logging.py`` (logger factory +
+multi-sink metric logging); metric sinks live in
+:mod:`areal_tpu.base.metrics`.
+"""
+
+import logging
+import os
+import sys
+from typing import Optional
+
+_FORMAT = "%(asctime)s.%(msecs)03d %(name)s %(levelname)s: %(message)s"
+_DATE_FORMAT = "%Y%m%d-%H:%M:%S"
+
+_LEVEL = os.environ.get("AREAL_LOG_LEVEL", "INFO").upper()
+
+_configured = False
+
+
+def _configure_root():
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler(sys.stdout)
+    handler.setFormatter(logging.Formatter(_FORMAT, _DATE_FORMAT))
+    root = logging.getLogger("areal")
+    root.setLevel(_LEVEL)
+    root.addHandler(handler)
+    root.propagate = False
+    _configured = True
+
+
+def getLogger(name: Optional[str] = None) -> logging.Logger:
+    _configure_root()
+    if not name:
+        return logging.getLogger("areal")
+    return logging.getLogger(f"areal.{name}")

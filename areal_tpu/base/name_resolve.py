@@ -1,0 +1,347 @@
+"""Distributed key-value store used for service discovery and rendezvous.
+
+TPU-native counterpart of the reference's ``realhf/base/name_resolve.py``
+(which offers NFS/etcd3/Redis/Ray/memory backends). Here we provide:
+
+- ``MemoryNameRecordRepository`` — in-process dict, for unit tests and
+  single-process experiments.
+- ``FileNameRecordRepository``   — a shared-filesystem backend (works on any
+  POSIX FS incl. NFS/GCS-fuse on TPU pods). Values are small text files; keys
+  map to directories. This is the default for multi-process runs.
+
+Semantics kept from the reference: ``add`` (with ``replace`` /
+``delete_on_exit`` / ``keepalive_ttl``), ``get``, ``wait`` (poll until a key
+appears), ``delete``, ``clear_subtree``, ``get_subtree``, ``find_subtree``,
+and ``reset`` (drop everything this process added).
+"""
+
+import dataclasses
+import os
+import random
+import shutil
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("name_resolve")
+
+
+class NameEntryExistsError(Exception):
+    pass
+
+
+class NameEntryNotFoundError(Exception):
+    pass
+
+
+class NameRecordRepository:
+    """Abstract distributed KV store."""
+
+    def add(
+        self,
+        name: str,
+        value: str,
+        delete_on_exit: bool = True,
+        keepalive_ttl: Optional[float] = None,
+        replace: bool = False,
+    ):
+        raise NotImplementedError()
+
+    def get(self, name: str) -> str:
+        raise NotImplementedError()
+
+    def delete(self, name: str):
+        raise NotImplementedError()
+
+    def clear_subtree(self, name_root: str):
+        raise NotImplementedError()
+
+    def get_subtree(self, name_root: str) -> List[str]:
+        raise NotImplementedError()
+
+    def find_subtree(self, name_root: str) -> List[str]:
+        """Return sorted keys under ``name_root``."""
+        raise NotImplementedError()
+
+    def wait(
+        self,
+        name: str,
+        timeout: Optional[float] = None,
+        poll_frequency: float = 0.1,
+    ) -> str:
+        """Poll until ``name`` exists, then return its value."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                return self.get(name)
+            except NameEntryNotFoundError:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"Timeout waiting for name_resolve key: {name}"
+                    )
+                time.sleep(poll_frequency + random.random() * 0.01)
+
+    def add_subentry(self, name: str, value: str, **kwargs) -> str:
+        """Add ``value`` under a fresh unique sub-key of ``name``."""
+        sub = f"{name}/{random.randint(0, 2**31):010d}"
+        self.add(sub, value, **kwargs)
+        return sub
+
+    def reset(self):
+        """Delete every entry added (with delete_on_exit) by this repo."""
+        raise NotImplementedError()
+
+    def watch_names(
+        self,
+        names: List[str],
+        call_back: Callable[[], None],
+        poll_frequency: float = 5.0,
+        wait_timeout: float = 300.0,
+    ):
+        """Spawn a daemon thread that fires ``call_back`` once any of
+        ``names`` disappears (after having existed)."""
+        if isinstance(names, str):
+            names = [names]
+
+        def _watch():
+            for name in names:
+                try:
+                    self.wait(name, timeout=wait_timeout)
+                except TimeoutError:
+                    logger.warning("watch_names: %s never appeared", name)
+                    call_back()
+                    return
+            while True:
+                try:
+                    for name in names:
+                        self.get(name)
+                except NameEntryNotFoundError:
+                    call_back()
+                    return
+                time.sleep(poll_frequency)
+
+        t = threading.Thread(target=_watch, daemon=True)
+        t.start()
+        return t
+
+
+class MemoryNameRecordRepository(NameRecordRepository):
+    def __init__(self):
+        self._store: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._to_delete = set()
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
+        name = name.rstrip("/")
+        with self._lock:
+            if name in self._store and not replace:
+                raise NameEntryExistsError(name)
+            self._store[name] = str(value)
+            if delete_on_exit:
+                self._to_delete.add(name)
+
+    def get(self, name):
+        name = name.rstrip("/")
+        with self._lock:
+            if name not in self._store:
+                raise NameEntryNotFoundError(name)
+            return self._store[name]
+
+    def delete(self, name):
+        name = name.rstrip("/")
+        with self._lock:
+            if name not in self._store:
+                raise NameEntryNotFoundError(name)
+            del self._store[name]
+            self._to_delete.discard(name)
+
+    def clear_subtree(self, name_root):
+        name_root = name_root.rstrip("/")
+        with self._lock:
+            for k in [k for k in self._store if k == name_root or k.startswith(name_root + "/")]:
+                del self._store[k]
+                self._to_delete.discard(k)
+
+    def get_subtree(self, name_root):
+        name_root = name_root.rstrip("/")
+        with self._lock:
+            return sorted(
+                v
+                for k, v in self._store.items()
+                if k == name_root or k.startswith(name_root + "/")
+            )
+
+    def find_subtree(self, name_root):
+        name_root = name_root.rstrip("/")
+        with self._lock:
+            return sorted(
+                k
+                for k in self._store
+                if k == name_root or k.startswith(name_root + "/")
+            )
+
+    def reset(self):
+        with self._lock:
+            for k in list(self._to_delete):
+                self._store.pop(k, None)
+            self._to_delete.clear()
+
+
+class FileNameRecordRepository(NameRecordRepository):
+    """Shared-filesystem KV store: key → ``<root>/<key>/VALUE`` text file."""
+
+    VALUE_FILE = "__value__"
+
+    def __init__(self, root: Optional[str] = None):
+        if root is None:
+            root = os.environ.get(
+                "AREAL_NAME_RESOLVE_ROOT", "/tmp/areal_tpu/name_resolve"
+            )
+        self._root = root
+        self._to_delete = set()
+        self._lock = threading.Lock()
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self._root, name.strip("/"), self.VALUE_FILE)
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
+        path = self._path(name)
+        if os.path.exists(path) and not replace:
+            raise NameEntryExistsError(name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}.{random.randint(0, 1 << 30)}"
+        with open(tmp, "w") as f:
+            f.write(str(value))
+        os.replace(tmp, path)  # atomic on POSIX
+        if delete_on_exit:
+            with self._lock:
+                self._to_delete.add(name)
+
+    def get(self, name):
+        path = self._path(name)
+        try:
+            with open(path, "r") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise NameEntryNotFoundError(name) from None
+
+    def delete(self, name):
+        path = self._path(name)
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            raise NameEntryNotFoundError(name) from None
+        with self._lock:
+            self._to_delete.discard(name)
+        # Best-effort cleanup of empty dirs.
+        try:
+            os.removedirs(os.path.dirname(path))
+        except OSError:
+            pass
+
+    def clear_subtree(self, name_root):
+        path = os.path.join(self._root, name_root.strip("/"))
+        shutil.rmtree(path, ignore_errors=True)
+        with self._lock:
+            self._to_delete = {
+                n for n in self._to_delete
+                if not (n == name_root or n.startswith(name_root.rstrip("/") + "/"))
+            }
+
+    def _walk(self, name_root):
+        base = os.path.join(self._root, name_root.strip("/"))
+        found = []
+        if os.path.isfile(os.path.join(base, self.VALUE_FILE)):
+            found.append(name_root.strip("/"))
+        for dirpath, _, filenames in os.walk(base):
+            if self.VALUE_FILE in filenames and dirpath != base:
+                found.append(os.path.relpath(dirpath, self._root))
+        return sorted(set(found))
+
+    def get_subtree(self, name_root):
+        return [self.get(k) for k in self._walk(name_root)]
+
+    def find_subtree(self, name_root):
+        return self._walk(name_root)
+
+    def reset(self):
+        with self._lock:
+            names = list(self._to_delete)
+            self._to_delete.clear()
+        for name in names:
+            try:
+                self.delete(name)
+            except NameEntryNotFoundError:
+                pass
+
+
+@dataclasses.dataclass
+class NameResolveConfig:
+    type: str = "file"  # "memory" | "file"
+    root: Optional[str] = None
+
+
+_DEFAULT: NameRecordRepository = MemoryNameRecordRepository()
+
+
+def make_repository(cfg: NameResolveConfig) -> NameRecordRepository:
+    if cfg.type == "memory":
+        return MemoryNameRecordRepository()
+    if cfg.type == "file":
+        return FileNameRecordRepository(cfg.root)
+    raise ValueError(f"Unknown name_resolve backend: {cfg.type}")
+
+
+def reconfigure(cfg: NameResolveConfig):
+    """Swap the module-level default repository (like the reference's
+    ``name_resolve.reconfigure``)."""
+    global _DEFAULT
+    _DEFAULT = make_repository(cfg)
+
+
+def default_repository() -> NameRecordRepository:
+    return _DEFAULT
+
+
+# Module-level convenience API mirroring the reference usage style
+# (``name_resolve.add(...)`` etc).
+def add(*args, **kwargs):
+    return _DEFAULT.add(*args, **kwargs)
+
+
+def add_subentry(*args, **kwargs):
+    return _DEFAULT.add_subentry(*args, **kwargs)
+
+
+def get(*args, **kwargs):
+    return _DEFAULT.get(*args, **kwargs)
+
+
+def wait(*args, **kwargs):
+    return _DEFAULT.wait(*args, **kwargs)
+
+
+def delete(*args, **kwargs):
+    return _DEFAULT.delete(*args, **kwargs)
+
+
+def clear_subtree(*args, **kwargs):
+    return _DEFAULT.clear_subtree(*args, **kwargs)
+
+
+def get_subtree(*args, **kwargs):
+    return _DEFAULT.get_subtree(*args, **kwargs)
+
+
+def find_subtree(*args, **kwargs):
+    return _DEFAULT.find_subtree(*args, **kwargs)
+
+
+def watch_names(*args, **kwargs):
+    return _DEFAULT.watch_names(*args, **kwargs)
+
+
+def reset():
+    return _DEFAULT.reset()

@@ -1,0 +1,63 @@
+"""Canonical name_resolve key layout for one experiment trial.
+
+Counterpart of the reference's ``realhf/base/names.py``: every distributed
+component publishes/discovers under ``areal_tpu/<experiment>/<trial>/...``.
+"""
+
+ROOT = "areal_tpu"
+
+
+def trial_root(experiment_name: str, trial_name: str) -> str:
+    return f"{ROOT}/{experiment_name}/{trial_name}"
+
+
+def worker_status(experiment_name, trial_name, worker_name) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/worker_status/{worker_name}"
+
+
+def worker_control(experiment_name, trial_name, worker_name) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/worker_control/{worker_name}"
+
+
+def experiment_status(experiment_name, trial_name) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/experiment_status"
+
+
+def master_stream(experiment_name, trial_name) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/master_stream"
+
+
+def push_pull_stream(experiment_name, trial_name, stream_name) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/push_pull_stream/{stream_name}"
+
+
+def push_pull_stream_root(experiment_name, trial_name) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/push_pull_stream"
+
+
+def gen_servers(experiment_name, trial_name) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/gen_servers"
+
+
+def gen_server(experiment_name, trial_name, server_idx) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/gen_servers/{server_idx}"
+
+
+def gserver_manager(experiment_name, trial_name) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/gserver_manager"
+
+
+def model_version(experiment_name, trial_name, model_name) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/model_version/{model_name}"
+
+
+def update_weights_signal(experiment_name, trial_name) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/update_weights"
+
+
+def trainer_coordinator(experiment_name, trial_name) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/trainer_coordinator"
+
+
+def metric_server(experiment_name, trial_name, name) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/metric_server/{name}"

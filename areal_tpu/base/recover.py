@@ -1,0 +1,72 @@
+"""Failure recovery bookkeeping (≈ ``realhf/base/recover.py:19-80``).
+
+``RecoverInfo`` captures everything the master needs to resume a trial after
+restart-the-world recovery: step counters, frequency-control states, the hashes
+of data ids already consumed, and the dataloader epoch position. Dumped
+atomically as JSON at every checkpoint tick; model/optimizer state itself is
+checkpointed separately via Orbax.
+"""
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+from areal_tpu.base import constants, logging
+
+logger = logging.getLogger("recover")
+
+RECOVER_INFO_FILE = "recover_info.json"
+
+
+@dataclasses.dataclass
+class StepInfo:
+    epoch: int = 0
+    epoch_step: int = 0
+    global_step: int = 0
+
+    def next(self, steps_per_epoch: Optional[int] = None) -> "StepInfo":
+        epoch, epoch_step = self.epoch, self.epoch_step + 1
+        if steps_per_epoch is not None and epoch_step >= steps_per_epoch:
+            epoch, epoch_step = epoch + 1, 0
+        return StepInfo(epoch, epoch_step, self.global_step + 1)
+
+
+@dataclasses.dataclass
+class RecoverInfo:
+    recover_start: StepInfo = dataclasses.field(default_factory=StepInfo)
+    last_step_info: StepInfo = dataclasses.field(default_factory=StepInfo)
+    save_ctl_states: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    ckpt_ctl_states: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    eval_ctl_states: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    data_loading_dp_idx: int = 0
+    hash_vals_to_ignore: List[int] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RecoverInfo":
+        d = dict(d)
+        for k in ("recover_start", "last_step_info"):
+            d[k] = StepInfo(**d[k])
+        return cls(**d)
+
+
+def dump(info: RecoverInfo, root: Optional[str] = None):
+    root = root or constants.get_recover_root()
+    path = os.path.join(root, RECOVER_INFO_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(info.to_dict(), f, indent=2)
+    os.replace(tmp, path)
+    logger.debug("Dumped recover info to %s", path)
+
+
+def load(root: Optional[str] = None) -> Optional[RecoverInfo]:
+    root = root or constants.get_recover_root()
+    path = os.path.join(root, RECOVER_INFO_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return RecoverInfo.from_dict(json.load(f))

@@ -1,0 +1,42 @@
+"""Deterministic seeding across python/numpy/jax (≈ ``realhf/base/seeding.py``).
+
+JAX is functional, so beyond python/numpy seeding we hand out a root
+``jax.random.key`` derived from (seed, key_string) — every consumer folds in
+its own identity instead of mutating global RNG state.
+"""
+
+import hashlib
+import random
+from typing import Optional
+
+import numpy as np
+
+_BASE_SEED: Optional[int] = None
+_SEED_NAME: str = ""
+
+
+def _hash(s: str) -> int:
+    return int.from_bytes(hashlib.sha256(s.encode()).digest()[:4], "little")
+
+
+def set_random_seed(base_seed: int, name: str = ""):
+    """Seed python & numpy with a per-component offset derived from name."""
+    global _BASE_SEED, _SEED_NAME
+    _BASE_SEED, _SEED_NAME = base_seed, name
+    seed = (base_seed + _hash(name)) % (2**31)
+    random.seed(seed)
+    np.random.seed(seed)
+
+
+def base_seed() -> int:
+    if _BASE_SEED is None:
+        raise RuntimeError("set_random_seed() has not been called")
+    return _BASE_SEED
+
+
+def jax_root_key(key_string: str = ""):
+    """A fresh jax PRNG key derived from the base seed and a component id."""
+    import jax
+
+    seed = (base_seed() + _hash(_SEED_NAME + "/" + key_string)) % (2**31)
+    return jax.random.key(seed)

@@ -1,0 +1,137 @@
+"""Denominator-keyed statistics tracker (≈ ``realhf/base/stats_tracker.py:20``).
+
+Collects per-step scalar/vector statistics with named *denominators* (boolean
+masks) so means are computed over exactly the tokens/sequences that matter.
+Scopes compose hierarchically (``with tracker.scope("actor")``). ``export``
+reduces everything to plain python floats.
+
+In the reference, export performs a torch.distributed all-reduce; here the
+trainer is a single pjit program per host group, so values arriving at the
+tracker are already global (device arrays are converted via ``np.asarray``).
+Cross-process aggregation, when needed, happens at the master via metadata
+messages.
+"""
+
+import contextlib
+from enum import Enum
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+
+class ReduceType(Enum):
+    AVG = "avg"
+    SUM = "sum"
+    MIN = "min"
+    MAX = "max"
+    SCALAR = "scalar"
+
+
+_MOE_AUX = "moe_aux"  # reserved scope example
+
+
+class DistributedStatsTracker:
+    def __init__(self, name: str = ""):
+        self._scope: List[str] = [name] if name else []
+        self._denominators: Dict[str, List[np.ndarray]] = {}
+        self._stats: Dict[str, List[np.ndarray]] = {}
+        self._meta: Dict[str, dict] = {}
+
+    def _key(self, name: str) -> str:
+        return "/".join(self._scope + [name]) if self._scope else name
+
+    @contextlib.contextmanager
+    def scope(self, name: str):
+        self._scope.append(name)
+        try:
+            yield
+        finally:
+            self._scope.pop()
+
+    def denominator(self, **kwargs):
+        """Register boolean-mask denominators, e.g. ``mask=loss_mask``."""
+        for name, mask in kwargs.items():
+            mask = np.asarray(mask)
+            if mask.dtype != bool:
+                mask = mask.astype(bool)
+            key = self._key(name)
+            self._denominators.setdefault(key, []).append(mask)
+            self._meta[key] = dict(is_denominator=True)
+
+    def stat(
+        self,
+        denominator: str,
+        reduce_type: ReduceType = ReduceType.AVG,
+        **kwargs,
+    ):
+        """Record vector stats reduced over a registered denominator mask.
+
+        The value is paired with the *latest* mask recorded under
+        ``denominator`` at call time.
+        """
+        denom_key = self._key(denominator)
+        if denom_key not in self._denominators:
+            raise ValueError(f"Unknown denominator {denom_key}")
+        mask = self._denominators[denom_key][-1]
+        for name, value in kwargs.items():
+            value = np.asarray(value, dtype=np.float32)
+            key = self._key(name)
+            if value.shape != mask.shape:
+                raise ValueError(
+                    f"stat {key}: shape {value.shape} != denominator "
+                    f"{denom_key} shape {mask.shape}"
+                )
+            # Store the (value, mask) pair so export never has to re-align.
+            self._stats.setdefault(key, []).append((value, mask))
+            self._meta[key] = dict(
+                denominator=denom_key, reduce_type=reduce_type
+            )
+
+    def scalar(self, **kwargs):
+        """Record plain scalars, averaged over occurrences at export."""
+        for name, value in kwargs.items():
+            key = self._key(name)
+            self._stats.setdefault(key, []).append(
+                np.asarray(float(value), dtype=np.float32)
+            )
+            self._meta[key] = dict(reduce_type=ReduceType.SCALAR)
+
+    def export(self, reset: bool = True) -> Dict[str, float]:
+        result: Dict[str, float] = {}
+        for key, values in self._stats.items():
+            meta = self._meta[key]
+            rt = meta.get("reduce_type", ReduceType.SCALAR)
+            if rt == ReduceType.SCALAR:
+                result[key] = float(np.mean([v for v in values]))
+                continue
+            vcat = np.concatenate([v.reshape(-1) for v, _ in values])
+            mcat = np.concatenate([m.reshape(-1) for _, m in values])
+            n = mcat.sum()
+            if rt == ReduceType.AVG:
+                result[key] = float((vcat * mcat).sum() / max(n, 1))
+            elif rt == ReduceType.SUM:
+                result[key] = float((vcat * mcat).sum())
+            elif rt == ReduceType.MIN:
+                result[key] = float(
+                    np.where(mcat, vcat, np.inf).min()
+                ) if n else 0.0
+            elif rt == ReduceType.MAX:
+                result[key] = float(
+                    np.where(mcat, vcat, -np.inf).max()
+                ) if n else 0.0
+        for key, masks in self._denominators.items():
+            result[f"{key}/n"] = float(sum(m.sum() for m in masks))
+        if reset:
+            self._stats.clear()
+            self._denominators.clear()
+        return result
+
+
+# Default process-level tracker, mirroring reference module-level API.
+DEFAULT = DistributedStatsTracker()
+
+denominator = DEFAULT.denominator
+stat = DEFAULT.stat
+scalar = DEFAULT.scalar
+scope = DEFAULT.scope
+export = DEFAULT.export

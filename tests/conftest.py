@@ -1,0 +1,35 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax import.
+
+Mirrors the reference's CPU-only test strategy (``realhf/base/testing.py``):
+the whole stack must be testable without TPU hardware. An 8-device host
+platform replaces the reference's 8-process gloo trick (SURVEY.md §4).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("AREAL_FILEROOT", "/tmp/areal_tpu_test")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    from areal_tpu.base import seeding
+
+    seeding.set_random_seed(1, "test")
+    yield

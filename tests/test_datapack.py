@@ -1,0 +1,58 @@
+import numpy as np
+import pytest
+
+from areal_tpu.base import datapack
+
+
+@pytest.mark.parametrize("k", [1, 2, 4, 7])
+def test_partition_balanced_valid(rng, k):
+    nums = rng.integers(1, 1000, size=37).tolist()
+    bounds = datapack.partition_balanced(nums, k)
+    assert bounds[0] == 0 and bounds[-1] == len(nums)
+    assert all(bounds[i] < bounds[i + 1] for i in range(k))
+
+
+def test_partition_balanced_optimal_small():
+    # Brute-force check optimality on small inputs.
+    import itertools
+
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        nums = rng.integers(1, 50, size=8).tolist()
+        k = 3
+        bounds = datapack.partition_balanced(nums, k)
+        got = max(
+            sum(nums[bounds[i]: bounds[i + 1]]) for i in range(k)
+        )
+        best = min(
+            max(sum(nums[a:b]), sum(nums[b:c]), sum(nums[c:]))
+            for a, b, c in [(0, b, c) for b in range(1, 7) for c in range(b + 1, 8)]
+        )
+        assert got == best
+
+
+def test_partition_min_size():
+    nums = [100, 1, 1, 1]
+    bounds = datapack.partition_balanced(nums, 2, min_size=2)
+    assert bounds == [0, 2, 4]
+
+
+def test_ffd_allocate():
+    sizes = [5, 9, 3, 7, 2, 6]
+    bins = datapack.ffd_allocate(sizes, capacity=10)
+    seen = sorted(i for b in bins for i in b)
+    assert seen == list(range(6))
+    for b in bins:
+        assert sum(sizes[i] for i in b) <= 10
+
+
+def test_ffd_min_groups():
+    bins = datapack.ffd_allocate([1, 1], capacity=100, min_groups=2)
+    assert len(bins) >= 2
+
+
+def test_ffd_oversize_item():
+    bins = datapack.ffd_allocate([50, 5], capacity=10)
+    assert [50] in [[sum([50, 5][i] for i in b)] for b in bins] or any(
+        b == [0] for b in bins
+    )

@@ -1,0 +1,84 @@
+"""≈ reference ``tests/distributed/test_name_resolve.py``: parametrized over
+backends."""
+
+import pytest
+
+from areal_tpu.base import name_resolve
+from areal_tpu.base.name_resolve import (
+    NameEntryExistsError,
+    NameEntryNotFoundError,
+    NameResolveConfig,
+    make_repository,
+)
+
+
+@pytest.fixture(params=["memory", "file"])
+def repo(request, tmp_path):
+    cfg = NameResolveConfig(type=request.param, root=str(tmp_path / "nr"))
+    r = make_repository(cfg)
+    yield r
+    r.reset()
+
+
+def test_add_get_delete(repo):
+    repo.add("a/b/c", "v1")
+    assert repo.get("a/b/c") == "v1"
+    with pytest.raises(NameEntryExistsError):
+        repo.add("a/b/c", "v2")
+    repo.add("a/b/c", "v2", replace=True)
+    assert repo.get("a/b/c") == "v2"
+    repo.delete("a/b/c")
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("a/b/c")
+    with pytest.raises(NameEntryNotFoundError):
+        repo.delete("a/b/c")
+
+
+def test_subtree(repo):
+    repo.add("root/x/1", "a")
+    repo.add("root/x/2", "b")
+    repo.add("root/y", "c")
+    assert repo.get_subtree("root/x") == ["a", "b"]
+    assert repo.find_subtree("root/x") == ["root/x/1", "root/x/2"]
+    assert sorted(repo.get_subtree("root")) == ["a", "b", "c"]
+    repo.clear_subtree("root/x")
+    assert repo.get_subtree("root/x") == []
+    assert repo.get("root/y") == "c"
+
+
+def test_wait(repo):
+    import threading, time
+
+    def _adder():
+        time.sleep(0.2)
+        repo.add("late/key", "zzz")
+
+    t = threading.Thread(target=_adder)
+    t.start()
+    assert repo.wait("late/key", timeout=5) == "zzz"
+    t.join()
+    with pytest.raises(TimeoutError):
+        repo.wait("never/key", timeout=0.2)
+
+
+def test_add_subentry(repo):
+    k1 = repo.add_subentry("sub", "v1")
+    k2 = repo.add_subentry("sub", "v2")
+    assert k1 != k2
+    assert sorted(repo.get_subtree("sub")) == ["v1", "v2"]
+
+
+def test_reset(repo):
+    repo.add("keep", "1", delete_on_exit=False)
+    repo.add("drop", "2", delete_on_exit=True)
+    repo.reset()
+    assert repo.get("keep") == "1"
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("drop")
+
+
+def test_module_level_default():
+    name_resolve.reconfigure(NameResolveConfig(type="memory"))
+    name_resolve.add("m/k", "v")
+    assert name_resolve.get("m/k") == "v"
+    name_resolve.reset()

@@ -1,0 +1,48 @@
+from areal_tpu.base import recover
+from areal_tpu.base.recover import RecoverInfo, StepInfo
+from areal_tpu.base.timeutil import EpochStepTimeFreqCtl
+
+
+def test_freq_ctl_step():
+    ctl = EpochStepTimeFreqCtl(freq_step=3)
+    assert [ctl.check() for _ in range(7)] == [
+        False, False, True, False, False, True, False,
+    ]
+
+
+def test_freq_ctl_epoch():
+    ctl = EpochStepTimeFreqCtl(freq_epoch=2)
+    assert not ctl.check(epochs=1)
+    assert ctl.check(epochs=1)
+
+
+def test_freq_ctl_state_roundtrip():
+    ctl = EpochStepTimeFreqCtl(freq_step=5)
+    ctl.check()
+    ctl.check()
+    st = ctl.state_dict()
+    ctl2 = EpochStepTimeFreqCtl(freq_step=5)
+    ctl2.load_state_dict(st)
+    assert not ctl2.check()
+    assert not ctl2.check()
+    assert ctl2.check()
+
+
+def test_step_info_next():
+    s = StepInfo(0, 4, 9)
+    s2 = s.next(steps_per_epoch=5)
+    assert (s2.epoch, s2.epoch_step, s2.global_step) == (1, 0, 10)
+
+
+def test_recover_info_roundtrip(tmp_path):
+    info = RecoverInfo(
+        recover_start=StepInfo(1, 2, 3),
+        last_step_info=StepInfo(1, 1, 2),
+        save_ctl_states={"actor": {"epoch_count": 0, "step_count": 1}},
+        hash_vals_to_ignore=[123, 456],
+    )
+    recover.dump(info, root=str(tmp_path))
+    loaded = recover.load(root=str(tmp_path))
+    assert loaded.recover_start == StepInfo(1, 2, 3)
+    assert loaded.hash_vals_to_ignore == [123, 456]
+    assert recover.load(root=str(tmp_path / "nope")) is None

@@ -1,0 +1,116 @@
+"""≈ reference ``tests/data/test_sequence_gather_split.py``."""
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+
+
+def make_sample(rng, n_items=6, with_data=True):
+    seqlens = rng.integers(2, 17, size=n_items).tolist()
+    total = sum(seqlens)
+    data = {
+        "packed_input_ids": rng.integers(0, 100, size=total).astype(np.int64),
+        "rewards": rng.normal(size=n_items).astype(np.float32),
+    }
+    return SequenceSample.from_default(
+        ids=[f"id{i}" for i in range(n_items)],
+        seqlens=seqlens,
+        data=data,
+        metadata={"birth_time": [float(i) for i in range(n_items)]},
+    )
+
+
+def test_from_default_shapes(rng):
+    s = make_sample(rng)
+    assert s.bs == 6
+    assert s.seqlens["rewards"] == [[1]] * 6
+    assert s.total_len("rewards") == 6
+
+
+def test_gather_split_roundtrip(rng):
+    s = make_sample(rng)
+    parts = s.split_with_lengths([2, 3, 1])
+    assert [p.bs for p in parts] == [2, 3, 1]
+    regathered = SequenceSample.gather(parts)
+    assert regathered.ids == s.ids
+    np.testing.assert_array_equal(
+        regathered.data["packed_input_ids"], s.data["packed_input_ids"]
+    )
+    np.testing.assert_array_equal(regathered.data["rewards"], s.data["rewards"])
+    assert regathered.metadata["birth_time"] == s.metadata["birth_time"]
+
+
+def test_unpack(rng):
+    s = make_sample(rng)
+    items = s.unpack()
+    assert len(items) == s.bs
+    for i, it in enumerate(items):
+        assert it.ids == [f"id{i}"]
+        assert it.item_total_len("packed_input_ids", 0) == s.item_total_len(
+            "packed_input_ids", i
+        )
+
+
+def test_balanced_split(rng):
+    s = make_sample(rng, n_items=10)
+    parts = s.split(3)
+    totals = [p.total_len("packed_input_ids") for p in parts]
+    assert sum(totals) == s.total_len("packed_input_ids")
+    # Balanced: max part within 2x of ideal.
+    assert max(totals) <= 2 * (sum(totals) // 3 + 16)
+
+
+def test_micro_batch_token_budget(rng):
+    s = make_sample(rng, n_items=10)
+    mbs = s.split_into_micro_batches(MicroBatchSpec(n_mbs=1, max_tokens_per_mb=30))
+    assert all(
+        mb.total_len("packed_input_ids") <= 30 or mb.bs == 1 for mb in mbs
+    )
+
+
+def test_meta_and_update(rng):
+    s = make_sample(rng)
+    m = s.meta()
+    assert m.data is None and m.ids == s.ids
+    extra = SequenceSample(
+        keys={"advantages"},
+        ids=list(s.ids),
+        seqlens={"advantages": s.seqlens["packed_input_ids"]},
+        data={
+            "advantages": np.zeros(
+                s.total_len("packed_input_ids"), dtype=np.float32
+            )
+        },
+    )
+    s.update_(extra)
+    assert "advantages" in s.keys
+    sel = s.select(["advantages", "rewards"])
+    assert sel.keys == {"advantages", "rewards"}
+
+
+def test_remap(rng):
+    s = make_sample(rng)
+    s.remap_keys_({"packed_input_ids": "input_ids"})
+    assert "input_ids" in s.keys and "packed_input_ids" not in s.keys
+
+
+def test_json_roundtrip(rng):
+    s = make_sample(rng)
+    d = s.as_json_compatible()
+    import json
+
+    d = json.loads(json.dumps(d))  # force plain types
+    s2 = SequenceSample.from_json_compatible(d)
+    assert s2.ids == [str(i) for i in s.ids]
+    np.testing.assert_array_equal(
+        s2.data["packed_input_ids"], s.data["packed_input_ids"]
+    )
+    np.testing.assert_allclose(s2.data["rewards"], s.data["rewards"], rtol=1e-6)
+
+
+def test_gather_mismatched_keys_raises(rng):
+    s1 = make_sample(rng)
+    s2 = s1.select(["rewards"])
+    with pytest.raises(ValueError):
+        SequenceSample.gather([s1, s2], keys=["packed_input_ids"])

@@ -1,0 +1,60 @@
+"""≈ reference ``tests/data/test_stats_tracker.py``."""
+
+import numpy as np
+import pytest
+
+from areal_tpu.base.stats_tracker import DistributedStatsTracker, ReduceType
+
+
+def test_masked_avg():
+    t = DistributedStatsTracker()
+    mask = np.array([1, 1, 0, 0], dtype=bool)
+    vals = np.array([1.0, 3.0, 100.0, 100.0], dtype=np.float32)
+    t.denominator(mask=mask)
+    t.stat("mask", loss=vals)
+    out = t.export()
+    assert out["loss"] == pytest.approx(2.0)
+    assert out["mask/n"] == 2
+
+
+def test_scopes_and_reduce_types():
+    t = DistributedStatsTracker()
+    with t.scope("actor"):
+        m = np.ones(3, dtype=bool)
+        t.denominator(n_tokens=m)
+        t.stat("n_tokens", reduce_type=ReduceType.SUM, x=np.array([1.0, 2, 3]))
+        t.stat("n_tokens", reduce_type=ReduceType.MAX, y=np.array([1.0, 5, 3]))
+        t.stat("n_tokens", reduce_type=ReduceType.MIN, z=np.array([1.0, 5, -3]))
+    t.scalar(lr=0.1)
+    out = t.export()
+    assert out["actor/x"] == 6.0
+    assert out["actor/y"] == 5.0
+    assert out["actor/z"] == -3.0
+    assert out["lr"] == pytest.approx(0.1)
+
+
+def test_accumulate_multiple_steps():
+    t = DistributedStatsTracker()
+    for i in range(3):
+        mask = np.array([1, i % 2], dtype=bool)
+        t.denominator(m=mask)
+        t.stat("m", v=np.array([1.0, 10.0]))
+    out = t.export()
+    # masks: [1,0],[1,1],[1,0] -> selected vals [1],[1,10],[1] => mean 13/4
+    assert out["v"] == pytest.approx(13 / 4)
+
+
+def test_shape_mismatch_raises():
+    t = DistributedStatsTracker()
+    t.denominator(m=np.ones(3, dtype=bool))
+    with pytest.raises(ValueError):
+        t.stat("m", v=np.ones(4))
+    with pytest.raises(ValueError):
+        t.stat("nope", v=np.ones(3))
+
+
+def test_export_resets():
+    t = DistributedStatsTracker()
+    t.scalar(a=1.0)
+    assert "a" in t.export()
+    assert t.export() == {}
