@@ -27,16 +27,6 @@ import numpy as np
 
 from areal_tpu.base import datapack
 
-_DTYPE_NAMES = {
-    np.dtype(np.float32): "float32",
-    np.dtype(np.float16): "float16",
-    np.dtype(np.int64): "int64",
-    np.dtype(np.int32): "int32",
-    np.dtype(np.uint8): "uint8",
-    np.dtype(np.bool_): "bool",
-}
-
-
 def _np_dtype(name: str) -> np.dtype:
     if name == "bfloat16":
         import ml_dtypes
@@ -174,8 +164,18 @@ class SequenceSample:
         if has_data:
             data = {}
             for k in keys:
-                parts = [s.data[k] for s in samples if s.data.get(k) is not None]
-                data[k] = np.concatenate(parts, axis=0) if parts else None
+                parts = [s.data.get(k) for s in samples]
+                if all(p is None for p in parts):
+                    data[k] = None
+                elif any(p is None for p in parts):
+                    # a partial mix would yield a packed array shorter than
+                    # sum(seqlens) and a confusing downstream crash
+                    raise ValueError(
+                        f"gather: key {k!r} present in some samples but None "
+                        "in others"
+                    )
+                else:
+                    data[k] = np.concatenate(parts, axis=0)
         metadata = {}
         for mk in samples[0].metadata:
             if all(mk in s.metadata for s in samples):

@@ -8,18 +8,14 @@ balanced-partition helpers at :18). Used for:
 - packing sequences into micro-batches under a token budget (first-fit
   decreasing bin packing).
 
-A C++ implementation lives in ``csrc/datapack.cpp`` (built as
-``areal_tpu._native``); these pure-python versions are the reference/fallback.
+Pure Python/numpy: partitioning a few thousand sequence lengths is
+microseconds and never on the hot path (the reference's C++ is also only a
+CPU-side helper).
 """
 
 from typing import List, Optional, Sequence
 
 import numpy as np
-
-try:  # optional native acceleration
-    from areal_tpu import _native  # type: ignore
-except ImportError:  # pragma: no cover
-    _native = None
 
 
 def partition_balanced(nums: Sequence[int], k: int, min_size: int = 1) -> List[int]:
@@ -83,11 +79,6 @@ def ffd_allocate(
 
     Items larger than capacity get singleton bins.
     """
-    if _native is not None:
-        try:
-            return _native.ffd_allocate(list(map(int, sizes)), int(capacity), int(min_groups))
-        except Exception:  # pragma: no cover - fall back on any native issue
-            pass
     order = sorted(range(len(sizes)), key=lambda i: -sizes[i])
     bins: List[List[int]] = []
     loads: List[int] = []

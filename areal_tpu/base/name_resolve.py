@@ -167,11 +167,12 @@ class MemoryNameRecordRepository(NameRecordRepository):
     def get_subtree(self, name_root):
         name_root = name_root.rstrip("/")
         with self._lock:
-            return sorted(
+            # ordered by key so the result aligns with find_subtree
+            return [
                 v
-                for k, v in self._store.items()
+                for k, v in sorted(self._store.items())
                 if k == name_root or k.startswith(name_root + "/")
-            )
+            ]
 
     def find_subtree(self, name_root):
         name_root = name_root.rstrip("/")
@@ -208,13 +209,21 @@ class FileNameRecordRepository(NameRecordRepository):
 
     def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
         path = self._path(name)
-        if os.path.exists(path) and not replace:
-            raise NameEntryExistsError(name)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + f".tmp.{os.getpid()}.{random.randint(0, 1 << 30)}"
-        with open(tmp, "w") as f:
-            f.write(str(value))
-        os.replace(tmp, path)  # atomic on POSIX
+        if replace:
+            tmp = path + f".tmp.{os.getpid()}.{random.randint(0, 1 << 30)}"
+            with open(tmp, "w") as f:
+                f.write(str(value))
+            os.replace(tmp, path)  # atomic on POSIX
+        else:
+            # O_EXCL makes create-if-absent atomic across processes — two
+            # workers racing to claim the same rendezvous key cannot both win.
+            try:
+                fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
+            except FileExistsError:
+                raise NameEntryExistsError(name) from None
+            with os.fdopen(fd, "w") as f:
+                f.write(str(value))
         if delete_on_exit:
             with self._lock:
                 self._to_delete.add(name)

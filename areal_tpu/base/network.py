@@ -21,10 +21,26 @@ def gethostip() -> str:
 
 
 def find_free_port(low: int = 1, high: int = 65536) -> int:
-    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind(("", 0))
-        return s.getsockname()[1]
+    """Free TCP port; honors [low, high) so callers can stay inside a
+    firewalled range. The default full range uses the fast bind-0 path."""
+    if low <= 1 and high >= 65536:
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("", 0))
+            return s.getsockname()[1]
+    import random as _random
+
+    ports = list(range(max(low, 1), min(high, 65536)))
+    _random.shuffle(ports)
+    for p in ports:
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                s.bind(("", p))
+                return p
+            except OSError:
+                continue
+    raise RuntimeError(f"No free port in [{low}, {high})")
 
 
 def find_multiple_free_ports(count: int) -> List[int]:

@@ -10,13 +10,6 @@ import time
 from typing import Optional
 
 
-@dataclasses.dataclass
-class FreqSpec:
-    freq_epoch: Optional[int] = None
-    freq_step: Optional[int] = None
-    freq_sec: Optional[float] = None
-
-
 class EpochStepTimeFreqCtl:
     def __init__(
         self,

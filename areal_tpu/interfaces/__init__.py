@@ -1,0 +1,13 @@
+"""Algorithm interfaces (≈ ``realhf/impl/model/interface/``).
+
+Importing this package registers all built-in interfaces, mirroring the
+reference's ``realhf/impl/model/__init__.py:114`` registration pattern.
+"""
+
+from areal_tpu.api.model import register_interface
+from areal_tpu.interfaces.sft import SFTInterface
+from areal_tpu.interfaces.ppo import PPOActorInterface, PPOCriticInterface
+
+register_interface("sft", SFTInterface)
+register_interface("ppo_actor", PPOActorInterface)
+register_interface("ppo_critic", PPOCriticInterface)

@@ -1,0 +1,297 @@
+"""PPO actor & critic interfaces (decoupled async PPO).
+
+TPU-native counterpart of ``realhf/impl/model/interface/ppo_interface.py``
+(1341 LoC). The structure mirrors the reference's train_step
+(``ppo_interface.py:527``): reward shaping with KL penalty → GAE →
+(group-)advantage normalization over the *whole* batch → minibatch loop with
+one optimizer step each, using the decoupled/dual-clip actor loss.
+
+Key layout difference: every per-token quantity is token-aligned on the
+packed axis (logprob at position t = log p(token t+1 | ≤ t)), so the action
+mask is "has a next token AND the next token is generated". GAE runs as one
+associative scan over the flat packed batch (``areal_tpu.ops.ppo``), not a
+CUDA kernel.
+"""
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model import ModelInterface, PPOHyperparameters
+from areal_tpu.ops import ppo as ppo_ops
+from areal_tpu.train import batching
+from areal_tpu.train.engine import vmapped_forward
+
+
+def _action_mask(arrays) -> jnp.ndarray:
+    """[D, T] bool: positions whose *label* (next token) is a generated
+    token of the same segment."""
+    seg = arrays["segment_ids"]
+    has_next = (seg > 0) & ~jax.vmap(ppo_ops.is_segment_end)(seg)
+    pm = arrays["prompt_mask"].astype(bool)
+    label_is_prompt = jnp.concatenate([pm[:, 1:], jnp.zeros_like(pm[:, :1])], 1)
+    return has_next & ~label_is_prompt
+
+
+def logprob_output_fn(params, cfg, arrays):
+    """Token-aligned logprobs of the next token — the "inference" MFC that
+    recomputes proximal logprobs (≈ ``ppo_interface.py:474``)."""
+    logits = vmapped_forward(params, cfg, arrays)
+    return jax.vmap(ppo_ops.gather_packed_shifted_log_probs)(
+        logits, arrays["input_ids"], arrays["segment_ids"]
+    )
+
+
+def value_output_fn(params, cfg, arrays):
+    """Per-token critic values [D, T] (zero on padding)."""
+    values = vmapped_forward(params, cfg, arrays)[..., 0]
+    return jnp.where(arrays["segment_ids"] > 0, values, 0.0)
+
+
+
+
+@dataclasses.dataclass
+class PPOActorInterface(ModelInterface):
+    hp: PPOHyperparameters = dataclasses.field(default_factory=PPOHyperparameters)
+    hf_family: Optional[str] = None
+
+    def __post_init__(self):
+        if self.hp.use_adaptive_kl:
+            self.kl_ctl = ppo_ops.AdaptiveKLController(
+                self.hp.kl_ctl, self.hp.adaptive_kl_target, self.hp.adaptive_kl_horizon
+            )
+        else:
+            self.kl_ctl = ppo_ops.FixedKLController(self.hp.kl_ctl)
+        self._last_ref_kl = 0.0
+        # Built once so the engine's jit cache hits across train_step calls.
+        self._actor_loss_fn = self._build_actor_loss()
+
+    def _build_actor_loss(self):
+        hp = self.hp
+
+        def actor_loss(params, cfg, arrays):
+            mask = _action_mask(arrays)
+            logits, aux = vmapped_forward(params, cfg, arrays, with_aux=True)
+            new_lp = jax.vmap(ppo_ops.gather_packed_shifted_log_probs)(
+                logits, arrays["input_ids"], arrays["segment_ids"]
+            )
+            old_lp = arrays["packed_logprobs"].astype(jnp.float32)
+            prox = arrays.get("prox_logp")
+            if hp.use_decoupled_loss and prox is not None:
+                prox = prox.astype(jnp.float32)
+            elif hp.recompute_logprob and prox is not None:
+                # sync-PPO with recomputed logprobs: use them as "old"
+                old_lp, prox = prox.astype(jnp.float32), None
+            else:
+                prox = None
+            loss, stat = ppo_ops.actor_loss_fn(
+                new_lp.reshape(-1),
+                old_lp.reshape(-1),
+                arrays["advantages"].astype(jnp.float32).reshape(-1),
+                hp.eps_clip,
+                mask.reshape(-1),
+                c_clip=hp.c_clip,
+                proximal_logprobs=None if prox is None else prox.reshape(-1),
+                behav_imp_weight_cap=hp.behav_imp_weight_cap,
+            )
+            n = jnp.maximum(mask.sum(), 1)
+            scalar_stats = {
+                "actor_loss": loss,
+                "importance_weight": jnp.sum(stat["importance_weight"]) / n,
+                "actor_clip_ratio": jnp.sum(stat["clip_mask"]) / n,
+                "approx_kl": jnp.sum(jnp.abs(stat["approx_kl"] * mask.reshape(-1))) / n,
+            }
+            return loss + aux, scalar_stats
+
+        return actor_loss
+
+    # -------------------------------------------------------------- #
+    # proximal logprob recompute (actor_inf MFC)
+    # -------------------------------------------------------------- #
+
+    def inference(
+        self, engine, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        outs = engine.forward(sample, mb_spec, logprob_output_fn)
+        main = sample.main_key()
+        res = SequenceSample(
+            keys={"prox_logp"},
+            ids=list(sample.ids),
+            seqlens={"prox_logp": [list(l) for l in sample.seqlens[main]]},
+            data={"prox_logp": np.concatenate([o.astype(np.float32) for o in outs])},
+        )
+        return res
+
+    # -------------------------------------------------------------- #
+    # advantage computation over the full batch
+    # -------------------------------------------------------------- #
+
+    def _prepare(self, sample: SequenceSample) -> SequenceSample:
+        """Compute advantages/returns on the whole batch (flat packed layout)
+        and attach them as new keys — the analogue of the reference's
+        pre-minibatch GAE + normalization block (``ppo_interface.py:527-647``)."""
+        hp = self.hp
+        pb = batching.pack_sequences(sample, n_rows=1, pad_multiple=128)
+        a = {k: jnp.asarray(v[0]) for k, v in pb.arrays.items()}
+        seg = a["segment_ids"]
+        mask = _action_mask({k: v[None] for k, v in a.items()})[0]
+
+        behav_lp = a["packed_logprobs"].astype(jnp.float32)
+        ref_lp = a.get("packed_ref_logprobs")
+        if ref_lp is None:
+            ref_lp = behav_lp  # zero KL penalty
+        values = a.get("values")
+        if values is None or hp.disable_value:
+            values = jnp.zeros_like(behav_lp)
+        values = values.astype(jnp.float32) * mask
+
+        reward_score = (
+            a["rewards"].astype(jnp.float32) * hp.reward_output_scaling
+            + hp.reward_output_bias
+        )
+        no_eos = a.get("seq_no_eos_mask")
+        if no_eos is None:
+            no_eos = jnp.zeros_like(reward_score, dtype=bool)
+        no_eos = no_eos.astype(bool)
+
+        # KL-penalized dense rewards + task reward at the *last action* token
+        ref_kl = behav_lp - ref_lp.astype(jnp.float32)
+        self._last_ref_kl = float(
+            jnp.sum(jnp.where(mask, ref_kl, 0.0)) / jnp.maximum(mask.sum(), 1)
+        )
+        kl_rw = jnp.where(mask, -self.kl_ctl.value * ref_kl, 0.0)
+        nxt_mask = jnp.concatenate([mask[1:], jnp.zeros((1,), bool)])
+        last_action = mask & ~nxt_mask
+        score = jnp.clip(reward_score, -hp.max_reward_clip, hp.max_reward_clip)
+        if hp.mask_no_eos_with_zero:
+            score = jnp.where(no_eos, 0.0, score)
+        rewards = kl_rw + jnp.where(last_action, score, 0.0)
+
+        # next values: within the action span values[t+1]; at the last action,
+        # bootstrap with the next token's value iff the sequence was truncated
+        # (≈ cugae's seq_no_eos bootstrap).
+        shifted_v = jnp.concatenate([values[1:], jnp.zeros((1,), values.dtype)])
+        raw_v = a.get("values")
+        if raw_v is not None and not hp.disable_value:
+            shifted_raw = jnp.concatenate(
+                [raw_v.astype(jnp.float32)[1:], jnp.zeros((1,), jnp.float32)]
+            )
+        else:
+            shifted_raw = jnp.zeros_like(values)
+        next_values = jnp.where(
+            nxt_mask, shifted_v, jnp.where(no_eos, shifted_raw, 0.0)
+        )
+
+        adv, ret = ppo_ops.segment_gae(
+            rewards, values, next_values, seg, hp.discount, hp.gae_lambda,
+            mask=mask, not_end=nxt_mask,
+        )
+        if hp.group_adv_norm:
+            adv = ppo_ops.group_normalization(
+                adv, mask, a["item_ids"], num_groups=sample.bs
+            )
+        elif hp.adv_norm:
+            adv = ppo_ops.masked_normalization(adv, mask)
+
+        return self._attach(sample, pb, adv, ret, kl_rw)
+
+    def _attach(self, sample, pb, adv, ret, kl_rw):
+        main = sample.main_key()
+        seqlens = {"advantages": [list(l) for l in sample.seqlens[main]],
+                   "returns": [list(l) for l in sample.seqlens[main]],
+                   "kl_rewards": [list(l) for l in sample.seqlens[main]]}
+        data = {}
+        for key, arr in (("advantages", adv), ("returns", ret), ("kl_rewards", kl_rw)):
+            per_seq = pb.unpack(np.asarray(arr)[None])
+            data[key] = np.concatenate(per_seq).astype(np.float32)
+        sample.update_(
+            SequenceSample(
+                keys=set(seqlens), ids=list(sample.ids), seqlens=seqlens, data=data
+            )
+        )
+        return sample
+
+    # -------------------------------------------------------------- #
+    # train step
+    # -------------------------------------------------------------- #
+
+    def train_step(
+        self, engine, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Dict[str, float]:
+        hp = self.hp
+        sample = self._prepare(sample)
+        mbs = sample.split(min(hp.ppo_n_minibatches, sample.bs))
+        all_stats = []
+        for mb in mbs:
+            stats = engine.train_batch(mb, mb_spec, self._actor_loss_fn)
+            all_stats.append(stats)
+        engine.version += 1
+        out = {k: float(np.mean([s[k] for s in all_stats])) for k in all_stats[0]}
+        # Adaptive KL control tracks policy-vs-reference divergence (the
+        # signed masked mean over action tokens), like the reference
+        # (ppo_interface.py:973-978) — NOT the PPO update KL.
+        self.kl_ctl.update(self._last_ref_kl, sample.bs)
+        out["kl_ctl"] = self.kl_ctl.value
+        out["ref_kl"] = self._last_ref_kl
+        out["n_seqs"] = sample.bs
+        return out
+
+
+@dataclasses.dataclass
+class PPOCriticInterface(ModelInterface):
+    hp: PPOHyperparameters = dataclasses.field(default_factory=PPOHyperparameters)
+    hf_family: Optional[str] = None
+
+    def __post_init__(self):
+        self.kl_ctl = ppo_ops.FixedKLController(self.hp.kl_ctl)
+        self._actor_helper = PPOActorInterface(hp=self.hp)
+        hp = self.hp
+
+        def critic_loss(params, cfg, arrays):
+            mask = _action_mask(arrays)
+            values, aux = vmapped_forward(params, cfg, arrays, with_aux=True)
+            new_values = jnp.where(
+                arrays["segment_ids"] > 0, values[..., 0], 0.0
+            )
+            loss, stat = ppo_ops.critic_loss_fn(
+                new_values.reshape(-1),
+                arrays["values"].astype(jnp.float32).reshape(-1),
+                arrays["returns"].astype(jnp.float32).reshape(-1),
+                hp.value_eps_clip,
+                mask.reshape(-1),
+            )
+            n = jnp.maximum(mask.sum(), 1)
+            return loss + aux, {
+                "critic_loss": loss,
+                "value_clip_ratio": jnp.sum(stat["clip_mask"]) / n,
+            }
+
+        self._critic_loss_fn = critic_loss
+
+    def inference(
+        self, engine, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        outs = engine.forward(sample, mb_spec, value_output_fn)
+        main = sample.main_key()
+        return SequenceSample(
+            keys={"values"},
+            ids=list(sample.ids),
+            seqlens={"values": [list(l) for l in sample.seqlens[main]]},
+            data={"values": np.concatenate([o.astype(np.float32) for o in outs])},
+        )
+
+    def train_step(
+        self, engine, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Dict[str, float]:
+        hp = self.hp
+        sample = self._actor_helper._prepare(sample)
+        mbs = sample.split(min(hp.ppo_n_minibatches, sample.bs))
+        all_stats = [
+            engine.train_batch(mb, mb_spec, self._critic_loss_fn) for mb in mbs
+        ]
+        engine.version += 1
+        return {k: float(np.mean([s[k] for s in all_stats])) for k in all_stats[0]}

@@ -1,0 +1,61 @@
+"""Supervised fine-tuning interface.
+
+TPU-native counterpart of ``realhf/impl/model/interface/sft_interface.py``
+(146 LoC): next-token cross-entropy over non-prompt tokens of packed
+sequences.
+"""
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model import ModelInterface
+from areal_tpu.ops import ppo as ppo_ops
+from areal_tpu.train.engine import vmapped_forward
+
+
+def sft_loss_fn(params, cfg, arrays):
+    """-mean log p(next token) over answer tokens (prompt_mask==0)."""
+    logits, aux = vmapped_forward(params, cfg, arrays, with_aux=True)
+    lp = jax.vmap(ppo_ops.gather_packed_shifted_log_probs)(
+        logits, arrays["input_ids"], arrays["segment_ids"]
+    )
+    seg = arrays["segment_ids"]
+    has_next = (seg > 0) & ~jax.vmap(ppo_ops.is_segment_end)(seg)
+    mask = has_next
+    if "prompt_mask" in arrays:
+        # the label of position t is token t+1: mask positions whose *label*
+        # is still part of the prompt
+        pm = arrays["prompt_mask"].astype(bool)
+        label_is_prompt = jnp.concatenate(
+            [pm[:, 1:], jnp.zeros_like(pm[:, :1])], axis=1
+        )
+        mask = mask & ~label_is_prompt
+    n = jnp.maximum(mask.sum(), 1)
+    loss = -jnp.sum(jnp.where(mask, lp, 0.0)) / n
+    return loss + aux, {
+        "ppl": jnp.exp(loss),
+        "n_tokens": n.astype(jnp.float32),
+    }
+
+
+@dataclasses.dataclass
+class SFTInterface(ModelInterface):
+    token_normalize_scope: str = "global"
+
+    def train_step(
+        self, engine, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Dict[str, float]:
+        stats = engine.train_batch(sample, mb_spec, sft_loss_fn)
+        return stats
+
+    def evaluate(self, engine, eval_samples) -> Dict[str, float]:
+        tot, n = 0.0, 0
+        for s in eval_samples:
+            r = engine.eval_batch(s, MicroBatchSpec(), sft_loss_fn)
+            tot += r["loss"]
+            n += 1
+        return {"loss": tot / max(n, 1)} if n else {}

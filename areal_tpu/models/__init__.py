@@ -1,0 +1,10 @@
+"""TPU-native model layer: packed-varlen transformer + HF family converters.
+
+Counterpart of the reference's ``realhf/impl/model/nn`` (ReaLModel) and
+``realhf/api/from_hf`` converter registry (SURVEY.md §2.4-§2.5) — redesigned
+as functional JAX: parameters are plain pytrees with stacked layer axes
+(``lax.scan`` over layers), sharding is declarative logical-axis metadata
+consumed by ``areal_tpu.parallel``.
+"""
+
+from areal_tpu.models.config import ModelConfig  # noqa: F401

@@ -1,0 +1,97 @@
+"""Model architecture config.
+
+TPU-native counterpart of ``ReaLModelConfig`` (``realhf/api/core/model_api.py:340``)
+and ``ReaLMoEConfig`` (``:294``). One dataclass covers every supported HF
+family (llama, qwen2, qwen3, mistral, gemma, gpt2, mixtral) via feature
+switches, exactly like the reference's single in-house architecture.
+"""
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """Mixture-of-experts settings (≈ ``ReaLMoEConfig``)."""
+
+    num_experts: int = 8
+    top_k: int = 2
+    routed_scaling_factor: float = 1.0
+    aux_loss_coeff: float = 0.0
+    z_loss_coeff: float = 0.0
+    input_jitter_eps: Optional[float] = None
+    norm_topk_prob: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    n_layers: int
+    n_q_heads: int
+    n_kv_heads: int
+    head_dim: int
+    hidden_dim: int
+    intermediate_dim: int
+    vocab_size: int
+    n_positions: int = 32768
+
+    # Norms
+    layer_norm_type: str = "rms"      # "rms" | "gemma" (=(1+w) rms) | "layer" (gpt2)
+    layer_norm_epsilon: float = 1e-5
+
+    # Attention
+    use_attention_bias: bool = False       # qkv projection bias (qwen2, gpt2)
+    use_attn_proj_bias: bool = False       # output projection bias (gpt2)
+    qk_layernorm: bool = False             # per-head q/k RMSNorm (qwen3)
+    sliding_window: Optional[int] = None
+    attn_logits_soft_cap: Optional[float] = None
+    softmax_scale: Optional[float] = None  # default head_dim ** -0.5
+
+    # Rotary (apply_rotary False => learned absolute positions, gpt2)
+    apply_rotary: bool = True
+    rotary_base: float = 10000.0
+    rotary_dim: Optional[int] = None       # default head_dim
+    rotary_scaling_type: Optional[str] = None
+    rotary_scaling_factor: float = 1.0
+    rotary_low_freq_factor: float = 1.0
+    rotary_high_freq_factor: float = 4.0
+    rotary_original_max_position: int = 8192
+
+    # MLP
+    activation_function: str = "silu"
+    mlp_type: str = "gated"                # "gated" (swiglu) | "fc" (gpt2) | "moe"
+    use_mlp_bias: bool = False             # gpt2
+    moe: Optional[MoEConfig] = None
+
+    # Embeddings / head
+    tied_embedding: bool = False
+    normalize_embed: bool = False          # gemma: scale embeds by sqrt(hidden)
+    final_logits_soft_cap: Optional[float] = None
+    abs_position_embedding: bool = False   # gpt2 learned positions
+
+    # Dropout (SFT only; PPO runs with 0 like the reference)
+    embd_pdrop: float = 0.0
+    resid_pdrop: float = 0.0
+    attn_pdrop: float = 0.0
+
+    # Head
+    is_critic: bool = False                # scalar value head instead of LM head
+
+    # Compute dtype for activations (params kept fp32 master in the optimizer)
+    dtype: str = "bfloat16"
+
+    # Attention backend toggle (flash only on TPU)
+    use_flash_attention: bool = False
+
+    @property
+    def n_rep(self) -> int:
+        return self.n_q_heads // self.n_kv_heads
+
+    @property
+    def rot_dim(self) -> int:
+        return self.rotary_dim if self.rotary_dim is not None else self.head_dim
+
+    def __post_init__(self):
+        if self.n_q_heads % self.n_kv_heads != 0:
+            raise ValueError("n_q_heads must be divisible by n_kv_heads")
+        if self.mlp_type == "moe" and self.moe is None:
+            object.__setattr__(self, "moe", MoEConfig())

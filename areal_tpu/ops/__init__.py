@@ -1,0 +1,5 @@
+"""TPU-native compute ops (JAX/XLA, with Pallas fast paths on TPU).
+
+Replaces the reference's ``csrc/`` CUDA kernels and ``realhf/impl/model/modules``
+torch modules with functional JAX equivalents (SURVEY.md §2.1).
+"""

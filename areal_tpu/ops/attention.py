@@ -1,0 +1,153 @@
+"""Packed varlen causal attention.
+
+TPU-native counterpart of the reference's flash-attn varlen path
+(``realhf/impl/model/modules/attn.py:272-289``). Where the reference carries
+``cu_seqlens`` into ``flash_attn_varlen_func`` (CUDA), we pack sequences into
+one token axis and carry integer ``segment_ids`` (0 = padding, real segments
+start at 1). A token attends to a key iff they share a segment id and the key
+does not come later in the packed order. Positions restart per segment, so
+causality within a segment coincides with packed-order causality.
+
+Two implementations behind one entry point:
+- ``_attention_xla``: plain einsum + mask. Reference semantics; used on CPU
+  (tests) and as the autodiff-friendly fallback.
+- Pallas flash attention (``areal_tpu.ops.pallas.flash_attention``) on TPU for
+  long contexts — selected by ``use_flash`` when available.
+
+All shapes static: ``q,k,v`` are ``[T, H, D]`` / ``[T, Hkv, D]`` where T is
+the padded packed-token budget, so one compiled program serves every batch.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -2.3819763e38  # ~ -float32 max; matches common flash-attn masks
+
+
+def _repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[T, Hkv, D] -> [T, Hkv*n_rep, D] (GQA key/value head expansion)."""
+    if n_rep == 1:
+        return k
+    t, hkv, d = k.shape
+    return jnp.broadcast_to(k[:, :, None, :], (t, hkv, n_rep, d)).reshape(
+        t, hkv * n_rep, d
+    )
+
+
+def _attention_xla(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    softmax_scale: float,
+    soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    t, h, d = q.shape
+    n_rep = h // k.shape[1]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scores = jnp.einsum(
+        "qhd,khd->hqk", q, k, preferred_element_type=jnp.float32
+    ) * softmax_scale
+    if soft_cap is not None:
+        scores = soft_cap * jnp.tanh(scores / soft_cap)
+    idx = jnp.arange(t)
+    same_seg = (segment_ids[:, None] == segment_ids[None, :]) & (
+        segment_ids[:, None] > 0
+    )
+    causal = idx[:, None] >= idx[None, :]
+    mask = same_seg & causal
+    if sliding_window is not None:
+        mask &= idx[:, None] - idx[None, :] < sliding_window
+    scores = jnp.where(mask[None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # Fully-masked (padding) rows: softmax over all -inf gives garbage; zero them.
+    probs = jnp.where(mask.any(axis=-1)[None, :, None], probs, 0.0)
+    return jnp.einsum("hqk,khd->qhd", probs.astype(v.dtype), v)
+
+
+def packed_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    *,
+    softmax_scale: Optional[float] = None,
+    soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    use_flash: bool = False,
+    flash_block_size: int = 512,
+) -> jnp.ndarray:
+    """Causal self-attention over a packed token axis.
+
+    Args:
+      q: ``[T, H, D]``; k, v: ``[T, Hkv, D]`` (``H % Hkv == 0``).
+      segment_ids: ``[T]`` int32, 0 marks padding tokens.
+    Returns ``[T, H, D]``.
+    """
+    if softmax_scale is None:
+        softmax_scale = q.shape[-1] ** -0.5
+    if use_flash:
+        from areal_tpu.ops.pallas import flash_attention as _fa
+
+        return _fa.packed_flash_attention(
+            q,
+            k,
+            v,
+            segment_ids,
+            softmax_scale=softmax_scale,
+            soft_cap=soft_cap,
+            sliding_window=sliding_window,
+            block_size=flash_block_size,
+        )
+    return _attention_xla(
+        q, k, v, segment_ids, softmax_scale, soft_cap, sliding_window
+    )
+
+
+def decode_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    cache_lens: jnp.ndarray,
+    *,
+    softmax_scale: Optional[float] = None,
+    soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Single-token decode attention against a per-sequence KV cache.
+
+    Args:
+      q: ``[B, H, D]`` — one new token per sequence.
+      k_cache, v_cache: ``[B, S, Hkv, D]`` — S is the static cache capacity;
+        the new token's K/V must already be written at ``cache_lens - 1``.
+      cache_lens: ``[B]`` int32 — number of valid cache entries per sequence
+        (including the current token).
+    Returns ``[B, H, D]``.
+    """
+    if softmax_scale is None:
+        softmax_scale = q.shape[-1] ** -0.5
+    b, s = k_cache.shape[0], k_cache.shape[1]
+    n_rep = q.shape[1] // k_cache.shape[2]
+    k = k_cache
+    v = v_cache
+    if n_rep > 1:
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
+    scores = jnp.einsum(
+        "bhd,bshd->bhs", q, k, preferred_element_type=jnp.float32
+    ) * softmax_scale
+    if soft_cap is not None:
+        scores = soft_cap * jnp.tanh(scores / soft_cap)
+    pos = jnp.arange(s)[None, :]
+    mask = pos < cache_lens[:, None]
+    if sliding_window is not None:
+        mask &= pos >= cache_lens[:, None] - sliding_window
+    scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(cache_lens[:, None, None] > 0, probs, 0.0)
+    return jnp.einsum("bhs,bshd->bhd", probs.astype(v.dtype), v)

@@ -1,0 +1,66 @@
+"""Mixture-of-experts MLP (top-k router + experts).
+
+TPU-native counterpart of ``realhf/impl/model/modules/moe/`` (router.py,
+experts.py, token_dispatcher.py, layer.py — ~700 LoC). The reference
+permutes tokens per expert and runs grouped GEMMs; here we use the
+dense-dispatch formulation (every expert computed for every token, combined
+with the routing weights). That is the correctness-first XLA path — fine for
+tests and small expert counts; a ``lax.ragged_dot`` (megablox-style) dispatch
+is the later TPU optimization documented in SURVEY.md §2.1.
+
+Router runs in fp32 (matches the reference's fp32 router,
+``moe/router.py``).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.ops.activations import ACT2FN
+
+
+def router_probs(cfg, p, x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (combine_weights [T, X], router_logits [T, X])."""
+    moe = cfg.moe
+    logits = (x.astype(jnp.float32) @ p["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, moe.top_k)
+    if moe.norm_topk_prob:
+        top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    combine = jnp.zeros_like(probs)
+    combine = jnp.put_along_axis(  # scatter top-k weights back to [T, X]
+        combine, top_idx, top_vals, axis=-1, inplace=False
+    )
+    return combine * moe.routed_scaling_factor, logits
+
+
+def load_balancing_aux_loss(cfg, combine: jnp.ndarray, logits: jnp.ndarray):
+    """Switch-style aux loss (≈ ``moe/router.py`` aux loss) in fp32."""
+    moe = cfg.moe
+    probs = jax.nn.softmax(logits, axis=-1)
+    frac_tokens = jnp.mean((combine > 0).astype(jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = moe.num_experts * jnp.sum(frac_tokens * frac_probs)
+    z = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+    return moe.aux_loss_coeff * aux + moe.z_loss_coeff * z
+
+
+def moe_mlp(cfg, p, x):
+    """x: [..., E] -> ([..., E], aux_loss). Dense dispatch over all experts.
+
+    The aux loss includes padding tokens (the layer has no mask); with packed
+    batches the padding fraction is small and its router logits are the
+    uniform x=0 output, so the bias is negligible.
+    """
+    act = ACT2FN[cfg.activation_function]
+    lead = x.shape[:-1]
+    xt = x.reshape(-1, x.shape[-1])
+    combine, logits = router_probs(cfg, p, xt)
+    h = act(jnp.einsum("te,xef->txf", xt, p["w_gate"])) * jnp.einsum(
+        "te,xef->txf", xt, p["w_up"]
+    )
+    y = jnp.einsum("txf,xfe->txe", h, p["w_down"])
+    out = jnp.einsum("txe,tx->te", y, combine.astype(y.dtype))
+    aux = load_balancing_aux_loss(cfg, combine, logits)
+    return out.reshape(*lead, -1), aux

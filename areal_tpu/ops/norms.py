@@ -1,0 +1,37 @@
+"""Normalization layers (functional).
+
+TPU-native counterpart of ``realhf/impl/model/modules/rms.py`` and the
+LayerNorm variants in ``realhf/impl/model/modules/mlp.py``. Plain jnp — XLA
+fuses these into surrounding ops; no Pallas needed.
+"""
+
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-6, *, plus_one: bool = False):
+    """RMSNorm. ``plus_one`` selects the Gemma convention ``(1 + w) * x_hat``.
+
+    Computation runs in float32 regardless of input dtype (matches the
+    reference's fp32 norm accumulation) and casts back at the end.
+    """
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x / jnp.sqrt(var + eps)
+    w = weight.astype(jnp.float32)
+    if plus_one:
+        w = 1.0 + w
+    return (x * w).astype(dtype)
+
+
+def layer_norm(x, weight, bias, eps: float = 1e-5):
+    """Standard LayerNorm (GPT-2 family)."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    x = (x - mean) / jnp.sqrt(var + eps)
+    out = x * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(dtype)

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels for the hot ops.
+
+TPU-native replacement for the reference's CUDA kernels and flash-attn
+dependency (SURVEY.md §2.1): a block-wise flash attention over packed varlen
+batches with segment-id masking (≈ ``flash_attn_varlen_func`` at
+``realhf/impl/model/modules/attn.py:289``).
+"""

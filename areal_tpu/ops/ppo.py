@@ -1,0 +1,331 @@
+"""PPO math over packed sequences.
+
+TPU-native counterpart of ``realhf/impl/model/utils/ppo_functional.py`` (394
+LoC) and the ``csrc/cugae`` CUDA kernel (``csrc/cugae/gae.cu:10``). Semantics
+match the reference exactly (tests compare against a numpy port of
+``pygae1d_nolp_misalign``); the layout is redesigned for XLA:
+
+- The reference packs with ``cu_seqlens`` and a *misaligned* values array
+  (one extra bootstrap slot per sequence). Here every array lives on the same
+  padded packed token axis ``[T]`` with ``segment_ids`` (0 = pad), and the
+  bootstrap is an explicit per-token ``next_values`` array — static shapes,
+  no host-side offsets.
+- GAE is a first-order linear recurrence ``A_t = delta_t + (gamma*lam)*A_{t+1}``
+  solved with ``jax.lax.associative_scan`` (log-depth on the VPU) instead of a
+  one-thread-per-sequence CUDA kernel; segment boundaries reset the carry via
+  the decay coefficient, so one scan covers the whole packed batch.
+
+All loss math runs in float32 (reference asserts fp32 inputs).
+"""
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# --------------------------------------------------------------------------- #
+# KL controllers (host-side Python state, ≈ ppo_functional.py:14-48)
+# --------------------------------------------------------------------------- #
+
+
+class FixedKLController:
+    def __init__(self, kl_coef: float):
+        self.value = kl_coef
+
+    def update(self, current: float, n_steps: int):
+        pass
+
+
+class AdaptiveKLController:
+    """Adaptive KL controller (arXiv:1909.08593)."""
+
+    def __init__(self, init_kl_coef: float, target: float, horizon: float):
+        self.value = init_kl_coef
+        self.target = target
+        self.horizon = horizon
+
+    def update(self, current: float, n_steps: int):
+        proportional_error = float(
+            jnp.clip(current / self.target - 1, -0.2, 0.2)
+        )
+        self.value *= 1 + proportional_error * n_steps / self.horizon
+
+
+# --------------------------------------------------------------------------- #
+# Losses
+# --------------------------------------------------------------------------- #
+
+
+def actor_loss_fn(
+    logprobs: jnp.ndarray,
+    old_logprobs: jnp.ndarray,
+    advantages: jnp.ndarray,
+    eps_clip: float,
+    loss_mask: jnp.ndarray,
+    c_clip: Optional[float] = None,
+    proximal_logprobs: Optional[jnp.ndarray] = None,
+    behav_imp_weight_cap: Optional[float] = None,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Decoupled-PPO actor loss (≈ ``ppo_functional.actor_loss_fn:51``).
+
+    ``proximal_logprobs`` activates the decoupled objective: the clip ratio is
+    taken w.r.t. the proximal (recomputed) policy while the behavioral policy
+    contributes an importance weight ``exp(proximal - behav)``; optionally
+    capped. ``c_clip`` activates dual clipping (arXiv:1912.09729).
+    """
+    logprobs = logprobs.astype(jnp.float32)
+    old_logprobs = old_logprobs.astype(jnp.float32)
+    advantages = advantages.astype(jnp.float32)
+    loss_mask = loss_mask.astype(bool)
+    denorm_logprobs = (
+        proximal_logprobs.astype(jnp.float32)
+        if proximal_logprobs is not None
+        else old_logprobs
+    )
+    n_valid = jnp.maximum(jnp.sum(loss_mask), 1)
+
+    ratio = jnp.where(loss_mask, jnp.exp(logprobs - denorm_logprobs), 0.0)
+    clipped_ratio = jnp.clip(ratio, 1.0 - eps_clip, 1.0 + eps_clip)
+    pg_loss1 = -advantages * ratio
+    pg_loss2 = -advantages * clipped_ratio
+    clip_mask = jax.lax.stop_gradient(pg_loss1 < pg_loss2)
+    pg_loss = jnp.maximum(pg_loss1, pg_loss2)
+    if c_clip is not None:
+        assert c_clip > 1.0, c_clip
+        pg_loss3 = jnp.sign(advantages) * c_clip * advantages
+        dual_clip_mask = jax.lax.stop_gradient(pg_loss3 < pg_loss)
+        pg_loss = jnp.minimum(pg_loss, pg_loss3)
+    else:
+        dual_clip_mask = jnp.zeros_like(clip_mask)
+
+    stat: Dict[str, jnp.ndarray] = {}
+    if proximal_logprobs is not None:
+        behav_kl = proximal_logprobs - old_logprobs
+        behav_imp_weight = jnp.exp(behav_kl)
+        if behav_imp_weight_cap is not None:
+            behav_mask = (behav_imp_weight <= behav_imp_weight_cap) & loss_mask
+        else:
+            behav_mask = loss_mask
+        behav_kl = jnp.where(behav_mask, behav_kl, 0.0)
+        behav_imp_weight = jnp.where(behav_mask, behav_imp_weight, 0.0)
+        pg_loss = pg_loss * jax.lax.stop_gradient(behav_imp_weight)
+        stat.update(
+            behave_imp_weight=behav_imp_weight,
+            behave_approx_kl=behav_kl,
+            behave_mask=behav_mask,
+        )
+
+    logging_loss = jax.lax.stop_gradient(pg_loss)
+    loss = jnp.sum(jnp.where(loss_mask, pg_loss, 0.0)) / n_valid
+    stat.update(
+        loss=logging_loss,
+        importance_weight=jax.lax.stop_gradient(ratio),
+        approx_kl=jax.lax.stop_gradient(logprobs - denorm_logprobs),
+        clip_mask=clip_mask & loss_mask,
+        dual_clip_mask=dual_clip_mask & loss_mask,
+    )
+    return loss, stat
+
+
+def _huber(x, y, delta: float = 10.0):
+    diff = jnp.abs(x - y)
+    return jnp.where(diff < delta, 0.5 * diff**2, delta * (diff - 0.5 * delta))
+
+
+def _mse(x, y):
+    return 0.5 * (x - y) ** 2
+
+
+def critic_loss_fn(
+    value: jnp.ndarray,
+    old_value: jnp.ndarray,
+    target_value: jnp.ndarray,
+    value_eps_clip: float,
+    loss_mask: jnp.ndarray,
+    loss_fn_type: str = "mse",
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Clipped value loss (≈ ``ppo_functional.critic_loss_fn:161``)."""
+    value = value.astype(jnp.float32)
+    old_value = old_value.astype(jnp.float32)
+    target_value = target_value.astype(jnp.float32)
+    loss_mask = loss_mask.astype(bool)
+    loss_fn = {"huber": _huber, "mse": _mse}[loss_fn_type]
+
+    loss_original = loss_fn(value, target_value)
+    value_clipped = old_value + jnp.clip(
+        value - old_value, -value_eps_clip, value_eps_clip
+    )
+    loss_clipped = loss_fn(value_clipped, target_value)
+    value_loss = jnp.maximum(loss_original, loss_clipped)
+    clip_mask = jax.lax.stop_gradient(loss_clipped > loss_original) & loss_mask
+    n_valid = jnp.maximum(jnp.sum(loss_mask), 1)
+    loss = jnp.sum(jnp.where(loss_mask, value_loss, 0.0)) / n_valid
+    return loss, {"clip_mask": clip_mask, "loss": jax.lax.stop_gradient(value_loss)}
+
+
+# --------------------------------------------------------------------------- #
+# Rewards & GAE on the packed segment layout
+# --------------------------------------------------------------------------- #
+
+
+def is_segment_end(segment_ids: jnp.ndarray) -> jnp.ndarray:
+    """True at the last token of each segment (padding rows are False)."""
+    nxt = jnp.concatenate([segment_ids[1:], jnp.zeros((1,), segment_ids.dtype)])
+    return (segment_ids > 0) & (nxt != segment_ids)
+
+
+def get_packed_rewards(
+    kl_ctl: float,
+    clip_reward_value: float,
+    log_probs: jnp.ndarray,       # [T] behavior logprobs at action tokens
+    ref_log_probs: jnp.ndarray,   # [T]
+    reward_score: jnp.ndarray,    # [T]: per-token; the interface scatters the
+                                  # sequence-level score onto segment ends
+    segment_ids: jnp.ndarray,     # [T]
+    seq_no_eos_mask: jnp.ndarray, # [T] broadcast per token (True = truncated)
+    mask_no_eos_with_zero: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """≈ ``ppo_functional.get_packed_rewards:229``: KL penalty everywhere plus
+    the (clipped) task reward on the final action token of each sequence."""
+    mask = segment_ids > 0
+    kl_rewards = jnp.where(mask, -kl_ctl * (log_probs - ref_log_probs), 0.0)
+    score = jnp.clip(reward_score, -clip_reward_value, clip_reward_value)
+    at_end = is_segment_end(segment_ids)
+    if mask_no_eos_with_zero:
+        score = jnp.where(seq_no_eos_mask, 0.0, score)
+    tot_rewards = kl_rewards + jnp.where(at_end, score, 0.0)
+    return kl_rewards, tot_rewards
+
+
+def segment_next_values(
+    values: jnp.ndarray, segment_ids: jnp.ndarray, bootstrap: jnp.ndarray
+) -> jnp.ndarray:
+    """next_values[t] = values[t+1] within a segment; at the segment's last
+    token, ``bootstrap[t]`` (e.g. the value of the EOS/truncation token, or 0)."""
+    shifted = jnp.concatenate([values[1:], jnp.zeros((1,), values.dtype)])
+    return jnp.where(is_segment_end(segment_ids), bootstrap, shifted)
+
+
+def segment_gae(
+    rewards: jnp.ndarray,      # [T] fp32
+    values: jnp.ndarray,       # [T] fp32
+    next_values: jnp.ndarray,  # [T] fp32 (see segment_next_values)
+    segment_ids: jnp.ndarray,  # [T]
+    gamma: float,
+    lam: float,
+    mask: Optional[jnp.ndarray] = None,     # valid action positions
+    not_end: Optional[jnp.ndarray] = None,  # t+1 continues the trajectory
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """GAE over every segment at once via associative scan.
+
+    Replaces ``cugae1d_nolp_misalign`` / ``pygae1d_nolp_misalign``
+    (``csrc/cugae/gae.cu:10``, ``ppo_functional.py:292``): advantages and
+    returns, zero outside ``mask``. By default a trajectory is a whole
+    segment; PPO passes an action ``mask`` (generated tokens only) and a
+    matching ``not_end`` so trajectories span only the action positions.
+    """
+    if mask is None:
+        mask = segment_ids > 0
+    mask = mask.astype(jnp.float32)
+    rewards = rewards.astype(jnp.float32) * mask
+    values = values.astype(jnp.float32)
+    next_values = next_values.astype(jnp.float32)
+    delta = (rewards + gamma * next_values - values) * mask
+    # Recurrence (in reverse token order): A_t = delta_t + c_t * A_{t+1},
+    # where c_t = gamma*lam if t+1 continues the same trajectory else 0.
+    if not_end is None:
+        not_end = ~is_segment_end(segment_ids)
+    c = gamma * lam * not_end.astype(jnp.float32) * mask
+
+    def combine(right, left):
+        # Elements are (a, b) representing x -> a*x + b, composed right-to-left
+        # on the reversed axis.
+        a1, b1 = right
+        a2, b2 = left
+        return a2 * a1, a2 * b1 + b2
+
+    a_rev = jnp.flip(c, axis=0)
+    b_rev = jnp.flip(delta, axis=0)
+    _, adv_rev = jax.lax.associative_scan(combine, (a_rev, b_rev), axis=0)
+    advantages = jnp.flip(adv_rev, axis=0) * mask
+    returns = (advantages + values) * mask
+    return advantages, returns
+
+
+# --------------------------------------------------------------------------- #
+# Packed logprob / normalization helpers (≈ impl/model/utils/functional.py)
+# --------------------------------------------------------------------------- #
+
+
+def gather_logprobs(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Log p(labels[t] | logits[t]) for each packed position, fp32. [T]"""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+
+
+def gather_packed_shifted_log_probs(
+    logits: jnp.ndarray, input_ids: jnp.ndarray, segment_ids: jnp.ndarray
+) -> jnp.ndarray:
+    """Logprob of the *next* token at each position (zero where the next token
+    leaves the segment). ≈ ``gather_packed_shifted_log_probs`` in the
+    reference's ``utils/functional.py`` but with static shapes: the output
+    stays [T]; positions without a successor are 0 and masked downstream."""
+    nxt_ids = jnp.concatenate([input_ids[1:], jnp.zeros((1,), input_ids.dtype)])
+    lp = gather_logprobs(logits, nxt_ids)
+    has_next = (segment_ids > 0) & ~is_segment_end(segment_ids)
+    return jnp.where(has_next, lp, 0.0)
+
+
+def entropy_from_logits(logits: jnp.ndarray) -> jnp.ndarray:
+    """Per-position categorical entropy, fp32. [T]"""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+def masked_normalization(
+    x: jnp.ndarray,
+    mask: jnp.ndarray,
+    eps: float = 1e-5,
+    unbiased: bool = False,
+) -> jnp.ndarray:
+    """Normalize to zero mean / unit std over masked entries (fp32).
+
+    ≈ ``masked_normalization`` in the reference's ``utils/functional.py``;
+    the reference all-reduces across DP — here the caller runs this inside
+    pjit on the global batch, so the mean/std are already global.
+    """
+    x = x.astype(jnp.float32)
+    mask = mask.astype(jnp.float32)
+    n = jnp.maximum(jnp.sum(mask), 1.0)
+    mean = jnp.sum(x * mask) / n
+    var = jnp.sum(jnp.square(x - mean) * mask) / jnp.maximum(
+        n - (1.0 if unbiased else 0.0), 1.0
+    )
+    return jnp.where(mask > 0, (x - mean) / jnp.sqrt(var + eps), x)
+
+
+def group_normalization(
+    x: jnp.ndarray,
+    mask: jnp.ndarray,
+    group_ids: jnp.ndarray,
+    num_groups: int,
+    eps: float = 1e-5,
+    std_norm: bool = True,
+) -> jnp.ndarray:
+    """GRPO-style per-group advantage normalization
+    (≈ ``ppo_interface.py:648-680`` group_adv_norm): subtract the group mean
+    (and optionally divide by group std) where groups share a prompt."""
+    x = x.astype(jnp.float32)
+    m = mask.astype(jnp.float32)
+    gsum = jax.ops.segment_sum(x * m, group_ids, num_segments=num_groups)
+    gcnt = jnp.maximum(
+        jax.ops.segment_sum(m, group_ids, num_segments=num_groups), 1.0
+    )
+    gmean = (gsum / gcnt)[group_ids]
+    out = x - gmean
+    if std_norm:
+        gvar = jax.ops.segment_sum(jnp.square(out) * m, group_ids, num_segments=num_groups)
+        gstd = jnp.sqrt(gvar / gcnt + eps)[group_ids]
+        out = out / gstd
+    return jnp.where(m > 0, out, x)

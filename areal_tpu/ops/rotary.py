@@ -1,0 +1,86 @@
+"""Rotary position embeddings with scaling variants.
+
+TPU-native counterpart of ``realhf/impl/model/modules/rotary.py`` (281 LoC in
+the reference). Functional: frequencies are computed on the fly from positions
+(no cached cos/sin buffers — XLA constant-folds or fuses them), which also
+makes packed varlen batches trivial: each token carries its own position.
+
+Supports the HF ``rope_scaling`` variants used by the reference model
+families: none, "linear", "dynamic" (NTK), and "llama3".
+"""
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class RotaryConfig:
+    dim: int                      # rotary dimension (usually head_dim)
+    base: float = 10000.0
+    scaling_type: Optional[str] = None   # None | "linear" | "dynamic" | "llama3"
+    scaling_factor: float = 1.0
+    # llama3-specific:
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position: int = 8192
+    # dynamic-NTK-specific:
+    max_position: int = 2048
+
+
+def _inv_freq(cfg: RotaryConfig) -> jnp.ndarray:
+    base = cfg.base
+    if cfg.scaling_type == "dynamic":
+        # NTK-aware base rescale, fixed at the configured max length (the
+        # sequence-length-adaptive variant is not jit-friendly; families used
+        # for RL here ship with static rope configs anyway).
+        base = base * cfg.scaling_factor ** (cfg.dim / (cfg.dim - 2))
+    inv = 1.0 / (
+        base ** (jnp.arange(0, cfg.dim, 2, dtype=jnp.float32) / cfg.dim)
+    )
+    if cfg.scaling_type == "linear":
+        inv = inv / cfg.scaling_factor
+    elif cfg.scaling_type == "llama3":
+        # Frequency-dependent interpolation (HF Llama-3.1 convention).
+        low_wl = cfg.original_max_position / cfg.low_freq_factor
+        high_wl = cfg.original_max_position / cfg.high_freq_factor
+        wl = 2 * math.pi / inv
+        smooth = (cfg.original_max_position / wl - cfg.low_freq_factor) / (
+            cfg.high_freq_factor - cfg.low_freq_factor
+        )
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = (1 - smooth) * inv / cfg.scaling_factor + smooth * inv
+        inv = jnp.where(wl > low_wl, inv / cfg.scaling_factor, inv)
+        inv = jnp.where((wl <= low_wl) & (wl >= high_wl), scaled, inv)
+    return inv
+
+
+def rotary_cos_sin(cfg: RotaryConfig, positions: jnp.ndarray, dtype=jnp.float32):
+    """cos/sin tables for given integer positions. Shapes ``[..., dim/2]``."""
+    inv = _inv_freq(cfg)
+    freqs = positions.astype(jnp.float32)[..., None] * inv[None]
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rotary(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray):
+    """Apply rotary embedding.
+
+    ``x``: ``[..., heads, head_dim]``; ``cos/sin``: ``[..., head_dim/2]``
+    (broadcast over the heads axis). Uses the HF "half-split" layout
+    (first half / second half), matching all supported families.
+    """
+    d2 = cos.shape[-1]
+    x1 = x[..., :d2]
+    x2 = x[..., d2 : 2 * d2]
+    c = cos[..., None, :].astype(jnp.float32)
+    s = sin[..., None, :].astype(jnp.float32)
+    x1f = x1.astype(jnp.float32)
+    x2f = x2.astype(jnp.float32)
+    o1 = x1f * c - x2f * s
+    o2 = x2f * c + x1f * s
+    out = jnp.concatenate([o1, o2], axis=-1)
+    if 2 * d2 < x.shape[-1]:  # partial rotary (gpt-neox style)
+        out = jnp.concatenate([out, x[..., 2 * d2 :].astype(jnp.float32)], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,17 @@
+"""Device-mesh parallelism layer.
+
+TPU-native replacement for the reference's ``realhf/base/topology.py``
+(ProcessTopology/ParallelGrid), ``realhf/impl/model/parallelism/`` (manual TP
+modules + PP instruction engine) and ``realhf/impl/model/comm/`` (NCCL group
+bookkeeping) — all ~5k LoC of manual collective plumbing collapse into:
+a ``jax.sharding.Mesh`` + logical-axis rules + pjit (SURVEY.md §2.2).
+"""
+
+from areal_tpu.parallel.mesh import (  # noqa: F401
+    ParallelConfig,
+    batch_pspec,
+    logical_to_pspec,
+    make_mesh,
+    param_shardings,
+    shard_params,
+)

@@ -1,0 +1,122 @@
+"""Mesh construction and logical-axis sharding rules.
+
+The reference assigns each model a 3D ``ProcessTopology`` (dp, pp, tp —
+``realhf/base/topology.py:86,369``) and hand-builds NCCL groups per axis. The
+TPU equivalent is declarative: one ``jax.sharding.Mesh`` with named axes
+
+- ``data``: pure data parallelism (params replicated),
+- ``fsdp``: data parallelism with params sharded along their "embed" logical
+  axis (ZeRO-3 / FSDP — XLA inserts the gathers),
+- ``model``: tensor parallelism (heads/mlp/vocab logical axes; XLA inserts
+  the psums exactly where Megatron's Column/RowParallelLinear pairs do),
+
+plus logical→mesh rules mapping each parameter's logical axes (declared in
+``areal_tpu.models.transformer.param_logical_axes``) to mesh axes. Pipeline
+parallelism is deliberately absent: stages-as-shardings via GSPMD replace the
+reference's instruction-based PP engine (SURVEY.md §2.2 row "PP"). Sequence
+parallelism is an activation-sharding annotation (see ``seq_pspec``), and
+expert parallelism maps the "expert" logical axis onto ``model``.
+"""
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """≈ the reference's ``ParallelismConfig`` (``realhf/api/cli_args.py:127``)
+    re-expressed as mesh axis sizes."""
+
+    data: int = 1
+    fsdp: int = 1
+    model: int = 1
+
+    # Megatron SP equivalent: shard activation token axes over `model` in
+    # norm/elementwise regions. Annotation-level; no effect on correctness.
+    use_sequence_parallel: bool = False
+
+    @property
+    def world_size(self) -> int:
+        return self.data * self.fsdp * self.model
+
+    @classmethod
+    def from_str(cls, s: str) -> "ParallelConfig":
+        """Parse ``"d2f2m2"``-style strings (≈ the reference's ``d4m1p1``
+        allocation-mode tokens, with fsdp replacing pp)."""
+        import re
+
+        m = re.fullmatch(r"d(\d+)(?:f(\d+))?m(\d+)", s)
+        if not m:
+            raise ValueError(f"Bad parallelism spec: {s!r}")
+        return cls(
+            data=int(m.group(1)),
+            fsdp=int(m.group(2) or 1),
+            model=int(m.group(3)),
+        )
+
+
+# logical axis -> mesh axis (None = replicated)
+DEFAULT_RULES: Dict[str, Optional[str]] = {
+    "layer": None,
+    "vocab": "model",
+    "heads": "model",
+    "mlp": "model",
+    "expert": "model",
+    "embed": "fsdp",
+}
+
+
+def make_mesh(
+    cfg: ParallelConfig, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    if cfg.world_size > len(devices):
+        raise ValueError(
+            f"Parallel config needs {cfg.world_size} devices, have {len(devices)}"
+        )
+    devs = np.asarray(devices[: cfg.world_size]).reshape(
+        cfg.data, cfg.fsdp, cfg.model
+    )
+    return Mesh(devs, ("data", "fsdp", "model"))
+
+
+def logical_to_pspec(
+    axes: Optional[Tuple[Optional[str], ...]],
+    rules: Optional[Dict[str, Optional[str]]] = None,
+) -> P:
+    if axes is None:
+        return P()
+    rules = rules or DEFAULT_RULES
+    return P(*(rules.get(a) if a is not None else None for a in axes))
+
+
+def param_shardings(mesh: Mesh, logical_tree, rules=None):
+    """Map a tree of logical-axis tuples to NamedShardings (same structure)."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_pspec(axes, rules)),
+        logical_tree,
+        is_leaf=lambda x: x is None or isinstance(x, tuple),
+    )
+
+
+def shard_params(mesh: Mesh, params, logical_tree, rules=None):
+    shardings = param_shardings(mesh, logical_tree, rules)
+    return jax.device_put(params, shardings)
+
+
+def batch_pspec() -> P:
+    """Packed data buffers are [D, T]: rows spread over both data-parallel
+    mesh axes, the token axis unsharded (attention stays shard-local — the
+    exact analogue of the reference's per-DP-rank packed batches)."""
+    return P(("data", "fsdp"), None)
+
+
+def seq_pspec(use_sp: bool) -> P:
+    """Activation sharding for sequence-parallel regions: [D, T, E] with the
+    token axis over `model` (≈ Megatron SP, ``mappings.py:200-260``)."""
+    return P(("data", "fsdp"), "model" if use_sp else None, None)

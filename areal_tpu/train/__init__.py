@@ -1,0 +1,7 @@
+"""Trainer layer: packed-batch formation + pjit train engine + checkpointing.
+
+Counterpart of the reference's ``PipelinableEngine`` implementations
+(``realhf/impl/model/backend/megatron.py``, ``inference.py``, ``mock_train.py``)
+minus everything XLA renders unnecessary (DDP buckets, ZeRO-1 optimizer
+sharding, pipeline schedules — see SURVEY.md §2.2).
+"""

@@ -1,0 +1,181 @@
+"""Packed-batch formation: SequenceSample ↔ fixed-shape device buffers.
+
+The reference ships variable-length packed 1D tensors (cu_seqlens) straight
+into flash-attn. XLA wants static shapes, so the trainer packs sequences into
+``[n_rows, capacity]`` buffers — one row per data-parallel shard — with
+``segment_ids`` (0 = padding) marking sequence boundaries. Packing is
+length-balanced (LPT greedy, deterministic), the TPU analogue of the
+reference's seqlen-balanced DP dispatch (``realhf/api/core/data_api.py:398``
++ ``realhf/base/datapack.py``).
+
+Per-sequence scalar keys (rewards, eos masks, …) are broadcast across their
+segment's token span so every device array is uniformly ``[n_rows, capacity]``
+— interfaces pick them up at segment ends via ``ppo.is_segment_end``.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from areal_tpu.api.data import SequenceSample
+
+
+@dataclasses.dataclass
+class Placement:
+    """Where one sequence landed: buffer row + token span."""
+
+    item_idx: int      # index of the item in the source SequenceSample
+    seq_idx: int       # index of the sequence within the item (grouped items)
+    row: int
+    start: int
+    length: int
+    segment: int       # segment id within the row (>= 1)
+
+
+@dataclasses.dataclass
+class PackedBatch:
+    arrays: Dict[str, np.ndarray]          # each [n_rows, capacity] (+trailing)
+    placements: List[Placement]
+    n_rows: int
+    capacity: int
+
+    def unpack(self, out: np.ndarray) -> List[np.ndarray]:
+        """Split a token-aligned device output ``[n_rows, capacity, ...]``
+        back into per-sequence arrays, ordered like ``placements``."""
+        return [
+            out[p.row, p.start : p.start + p.length] for p in self.placements
+        ]
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def plan_rows(lengths: Sequence[int], n_rows: int) -> List[int]:
+    """LPT greedy: assign each length (desc order) to the least-loaded row.
+    Returns a row index per input. Deterministic."""
+    order = sorted(range(len(lengths)), key=lambda i: -lengths[i])
+    loads = [0] * n_rows
+    rows = [0] * len(lengths)
+    for i in order:
+        r = min(range(n_rows), key=lambda j: (loads[j], j))
+        rows[i] = r
+        loads[r] += lengths[i]
+    return rows
+
+
+def pack_sequences(
+    sample: SequenceSample,
+    n_rows: int,
+    capacity: Optional[int] = None,
+    pad_multiple: int = 128,
+) -> PackedBatch:
+    """Pack every sequence of the sample's main key into ``[n_rows, capacity]``
+    buffers together with all other keys (token-aligned keys packed in place,
+    scalar keys broadcast across their segment)."""
+    main_key = sample.main_key()
+    # flatten (item, seq) units of the main key
+    units: List[Tuple[int, int, int]] = []  # (item_idx, seq_idx, length)
+    for i, inner in enumerate(sample.seqlens[main_key]):
+        for j, n in enumerate(inner):
+            units.append((i, j, int(n)))
+    lengths = [u[2] for u in units]
+    rows = plan_rows(lengths, n_rows)
+    loads = [0] * n_rows
+    seg_counter = [0] * n_rows
+    placements: List[Placement] = []
+    for (i, j, n), r in zip(units, rows):
+        seg_counter[r] += 1
+        placements.append(Placement(i, j, r, loads[r], n, seg_counter[r]))
+        loads[r] += n
+    max_load = max(loads) if loads else 0
+    if capacity is None:
+        capacity = _round_up(max(max_load, pad_multiple), pad_multiple)
+    if max_load > capacity:
+        raise ValueError(
+            f"Packed row load {max_load} exceeds capacity {capacity}"
+        )
+
+    arrays: Dict[str, np.ndarray] = {
+        "segment_ids": np.zeros((n_rows, capacity), np.int32),
+        "positions": np.zeros((n_rows, capacity), np.int32),
+        "item_ids": np.zeros((n_rows, capacity), np.int32),
+    }
+    for p in placements:
+        sl = (p.row, slice(p.start, p.start + p.length))
+        arrays["segment_ids"][sl] = p.segment
+        arrays["positions"][sl] = np.arange(p.length)
+        arrays["item_ids"][sl] = p.item_idx
+
+    main_offsets = sample._offsets(main_key)
+    main_inner = sample.seqlens[main_key]
+
+    for key in sorted(sample.keys):
+        data = sample.data.get(key) if sample.data else None
+        if data is None:
+            continue
+        inner = sample.seqlens[key]
+        offsets = sample._offsets(key)
+        trailing = data.shape[1:]
+        buf = np.zeros((n_rows, capacity) + trailing, data.dtype)
+        for p in placements:
+            item_lens = inner[p.item_idx]
+            item_off = offsets[p.item_idx]
+            sl = (p.row, slice(p.start, p.start + p.length))
+            if len(item_lens) == len(main_inner[p.item_idx]) and item_lens[
+                p.seq_idx
+            ] == p.length:
+                # token-aligned: same layout as the main key
+                off = item_off + sum(item_lens[: p.seq_idx])
+                buf[sl] = data[off : off + p.length]
+            elif all(l == 1 for l in item_lens) and len(item_lens) == len(
+                main_inner[p.item_idx]
+            ):
+                # one scalar per sequence: broadcast over the segment
+                buf[sl] = data[item_off + p.seq_idx]
+            elif item_lens == [1]:
+                # one scalar per item: broadcast over every seq of the item
+                buf[sl] = data[item_off]
+            else:
+                raise ValueError(
+                    f"Key {key!r}: cannot align seqlens {item_lens} with main "
+                    f"key {main_inner[p.item_idx]}"
+                )
+        name = "input_ids" if key == main_key else key
+        arrays[name] = buf
+    return PackedBatch(
+        arrays=arrays, placements=placements, n_rows=n_rows, capacity=capacity
+    )
+
+
+def count_action_tokens(pb: PackedBatch) -> float:
+    """Host-side count of loss-bearing positions: tokens with a same-segment
+    successor whose label is not a prompt token. Mirrors the mask used by the
+    SFT/PPO losses so micro-batch grad weighting equals a global token-mean."""
+    seg = pb.arrays["segment_ids"]
+    nxt = np.concatenate([seg[:, 1:], np.zeros_like(seg[:, :1])], axis=1)
+    has_next = (seg > 0) & (nxt == seg)
+    if "prompt_mask" in pb.arrays:
+        pm = pb.arrays["prompt_mask"].astype(bool)
+        label_is_prompt = np.concatenate(
+            [pm[:, 1:], np.zeros_like(pm[:, :1])], axis=1
+        )
+        has_next &= ~label_is_prompt
+    return float(has_next.sum())
+
+
+def split_into_micro_batches(
+    sample: SequenceSample, n_mbs: int, max_tokens_per_mb: Optional[int], n_rows: int
+) -> List[SequenceSample]:
+    """Seqlen-balanced micro-batch split (≈ reference ``data_api.split``):
+    at least ``n_mbs`` parts, further split so no part exceeds
+    ``max_tokens_per_mb * n_rows`` total tokens."""
+    if max_tokens_per_mb is not None:
+        total = sum(
+            sum(inner) for inner in sample.seqlens[sample.main_key()]
+        )
+        budget = max_tokens_per_mb * n_rows
+        n_mbs = max(n_mbs, -(-total // budget))
+    n_mbs = min(n_mbs, sample.bs)
+    return sample.split(n_mbs)

@@ -1,0 +1,399 @@
+"""The pjit train/inference engine.
+
+TPU-native counterpart of the reference's ``PipelinableEngine`` contract
+(``realhf/api/core/model_api.py:514``: train_batch / eval_batch / forward)
+and its Megatron backend (``realhf/impl/model/backend/megatron.py``). What
+the reference assembles from DDP grad buckets + ZeRO-1 DistributedOptimizer +
+1F1B pipeline schedules, XLA gives as: one jitted step over a mesh with
+sharded params (fsdp axis) and sharded batch rows (data axes); optax handles
+the optimizer; grad accumulation is a host loop over micro-batches with a
+jitted accumulate step (shapes are bucketed by the packer, so each bucket
+compiles once).
+
+Losses/outputs are supplied by interfaces as pure functions
+``(params, cfg, arrays) -> (loss, stats)`` — the analogue of the reference's
+``loss_fn`` argument to ``train_batch``.
+"""
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.models.config import ModelConfig
+from areal_tpu.models import transformer as tfm
+from areal_tpu.parallel.mesh import (
+    ParallelConfig,
+    batch_pspec,
+    make_mesh,
+    param_shardings,
+)
+from areal_tpu.train import batching
+
+LossFn = Callable[[Any, ModelConfig, Dict[str, jnp.ndarray]], Tuple[jnp.ndarray, Dict]]
+OutputFn = Callable[[Any, ModelConfig, Dict[str, jnp.ndarray]], jnp.ndarray]
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    """≈ the reference's ``OptimizerConfig`` (``realhf/api/cli_args.py:173``)."""
+
+    type: str = "adam"
+    lr: float = 2e-5
+    weight_decay: float = 0.05
+    beta1: float = 0.9
+    beta2: float = 0.95
+    eps: float = 1e-5
+    gradient_clipping: float = 1.0
+    lr_scheduler_type: str = "constant"   # constant | linear | cosine
+    warmup_steps_proportion: float = 0.001
+    min_lr_ratio: float = 0.0
+
+
+def vmapped_forward(
+    params, cfg: ModelConfig, arrays: Dict[str, jnp.ndarray], with_aux: bool = False
+):
+    """Model forward over ``[D, T]`` packed buffers -> ``[D, T, vocab|1]``.
+    With ``with_aux``, returns ``(out, aux)`` where aux is the mean MoE
+    router loss across rows (0 for dense models)."""
+    out = jax.vmap(
+        lambda ids, seg, pos: tfm.forward_packed(
+            params, cfg, ids, seg, pos, with_aux=with_aux
+        )
+    )(arrays["input_ids"], arrays["segment_ids"], arrays["positions"])
+    if with_aux:
+        logits, aux = out
+        return logits, jnp.mean(aux)
+    return out
+
+
+class TrainEngine:
+    """Owns mesh + sharded params (+ optional optimizer state) for one model."""
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        parallel: ParallelConfig = ParallelConfig(),
+        optimizer: Optional[OptimizerConfig] = None,
+        mesh=None,
+    ):
+        self.cfg = model_cfg
+        self.parallel = parallel
+        self.mesh = mesh if mesh is not None else make_mesh(parallel)
+        self.optimizer_cfg = optimizer
+        self.params = None
+        self.opt_state = None
+        self.tx = None
+        self.hf_family = None
+        self._step = 0
+        self.version = 0
+        self._jit_cache: Dict[Any, Callable] = {}
+        self._param_shardings = param_shardings(
+            self.mesh, tfm.param_logical_axes(model_cfg)
+        )
+        self._batch_sharding = NamedSharding(self.mesh, batch_pspec())
+
+    # ------------------------------------------------------------------ #
+    # Initialization
+    # ------------------------------------------------------------------ #
+
+    @property
+    def n_rows(self) -> int:
+        return self.parallel.data * self.parallel.fsdp
+
+    def init_random(self, seed: int = 0):
+        init = jax.jit(
+            functools.partial(tfm.init_params, self.cfg),
+            out_shardings=self._param_shardings,
+        )
+        self.params = init(jax.random.key(seed))
+        return self
+
+    def load_hf(self, path: str):
+        import json
+        import os
+
+        from areal_tpu.models import hf as hf_conv
+
+        cfg, host_params = hf_conv.load_hf_checkpoint(path)
+        with open(os.path.join(path, "config.json")) as f:
+            model_type = json.load(f)["model_type"]
+        self.hf_family = hf_conv.family_for_model_type(model_type).name
+        return self.load_params(host_params)
+
+    def load_params(self, host_params):
+        host_params = jax.tree.map(
+            lambda x: np.asarray(x, np.float32), host_params
+        )
+        self.params = jax.device_put(host_params, self._param_shardings)
+        return self
+
+    def save_hf(self, path: str, family: str):
+        from areal_tpu.models import hf as hf_conv
+
+        hf_conv.save_hf_checkpoint(self.params, self.cfg, family, path)
+
+    # ------------------------------------------------------------------ #
+    # Optimizer
+    # ------------------------------------------------------------------ #
+
+    def setup_optimizer(self, total_train_steps: int):
+        assert self.optimizer_cfg is not None
+        oc = self.optimizer_cfg
+        warmup = max(1, int(oc.warmup_steps_proportion * total_train_steps))
+        end = oc.lr * oc.min_lr_ratio
+        if oc.lr_scheduler_type == "cosine":
+            sched = optax.schedules.warmup_cosine_decay_schedule(
+                0.0, oc.lr, warmup, max(total_train_steps, warmup + 1), end
+            )
+        elif oc.lr_scheduler_type == "linear":
+            sched = optax.schedules.join_schedules(
+                [
+                    optax.schedules.linear_schedule(0.0, oc.lr, warmup),
+                    optax.schedules.linear_schedule(
+                        oc.lr, end, max(total_train_steps - warmup, 1)
+                    ),
+                ],
+                [warmup],
+            )
+        else:
+            sched = optax.schedules.join_schedules(
+                [optax.schedules.linear_schedule(0.0, oc.lr, warmup), lambda _: oc.lr],
+                [warmup],
+            )
+        self._lr_sched = sched
+
+        def decay_mask(params):
+            return jax.tree.map(lambda x: x.ndim >= 2, params)
+
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(oc.gradient_clipping),
+            optax.adamw(
+                learning_rate=sched,
+                b1=oc.beta1,
+                b2=oc.beta2,
+                eps=oc.eps,
+                weight_decay=oc.weight_decay,
+                mask=decay_mask,
+            ),
+        )
+        self.opt_state = jax.jit(self.tx.init)(self.params)
+        return self
+
+    # ------------------------------------------------------------------ #
+    # Jitted step builders (cached per loss/output fn)
+    # ------------------------------------------------------------------ #
+
+    def _get_jitted(self, kind: str, fn) -> Callable:
+        # The cache holds a strong reference to fn so CPython cannot recycle
+        # its id for a different function while the entry lives. Interfaces
+        # must pass *stable* callables (built once per interface), otherwise
+        # every call re-traces.
+        key = (kind, id(fn))
+        if key in self._jit_cache:
+            return self._jit_cache[key][1]
+        cfg = self.cfg
+
+        if kind == "grad_acc":
+
+            def grad_acc(params, acc, arrays, weight):
+                def lf(p):
+                    loss, stats = fn(p, cfg, arrays)
+                    return loss * weight, stats
+
+                (loss, stats), grads = jax.value_and_grad(lf, has_aux=True)(params)
+                acc = jax.tree.map(jnp.add, acc, grads)
+                return acc, loss, stats
+
+            jitted = jax.jit(grad_acc, donate_argnums=(1,))
+        elif kind == "apply":
+
+            def apply(params, opt_state, grads):
+                gnorm = optax.global_norm(grads)
+                updates, opt_state = self.tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, gnorm
+
+            jitted = jax.jit(apply, donate_argnums=(0, 1, 2))
+        elif kind == "forward":
+
+            def fwd(params, arrays):
+                return fn(params, cfg, arrays)
+
+            jitted = jax.jit(fwd)
+        elif kind == "eval":
+
+            def ev(params, arrays):
+                return fn(params, cfg, arrays)
+
+            jitted = jax.jit(ev)
+        else:
+            raise ValueError(kind)
+        self._jit_cache[key] = (fn, jitted)
+        return jitted
+
+    def _zeros_like_params(self):
+        if "zeros" not in self._jit_cache:
+            self._jit_cache["zeros"] = (
+                None,
+                jax.jit(
+                    lambda p: jax.tree.map(
+                        lambda x: jnp.zeros(x.shape, jnp.float32), p
+                    ),
+                    out_shardings=self._param_shardings,
+                ),
+            )
+        return self._jit_cache["zeros"][1](self.params)
+
+    def _put_batch(self, packed: batching.PackedBatch) -> Dict[str, jnp.ndarray]:
+        return {
+            k: jax.device_put(v, self._batch_sharding)
+            for k, v in packed.arrays.items()
+        }
+
+    def _make_micro_batches(
+        self, sample: SequenceSample, mb_spec: MicroBatchSpec, capacity=None
+    ):
+        mbs = batching.split_into_micro_batches(
+            sample, mb_spec.n_mbs, mb_spec.max_tokens_per_mb, self.n_rows
+        )
+        cap = capacity or mb_spec.max_tokens_per_mb
+        return mbs, [
+            batching.pack_sequences(mb, self.n_rows, capacity=cap) for mb in mbs
+        ]
+
+    # ------------------------------------------------------------------ #
+    # PipelinableEngine API (≈ model_api.py:514)
+    # ------------------------------------------------------------------ #
+
+    def train_batch(
+        self,
+        sample: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        loss_fn: LossFn,
+        loss_weight_fn: Callable[[batching.PackedBatch], float] = None,
+        version_steps: Optional[int] = None,
+    ) -> Dict[str, float]:
+        """One optimizer step over the sample, accumulating grads across
+        micro-batches. Micro-batch grads are weighted by ``loss_weight_fn``
+        (default: valid-token count) and normalized by the total weight —
+        i.e. a global token-mean loss, like the reference."""
+        assert self.tx is not None, "call setup_optimizer() first"
+        if loss_weight_fn is None:
+            loss_weight_fn = batching.count_action_tokens
+        _, packed = self._make_micro_batches(sample, mb_spec)
+        weights = [loss_weight_fn(pb) for pb in packed]
+        total_w = sum(weights) or 1.0
+
+        grad_acc = self._get_jitted("grad_acc", loss_fn)
+        apply = self._get_jitted("apply", loss_fn)
+        acc = self._zeros_like_params()
+        losses = []
+        all_stats: List[Dict] = []
+        for pb, w in zip(packed, weights):
+            arrays = self._put_batch(pb)
+            acc, loss, stats = grad_acc(
+                self.params, acc, arrays, jnp.float32(w / total_w)
+            )
+            losses.append(loss)
+            all_stats.append(stats)
+        self.params, self.opt_state, gnorm = apply(
+            self.params, self.opt_state, acc
+        )
+        lr = float(self._lr_sched(self._step))
+        self._step += 1
+        out = {
+            "loss": float(jnp.sum(jnp.stack(losses))),
+            "grad_norm": float(gnorm),
+            "lr": lr,
+            "n_mbs": len(packed),
+        }
+        # merge scalar stats from micro-batches (means weighted by mb weight)
+        for k in all_stats[0]:
+            vals = [s[k] for s in all_stats]
+            if all(np.ndim(v) == 0 for v in vals):
+                out[k] = float(
+                    sum(float(v) * w for v, w in zip(vals, weights)) / total_w
+                )
+        return out
+
+    def eval_batch(
+        self, sample: SequenceSample, mb_spec: MicroBatchSpec, loss_fn: LossFn
+    ) -> Dict[str, float]:
+        _, packed = self._make_micro_batches(sample, mb_spec)
+        ev = self._get_jitted("eval", loss_fn)
+        tot, n = 0.0, 0
+        for pb in packed:
+            loss, _ = ev(self.params, self._put_batch(pb))
+            w = float((pb.arrays["segment_ids"] > 0).sum())
+            tot += float(loss) * w
+            n += w
+        return {"loss": tot / max(n, 1)}
+
+    def forward(
+        self,
+        sample: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        output_fn: OutputFn,
+    ) -> List[np.ndarray]:
+        """Token-aligned inference (logprob recompute, critic values, …).
+        ``output_fn`` runs fully inside jit (e.g. forward + logprob gather so
+        the [T, vocab] logits never leave the device). Returns one array per
+        sequence, in the sample's original (item, seq) order — the micro-batch
+        split reorders items, so results are matched back via item ids."""
+        mbs, packed = self._make_micro_batches(sample, mb_spec)
+        fwd = self._get_jitted("forward", output_fn)
+        by_key: Dict[Any, np.ndarray] = {}
+        for mb, pb in zip(mbs, packed):
+            out = np.asarray(fwd(self.params, self._put_batch(pb)))
+            for p, arr in zip(pb.placements, pb.unpack(out)):
+                by_key[(mb.ids[p.item_idx], p.seq_idx)] = arr
+        outs: List[np.ndarray] = []
+        main = sample.main_key()
+        for i, item_id in enumerate(sample.ids):
+            for j in range(len(sample.seqlens[main][i])):
+                outs.append(by_key[(item_id, j)])
+        return outs
+
+    # ------------------------------------------------------------------ #
+    # Checkpointing (orbax)
+    # ------------------------------------------------------------------ #
+
+    def save_checkpoint(self, path: str, with_optim: bool = True):
+        import os
+        import shutil
+
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(path)
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        state = {"params": self.params, "step": self._step, "version": self.version}
+        if with_optim and self.opt_state is not None:
+            state["opt_state"] = self.opt_state
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path, state)
+
+    def load_checkpoint(self, path: str, with_optim: bool = True):
+        import os
+
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(path)
+        state = {"params": self.params, "step": 0, "version": 0}
+        if with_optim and self.opt_state is not None:
+            state["opt_state"] = self.opt_state
+        with ocp.StandardCheckpointer() as ckptr:
+            restored = ckptr.restore(path, state)
+        self.params = restored["params"]
+        self._step = int(restored["step"])
+        self.version = int(restored["version"])
+        if with_optim and self.opt_state is not None:
+            self.opt_state = restored["opt_state"]
+        return self

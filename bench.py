@@ -1,0 +1,94 @@
+"""Single-chip training-throughput benchmark.
+
+Run by the driver on real TPU hardware each round. Measures SFT train-step
+token throughput on a small qwen2-profile model (packed varlen batches,
+bf16 compute) and prints ONE JSON line.
+
+``vs_baseline``: the reference publishes no absolute single-chip tokens/s
+(BASELINE.md — only relative async speedups on H800 clusters), so we compare
+against an analytic roofline: achieved model FLOP/s over the chip's peak
+(v5e ≈ 197 TFLOP/s bf16), i.e. MFU. vs_baseline is reported as achieved-MFU /
+0.4 (0.4 MFU being a strong packed-training baseline on this class of model).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model import make_interface
+    from areal_tpu.models.config import ModelConfig
+    from areal_tpu.parallel.mesh import ParallelConfig
+    from areal_tpu.train.engine import OptimizerConfig, TrainEngine
+
+    # ~125M-param qwen2-profile model; fits one v5e chip with Adam fp32 states
+    cfg = ModelConfig(
+        n_layers=12, n_q_heads=12, n_kv_heads=4, head_dim=64, hidden_dim=768,
+        intermediate_dim=2048, vocab_size=32768, use_attention_bias=True,
+        dtype="bfloat16",
+    )
+    par = ParallelConfig(data=1, fsdp=1, model=1)
+    eng = TrainEngine(cfg, par, OptimizerConfig(lr=1e-4))
+    eng.init_random(0)
+    eng.setup_optimizer(1000)
+
+    T = 4096          # packed tokens per micro-batch row
+    N_STEPS = 8
+    rng = np.random.default_rng(0)
+    lens = [512] * (T // 512)
+
+    def make_sample():
+        return SequenceSample.from_default(
+            ids=list(range(len(lens))),
+            seqlens=lens,
+            data={
+                "packed_input_ids": rng.integers(
+                    0, cfg.vocab_size, sum(lens)
+                ).astype(np.int64),
+                "prompt_mask": np.zeros(sum(lens), bool),
+            },
+        )
+
+    sft = make_interface("sft")
+    spec = MicroBatchSpec(n_mbs=1, max_tokens_per_mb=T)
+    sft.train_step(eng, make_sample(), spec)  # compile
+    jax.block_until_ready(eng.params)
+    t0 = time.perf_counter()
+    for _ in range(N_STEPS):
+        sft.train_step(eng, make_sample(), spec)
+    jax.block_until_ready(eng.params)
+    dt = time.perf_counter() - t0
+
+    tokens = N_STEPS * T
+    tok_per_s = tokens / dt
+    n_params = sum(x.size for x in jax.tree.leaves(eng.params))
+    flop_per_token = 6 * n_params  # fwd+bwd dense transformer approximation
+    achieved = tok_per_s * flop_per_token
+    peak = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))  # v5e bf16
+    mfu = achieved / peak
+    print(
+        json.dumps(
+            {
+                "metric": "sft_train_tokens_per_sec_single_chip",
+                "value": round(tok_per_s, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(mfu / 0.4, 4),
+                "detail": {
+                    "n_params": int(n_params),
+                    "mfu": round(mfu, 4),
+                    "step_time_s": round(dt / N_STEPS, 4),
+                    "device": str(jax.devices()[0].platform),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

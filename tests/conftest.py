@@ -8,7 +8,7 @@ platform replaces the reference's 8-process gloo trick (SURVEY.md §4).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # override axon/tpu: tests always run CPU
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,6 +17,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ.setdefault("AREAL_FILEROOT", "/tmp/areal_tpu_test")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# The axon sitecustomize force-registers the TPU plugin and overrides
+# JAX_PLATFORMS; the config update wins over both.
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

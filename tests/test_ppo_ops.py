@@ -1,0 +1,203 @@
+"""PPO math tests vs straightforward numpy references.
+
+Counterpart of the reference's ``tests/cpp_extensions/test_cugae.py`` (CUDA
+GAE vs python loop) and ``tests/data/test_dual_clip.py``.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from areal_tpu.ops import ppo
+
+
+def _pack_segments(lens):
+    T = sum(lens)
+    seg = np.zeros(T, np.int32)
+    off = 0
+    for i, n in enumerate(lens):
+        seg[off : off + n] = i + 1
+        off += n
+    return seg
+
+
+def _numpy_gae(rewards, values, next_values, lens, gamma, lam):
+    """Per-sequence reverse loop (the reference's pygae semantics with an
+    aligned layout)."""
+    adv = np.zeros_like(rewards)
+    off = 0
+    for n in lens:
+        lastgaelam = 0.0
+        for t in reversed(range(n)):
+            i = off + t
+            nv = next_values[i] if t == n - 1 else values[i + 1]
+            delta = rewards[i] + gamma * nv - values[i]
+            lastgaelam = delta + gamma * lam * (lastgaelam if t < n - 1 else 0.0)
+            adv[i] = lastgaelam
+        off += n
+    return adv, adv + values
+
+
+def test_segment_gae_matches_numpy(rng):
+    lens = [5, 1, 9, 3]
+    T = sum(lens) + 4  # trailing padding
+    seg = np.zeros(T, np.int32)
+    seg[: sum(lens)] = _pack_segments(lens)
+    rewards = rng.normal(size=T).astype(np.float32)
+    values = rng.normal(size=T).astype(np.float32)
+    bootstrap = rng.normal(size=T).astype(np.float32)  # truncation bootstrap
+    next_values = np.asarray(
+        ppo.segment_next_values(jnp.asarray(values), jnp.asarray(seg), jnp.asarray(bootstrap))
+    )
+    adv, ret = ppo.segment_gae(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(next_values),
+        jnp.asarray(seg), gamma=0.99, lam=0.95,
+    )
+    ref_adv, ref_ret = _numpy_gae(
+        rewards[: sum(lens)], values[: sum(lens)], next_values[: sum(lens)],
+        lens, 0.99, 0.95,
+    )
+    np.testing.assert_allclose(np.asarray(adv)[: sum(lens)], ref_adv, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ret)[: sum(lens)], ref_ret, atol=1e-5)
+    assert np.all(np.asarray(adv)[sum(lens):] == 0)
+    assert np.all(np.asarray(ret)[sum(lens):] == 0)
+
+
+def test_actor_loss_clip_and_dual_clip(rng):
+    T = 64
+    lp = rng.normal(size=T).astype(np.float32) * 0.1
+    old = rng.normal(size=T).astype(np.float32) * 0.1
+    adv = rng.normal(size=T).astype(np.float32)
+    mask = rng.random(T) > 0.2
+
+    loss, stat = ppo.actor_loss_fn(
+        jnp.asarray(lp), jnp.asarray(old), jnp.asarray(adv), 0.2, jnp.asarray(mask)
+    )
+    # numpy reference
+    ratio = np.where(mask, np.exp(lp - old), 0.0)
+    clipped = np.clip(ratio, 0.8, 1.2)
+    pg = np.maximum(-adv * ratio, -adv * clipped)
+    ref = np.where(mask, pg, 0).sum() / mask.sum()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+    # dual clip lower-bounds the loss for negative advantages with huge ratios
+    lp2 = old + 3.0  # ratio e^3
+    loss_noc, _ = ppo.actor_loss_fn(
+        jnp.asarray(lp2), jnp.asarray(old), jnp.asarray(adv), 0.2, jnp.asarray(mask)
+    )
+    loss_c, stat_c = ppo.actor_loss_fn(
+        jnp.asarray(lp2), jnp.asarray(old), jnp.asarray(adv), 0.2,
+        jnp.asarray(mask), c_clip=3.0,
+    )
+    assert float(loss_c) <= float(loss_noc)
+    assert bool(np.asarray(stat_c["dual_clip_mask"]).any())
+
+
+def test_actor_loss_decoupled(rng):
+    T = 32
+    old = rng.normal(size=T).astype(np.float32) * 0.1      # behavior policy
+    prox = old + rng.normal(size=T).astype(np.float32) * 0.05  # proximal
+    lp = prox + rng.normal(size=T).astype(np.float32) * 0.05
+    adv = rng.normal(size=T).astype(np.float32)
+    mask = np.ones(T, bool)
+    loss, stat = ppo.actor_loss_fn(
+        jnp.asarray(lp), jnp.asarray(old), jnp.asarray(adv), 0.2,
+        jnp.asarray(mask), proximal_logprobs=jnp.asarray(prox),
+    )
+    ratio = np.exp(lp - prox)
+    clipped = np.clip(ratio, 0.8, 1.2)
+    pg = np.maximum(-adv * ratio, -adv * clipped)
+    behav_w = np.exp(prox - old)
+    ref = (pg * behav_w).sum() / T
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+    # capping excludes tokens with large behavior drift
+    loss_cap, stat_cap = ppo.actor_loss_fn(
+        jnp.asarray(lp), jnp.asarray(old), jnp.asarray(adv), 0.2,
+        jnp.asarray(mask), proximal_logprobs=jnp.asarray(prox),
+        behav_imp_weight_cap=1.01,
+    )
+    assert np.asarray(stat_cap["behave_mask"]).sum() < T
+
+
+def test_critic_loss(rng):
+    T = 16
+    v = rng.normal(size=T).astype(np.float32)
+    old = v + rng.normal(size=T).astype(np.float32) * 0.01
+    tgt = rng.normal(size=T).astype(np.float32)
+    mask = np.ones(T, bool)
+    loss, stat = ppo.critic_loss_fn(
+        jnp.asarray(v), jnp.asarray(old), jnp.asarray(tgt), 0.2, jnp.asarray(mask)
+    )
+    clipped = old + np.clip(v - old, -0.2, 0.2)
+    ref = np.maximum(0.5 * (v - tgt) ** 2, 0.5 * (clipped - tgt) ** 2).mean()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_get_packed_rewards():
+    seg = jnp.asarray(_pack_segments([3, 2]))
+    lp = jnp.asarray(np.array([0.1, 0.2, 0.3, 0.4, 0.5], np.float32))
+    ref_lp = jnp.zeros(5, jnp.float32)
+    score = jnp.asarray(np.array([0, 0, 7.0, 0, -30.0], np.float32))
+    no_eos = jnp.asarray(np.array([False] * 3 + [True] * 2))
+    kl_r, tot = ppo.get_packed_rewards(
+        kl_ctl=0.1, clip_reward_value=5.0, log_probs=lp, ref_log_probs=ref_lp,
+        reward_score=score, segment_ids=seg, seq_no_eos_mask=no_eos,
+    )
+    np.testing.assert_allclose(np.asarray(kl_r), -0.1 * np.asarray(lp), atol=1e-6)
+    # reward clipped to ±5, added at positions 2 and 4
+    np.testing.assert_allclose(float(tot[2] - kl_r[2]), 5.0, atol=1e-6)
+    np.testing.assert_allclose(float(tot[4] - kl_r[4]), -5.0, atol=1e-6)
+    # masking truncated sequences zeroes their end reward
+    _, tot2 = ppo.get_packed_rewards(
+        kl_ctl=0.1, clip_reward_value=5.0, log_probs=lp, ref_log_probs=ref_lp,
+        reward_score=score, segment_ids=seg, seq_no_eos_mask=no_eos,
+        mask_no_eos_with_zero=True,
+    )
+    np.testing.assert_allclose(float(tot2[4] - kl_r[4]), 0.0, atol=1e-6)
+
+
+def test_gather_packed_shifted_log_probs(rng):
+    T, V = 8, 11
+    logits = rng.normal(size=(T, V)).astype(np.float32)
+    ids = rng.integers(0, V, size=T).astype(np.int32)
+    seg = np.array([1, 1, 1, 2, 2, 0, 0, 0], np.int32)
+    out = np.asarray(
+        ppo.gather_packed_shifted_log_probs(
+            jnp.asarray(logits), jnp.asarray(ids), jnp.asarray(seg)
+        )
+    )
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    for t in [0, 1, 3]:
+        np.testing.assert_allclose(out[t], logp[t, ids[t + 1]], rtol=1e-5)
+    assert out[2] == 0 and out[4] == 0 and np.all(out[5:] == 0)
+
+
+def test_masked_normalization(rng):
+    x = rng.normal(size=100).astype(np.float32) * 5 + 3
+    mask = rng.random(100) > 0.3
+    out = np.asarray(ppo.masked_normalization(jnp.asarray(x), jnp.asarray(mask)))
+    sel = out[mask]
+    assert abs(sel.mean()) < 1e-4
+    assert abs(sel.std() - 1.0) < 1e-2
+    np.testing.assert_array_equal(out[~mask], x[~mask])
+
+
+def test_group_normalization(rng):
+    x = rng.normal(size=12).astype(np.float32)
+    gid = np.repeat(np.arange(3), 4)
+    mask = np.ones(12, bool)
+    out = np.asarray(
+        ppo.group_normalization(
+            jnp.asarray(x), jnp.asarray(mask), jnp.asarray(gid), num_groups=3
+        )
+    )
+    for g in range(3):
+        assert abs(out[gid == g].mean()) < 1e-4
+
+
+def test_adaptive_kl_controller():
+    ctl = ppo.AdaptiveKLController(0.1, target=1.0, horizon=100)
+    ctl.update(current=2.0, n_steps=10)
+    assert ctl.value > 0.1  # KL above target -> coef grows
+    ctl2 = ppo.AdaptiveKLController(0.1, target=1.0, horizon=100)
+    ctl2.update(current=0.1, n_steps=10)
+    assert ctl2.value < 0.1
