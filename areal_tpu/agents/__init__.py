@@ -1,0 +1,8 @@
+"""Agents (≈ ``realhf/impl/agent/``)."""
+
+from areal_tpu.api.agent import register_agent
+from areal_tpu.agents.math_single_step import MathSingleStepAgent
+from areal_tpu.agents.math_multi_turn import MathMultiTurnAgent
+
+register_agent("math-single-step", MathSingleStepAgent)
+register_agent("math-multi-turn", MathMultiTurnAgent)

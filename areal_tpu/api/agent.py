@@ -1,0 +1,60 @@
+"""Agent API: trajectory collection contract + registry.
+
+Counterpart of ``realhf/api/core/agent_api.py:15-33``. An agent converses
+with the generation fleet through two asyncio queues: it puts observations
+``(qid, prompt_ids, gen_hyperparams)`` on ``obs_queue`` and awaits
+``BundledGenerationOutputs`` on ``act_queue`` (the PartialRolloutManager sits
+on the other side of both).
+"""
+
+import abc
+import asyncio
+import dataclasses
+from typing import Dict, List
+
+from areal_tpu.api.data import SequenceSample
+
+
+@dataclasses.dataclass
+class BundledGenerationOutputs:
+    """≈ ``model_api.BundledGenerationOutputs:180``: the grouped result of
+    one prompt's n samples, with per-sample version tags for staleness
+    accounting."""
+
+    qid: str
+    prompt_ids: List[int]
+    output_ids: List[List[int]]        # n samples, generated tokens only
+    logprobs: List[List[float]]        # aligned with output_ids
+    no_eos: List[bool]                 # True = truncated by length
+    version_start: List[int]           # weight version of first chunk
+    version_end: List[int]             # weight version of last chunk
+
+    @property
+    def seqs(self) -> List[List[int]]:
+        return [self.prompt_ids + o for o in self.output_ids]
+
+
+class Agent(abc.ABC):
+    @abc.abstractmethod
+    async def collect_trajectory(
+        self,
+        prompt: SequenceSample,
+        env,
+        obs_queue: asyncio.Queue,
+        act_queue: asyncio.Queue,
+    ) -> List[SequenceSample]:
+        ...
+
+
+ALL_AGENTS: Dict[str, type] = {}
+
+
+def register_agent(name: str, cls: type):
+    assert name not in ALL_AGENTS, name
+    ALL_AGENTS[name] = cls
+
+
+def make_agent(name: str, **kwargs) -> Agent:
+    import areal_tpu.agents  # noqa: F401  (triggers registration)
+
+    return ALL_AGENTS[name](**kwargs)

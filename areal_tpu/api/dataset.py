@@ -1,0 +1,53 @@
+"""Dataset API: registry + utilities.
+
+Counterpart of the dataset half of ``realhf/api/core/data_api.py``
+(``DatasetUtility:730``, ``load_shuffle_split_dataset:754``,
+``register_dataset/make_dataset:798-826``).
+"""
+
+import dataclasses
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.data import SequenceSample
+
+
+@dataclasses.dataclass
+class DatasetUtility:
+    seed: int
+    dp_rank: int
+    world_size: int
+    tokenizer: Optional[Any] = None
+
+
+def load_shuffle_split_jsonl(
+    path: str, util: DatasetUtility
+) -> List[dict]:
+    """Deterministic shuffle + contiguous per-DP-rank split
+    (≈ ``load_shuffle_split_dataset:754``)."""
+    with open(path) as f:
+        records = [json.loads(l) for l in f if l.strip()]
+    rng = np.random.RandomState(util.seed)
+    perm = rng.permutation(len(records))
+    records = [records[i] for i in perm]
+    n = len(records)
+    per = n // util.world_size
+    lo = util.dp_rank * per
+    hi = n if util.dp_rank == util.world_size - 1 else lo + per
+    return records[lo:hi]
+
+
+ALL_DATASETS: Dict[str, Callable] = {}
+
+
+def register_dataset(name: str, cls: Callable):
+    assert name not in ALL_DATASETS, name
+    ALL_DATASETS[name] = cls
+
+
+def make_dataset(name: str, util: DatasetUtility, **kwargs):
+    import areal_tpu.datasets  # noqa: F401  (triggers registration)
+
+    return ALL_DATASETS[name](util=util, **kwargs)

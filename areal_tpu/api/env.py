@@ -1,0 +1,28 @@
+"""Environment-service API (counterpart of ``realhf/api/core/env_api.py``)."""
+
+import abc
+from typing import Any, Dict, List, Tuple
+
+
+class EnvironmentService(abc.ABC):
+    async def reset(self, seed=None, options=None):
+        return None, {}
+
+    @abc.abstractmethod
+    async def step(self, action: Tuple) -> Tuple[Any, List[float], bool, bool, Dict]:
+        """Returns (obs, rewards, terminated, truncated, info)."""
+        ...
+
+
+ALL_ENVS: Dict[str, type] = {}
+
+
+def register_environment(name: str, cls: type):
+    assert name not in ALL_ENVS, name
+    ALL_ENVS[name] = cls
+
+
+def make_env(name: str, **kwargs) -> EnvironmentService:
+    import areal_tpu.envs  # noqa: F401  (triggers registration)
+
+    return ALL_ENVS[name](**kwargs)

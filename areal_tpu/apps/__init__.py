@@ -1,0 +1,1 @@
+"""Entry points & launchers (≈ ``realhf/apps`` + ``realhf/scheduler``)."""

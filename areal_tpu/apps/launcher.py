@@ -1,0 +1,434 @@
+"""Local multiprocess launcher + worker entry functions.
+
+Counterpart of the reference's local scheduler + Ray launcher + recover loop
+(``realhf/scheduler/local/client.py``, ``training/utils.py:119``,
+``apps/main.py:226-288``): each worker role runs as a spawned subprocess;
+the launcher watches them and, on a failure with ``recover_mode=auto``,
+kills the world and restarts it up to ``recover_retries`` times
+(restart-the-world elasticity, like the reference).
+
+Worker processes rendezvous through the file-backed name_resolve under the
+experiment fileroot — the same mechanism the reference uses on NFS.
+"""
+
+import dataclasses
+import json
+import logging
+import multiprocessing as mp
+import os
+import signal
+import sys
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("areal_tpu.launcher")
+
+
+def _setup_worker_env(cfg, device: str = ""):
+    """Common per-process setup: fileroot, name_resolve, devices, seeding."""
+    import os
+
+    if cfg.fileroot:
+        os.environ["AREAL_FILEROOT"] = cfg.fileroot
+    os.environ.setdefault(
+        "AREAL_NAME_RESOLVE_ROOT",
+        os.path.join(cfg.fileroot or "/tmp/areal_tpu", "name_resolve"),
+    )
+    if device == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from areal_tpu.base import constants, name_resolve, seeding
+
+    # cross-process rendezvous goes through the shared-filesystem backend
+    # (the in-memory default only works within one process)
+    name_resolve.reconfigure(
+        name_resolve.NameResolveConfig(
+            type="file", root=os.environ["AREAL_NAME_RESOLVE_ROOT"]
+        )
+    )
+
+    constants.set_experiment_trial_names(cfg.experiment_name, cfg.trial_name)
+    if cfg.fileroot:
+        constants.set_fileroot(cfg.fileroot)
+    seeding.set_random_seed(cfg.seed, "worker")
+
+
+def _load_engine(spec, is_critic=False, with_optimizer=True, total_steps=100):
+    from areal_tpu.train.engine import TrainEngine
+
+    cfg = spec.model_config(is_critic=is_critic)
+    eng = TrainEngine(
+        cfg,
+        spec.parallel_config(),
+        spec.optimizer if with_optimizer else None,
+    )
+    if spec.path:
+        eng.load_hf(spec.path)
+        if is_critic:
+            # CausalLM checkpoints carry no value head; critic head stays
+            # at its random init (≈ init_critic_from_actor)
+            import jax
+
+            from areal_tpu.models import transformer as tfm
+
+            head = tfm.init_params(cfg, jax.random.key(0))["head"]
+            eng.params = {**eng.params, "head": jax.device_put(
+                head, eng._param_shardings["head"]
+            )}
+    else:
+        eng.init_random(0)
+    if with_optimizer:
+        eng.setup_optimizer(total_steps)
+    return eng
+
+
+# --------------------------------------------------------------------------- #
+# worker mains (multiprocessing spawn targets)
+# --------------------------------------------------------------------------- #
+
+
+def gen_server_main(cfg, server_idx: int):
+    import asyncio
+
+    _setup_worker_env(cfg, cfg.gen.device)
+    import jax
+
+    from areal_tpu.base import name_resolve, names, network
+    from areal_tpu.gen.engine import GenerationEngine
+    from areal_tpu.gen.server import serve
+    from areal_tpu.models import hf as hf_conv
+
+    mcfg = cfg.actor.model_config()
+    if cfg.actor.path:
+        _, host_params = hf_conv.load_hf_checkpoint(cfg.actor.path)
+        import jax.numpy as jnp
+
+        params = jax.tree.map(
+            lambda x: jnp.asarray(x, jnp.dtype(mcfg.dtype)), host_params
+        )
+    else:
+        from areal_tpu.models import transformer as tfm
+
+        params = tfm.init_params(mcfg, jax.random.key(0))
+    engine = GenerationEngine(
+        mcfg,
+        params,
+        max_slots=cfg.gen.max_slots,
+        max_seqlen=cfg.gen.max_seqlen,
+        max_new_tokens_cap=cfg.gen.max_new_tokens_cap,
+        stop_token_ids=cfg.gen.stop_token_ids,
+        seed=cfg.seed + server_idx,
+    )
+
+    async def main():
+        port = network.find_free_port()
+        host = "127.0.0.1"
+        await serve(
+            engine, host, port, decode_steps=cfg.gen.decode_steps_per_chunk
+        )
+        name_resolve.add(
+            names.gen_server(cfg.experiment_name, cfg.trial_name, server_idx),
+            f"http://{host}:{port}",
+            replace=True,
+        )
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(main())
+
+
+def gserver_manager_main(cfg):
+    import asyncio
+
+    _setup_worker_env(cfg, "cpu")
+    from areal_tpu.base import name_resolve, names, network
+    from areal_tpu.system.gserver_manager import (
+        GserverManager,
+        GserverManagerConfig,
+        serve_manager,
+    )
+
+    gconfig_n = cfg.gconfig.n if not isinstance(cfg.gconfig, dict) else cfg.gconfig.get("n", 1)
+    mcfg = GserverManagerConfig(
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        model_name="actor",
+        # the staleness gate counts SEQUENCES (the trainer bumps
+        # training_samples by groups x gconfig.n), so the divisor must be
+        # sequences per train step too (≈ reference train_rpcs[0].n_seqs)
+        train_batch_size=cfg.train_batch_size * gconfig_n,
+        max_head_offpolicyness=cfg.manager.max_head_offpolicyness,
+        max_concurrent_rollouts=cfg.manager.max_concurrent_rollouts,
+        schedule_policy=cfg.manager.schedule_policy,
+    )
+
+    async def main():
+        manager = GserverManager(mcfg)
+        # wait for all advertised gen servers
+        for i in range(cfg.gen.n_servers):
+            name_resolve.wait(
+                names.gen_server(cfg.experiment_name, cfg.trial_name, i),
+                timeout=300,
+            )
+        manager.discover_servers()
+        await serve_manager(manager, "127.0.0.1", network.find_free_port())
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(main())
+
+
+def rollout_worker_main(cfg, worker_idx: int):
+    import asyncio
+
+    _setup_worker_env(cfg, "cpu")
+    from areal_tpu.api.agent import make_agent
+    from areal_tpu.api.dataset import DatasetUtility, make_dataset
+    from areal_tpu.api.env import make_env
+    from areal_tpu.api.model import GenerationHyperparameters
+    from areal_tpu.system.rollout_worker import RolloutWorker
+
+    util = DatasetUtility(
+        seed=cfg.dataset.seed,
+        dp_rank=worker_idx,
+        world_size=cfg.rollout.n_workers,
+    )
+    dataset = make_dataset(
+        cfg.dataset.name, util, path=cfg.dataset.path,
+        max_length=cfg.dataset.max_length,
+    )
+    env_args = dict(cfg.rollout.env_args)
+    if hasattr(dataset, "load_metadata") and "dataset_metadata" not in env_args:
+        env_args["dataset_metadata"] = dataset.load_metadata()
+    env = make_env(cfg.rollout.env, **env_args)
+    agent_args = dict(cfg.rollout.agent_args)
+    gconfig = cfg.gconfig
+    if isinstance(gconfig, dict):
+        gconfig = GenerationHyperparameters(**gconfig)
+    agent = make_agent(cfg.rollout.agent, gconfig=gconfig, **agent_args)
+    worker = RolloutWorker(
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        worker_index=worker_idx,
+        n_workers=cfg.rollout.n_workers,
+        n_pullers=1,
+        agent=agent,
+        env=env,
+        dataset=dataset,
+        new_tokens_per_chunk=cfg.rollout.new_tokens_per_chunk,
+        max_concurrent_tasks=cfg.rollout.max_concurrent_tasks,
+    )
+    asyncio.run(worker.run_async())
+
+
+def trainer_main(cfg):
+    _setup_worker_env(cfg, cfg.trainer_device)
+    from areal_tpu.base import constants
+    from areal_tpu.base.metrics import MetricLogger
+    from areal_tpu.system.stream_dataset import PullerStreamDataset
+    from areal_tpu.system.trainer_worker import (
+        AsyncPPOTrainerWorker,
+        TrainerControl,
+    )
+
+    total = cfg.control.total_train_steps
+    # bind the puller first so rollout workers can rendezvous while the
+    # engines load/compile
+    stream = PullerStreamDataset(
+        cfg.experiment_name, cfg.trial_name, 0, offline_dataset_size=10_000
+    )
+    actor = _load_engine(cfg.actor, total_steps=total)
+    ref = None
+    if cfg.use_ref_model and cfg.ppo.kl_ctl != 0:
+        ref = _load_engine(cfg.actor, with_optimizer=False)
+    critic = None
+    if cfg.critic is not None and not cfg.ppo.disable_value:
+        critic = _load_engine(cfg.critic, is_critic=True, total_steps=total)
+    worker = AsyncPPOTrainerWorker(
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        actor_engine=actor,
+        stream=stream,
+        hp=cfg.ppo,
+        control=TrainerControl(
+            total_train_steps=total,
+            save_freq_steps=cfg.control.save_freq_steps,
+            ckpt_freq_steps=cfg.control.ckpt_freq_steps,
+            ckpt_freq_secs=cfg.control.ckpt_freq_secs,
+            weight_sync_freq_steps=cfg.control.weight_sync_freq_steps,
+        ),
+        train_batch_size=cfg.train_batch_size,
+        mb_spec=cfg.mb_spec,
+        ref_engine=ref,
+        critic_engine=critic,
+        hf_family=cfg.hf_family,
+        metric_logger=MetricLogger(constants.get_log_root()),
+    )
+    if cfg.recover_mode in ("auto", "resume"):
+        worker.load_recover_checkpoint()
+    # publish v0 weights so the fleet starts from the trainer's init
+    worker.publish_weights()
+    worker.run()
+
+
+ROLE_MAINS = {
+    "gen_server": gen_server_main,
+    "gserver_manager": gserver_manager_main,
+    "rollout_worker": rollout_worker_main,
+    "trainer": trainer_main,
+}
+
+
+# --------------------------------------------------------------------------- #
+# orchestration
+# --------------------------------------------------------------------------- #
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _cpu_child_env(force_cpu: bool):
+    """Spawned children inherit the parent env at exec, and the TPU-plugin
+    sitecustomize claims the (single) accelerator at interpreter boot —
+    before any code of ours runs. For CPU-designated workers, scrub the
+    plugin triggers from the parent env around ``Process.start()``."""
+    if not force_cpu:
+        yield
+        return
+    saved = {
+        k: os.environ.pop(k, None)
+        for k in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE")
+    }
+    old_plat = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is not None:
+                os.environ[k] = v
+        if old_plat is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = old_plat
+
+
+def _spawn_all(cfg) -> Dict[str, mp.Process]:
+    ctx = mp.get_context("spawn")
+    procs: Dict[str, mp.Process] = {}
+
+    def start(name, p, force_cpu):
+        with _cpu_child_env(force_cpu):
+            p.start()
+        procs[name] = p
+        logger.info("started %s (pid %d)", name, p.pid)
+
+    gen_cpu = cfg.gen.device == "cpu"
+    for i in range(cfg.gen.n_servers):
+        start(
+            f"gen_server/{i}",
+            ctx.Process(target=gen_server_main, args=(cfg, i), daemon=True),
+            gen_cpu,
+        )
+    start(
+        "gserver_manager",
+        ctx.Process(target=gserver_manager_main, args=(cfg,), daemon=True),
+        True,
+    )
+    for i in range(cfg.rollout.n_workers):
+        start(
+            f"rollout_worker/{i}",
+            ctx.Process(target=rollout_worker_main, args=(cfg, i), daemon=True),
+            True,
+        )
+    start(
+        "trainer",
+        ctx.Process(target=trainer_main, args=(cfg,), daemon=True),
+        cfg.trainer_device == "cpu",
+    )
+    return procs
+
+
+def run_async_ppo(cfg) -> int:
+    """Launch the full async-PPO world; restart on failure per recover_mode.
+    Returns the trainer's exit code of the final attempt."""
+    attempts = 1 + (cfg.recover_retries if cfg.recover_mode == "auto" else 0)
+    for attempt in range(attempts):
+        if attempt > 0:
+            logger.warning("recover attempt %d/%d", attempt, attempts - 1)
+            cfg = dataclasses.replace(cfg, recover_mode="resume")
+        procs = _spawn_all(cfg)
+        trainer = procs["trainer"]
+        failed = False
+        try:
+            while trainer.is_alive():
+                trainer.join(timeout=5)
+                for name, p in procs.items():
+                    if name != "trainer" and not p.is_alive():
+                        logger.error("%s died (exit %s)", name, p.exitcode)
+                        failed = True
+                        break
+                if failed:
+                    break
+        finally:
+            for p in procs.values():
+                if p.is_alive():
+                    p.terminate()
+            for p in procs.values():
+                p.join(timeout=10)
+        if trainer.exitcode == 0 and not failed:
+            return 0
+        if cfg.recover_mode != "auto":
+            break
+    return trainer.exitcode if trainer.exitcode is not None else 1
+
+
+def run_sft(cfg) -> int:
+    """SFT runs in-process: one trainer program, no fleet."""
+    _setup_worker_env(cfg, "")
+    from areal_tpu.api.data import MicroBatchSpec
+    from areal_tpu.api.dataset import DatasetUtility, make_dataset
+    from areal_tpu.base import constants
+    from areal_tpu.base.metrics import MetricLogger
+    from areal_tpu.system.trainer_worker import SFTTrainerWorker, TrainerControl
+
+    tokenizer = None
+    if cfg.tokenizer_path:
+        import transformers
+
+        tokenizer = transformers.AutoTokenizer.from_pretrained(cfg.tokenizer_path)
+    util = DatasetUtility(
+        seed=cfg.dataset.seed, dp_rank=0, world_size=1, tokenizer=tokenizer
+    )
+    dataset = make_dataset(
+        cfg.dataset.name, util, path=cfg.dataset.path,
+        max_length=cfg.dataset.max_length,
+    )
+    eval_ds = None
+    if cfg.eval_dataset is not None:
+        eval_ds = make_dataset(
+            cfg.eval_dataset.name, util, path=cfg.eval_dataset.path,
+            max_length=cfg.eval_dataset.max_length,
+        )
+    engine = _load_engine(
+        cfg.model, total_steps=cfg.control.total_train_steps
+    )
+    worker = SFTTrainerWorker(
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        engine=engine,
+        dataset=dataset,
+        eval_dataset=eval_ds,
+        control=TrainerControl(
+            total_train_steps=cfg.control.total_train_steps,
+            save_freq_steps=cfg.control.save_freq_steps,
+        ),
+        batch_size=cfg.batch_size,
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=cfg.max_tokens_per_mb),
+        hf_family=cfg.hf_family,
+        metric_logger=MetricLogger(constants.get_log_root()),
+    )
+    worker.run()
+    return 0

@@ -1,0 +1,44 @@
+"""CLI entry point.
+
+Counterpart of ``realhf/apps/main.py`` + the ``training/main_*.py`` scripts:
+
+    python -m areal_tpu.apps.main sft --config cfg.yaml model.path=... control.total_train_steps=100
+    python -m areal_tpu.apps.main async-ppo --config cfg.yaml actor.path=...
+"""
+
+import argparse
+import logging
+import sys
+
+
+def main(argv=None):
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s",
+    )
+    parser = argparse.ArgumentParser(prog="areal_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    for cmd in ("sft", "async-ppo"):
+        p = sub.add_parser(cmd)
+        p.add_argument("--config", default=None, help="YAML config path")
+        p.add_argument(
+            "overrides", nargs="*", help="dotted overrides, e.g. a.b=1"
+        )
+    args = parser.parse_args(argv)
+
+    from areal_tpu.apps import launcher
+    from areal_tpu.experiments import (
+        AsyncPPOExperiment,
+        SFTExperiment,
+        load_config,
+    )
+
+    if args.cmd == "sft":
+        cfg = load_config(SFTExperiment, args.config, args.overrides)
+        return launcher.run_sft(cfg)
+    cfg = load_config(AsyncPPOExperiment, args.config, args.overrides)
+    return launcher.run_async_ppo(cfg)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

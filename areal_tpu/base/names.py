@@ -61,3 +61,7 @@ def trainer_coordinator(experiment_name, trial_name) -> str:
 
 def metric_server(experiment_name, trial_name, name) -> str:
     return f"{trial_root(experiment_name, trial_name)}/metric_server/{name}"
+
+
+def training_samples(experiment_name, trial_name) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/training_samples"

@@ -1,0 +1,9 @@
+"""Datasets (≈ ``realhf/impl/dataset/``)."""
+
+from areal_tpu.api.dataset import register_dataset
+from areal_tpu.datasets.prompt import MathCodePromptDataset, PromptOnlyDataset
+from areal_tpu.datasets.prompt_answer import PromptAnswerDataset
+
+register_dataset("math_code_prompt", MathCodePromptDataset)
+register_dataset("prompt", PromptOnlyDataset)
+register_dataset("prompt_answer", PromptAnswerDataset)

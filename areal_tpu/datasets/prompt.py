@@ -1,0 +1,89 @@
+"""Prompt datasets for RL rollout.
+
+Counterpart of ``realhf/impl/dataset/math_code_dataset.py:90`` (jsonl with
+ground-truth solutions / test cases + ``load_metadata``) and the prompt-only
+dataset. Records carry either pre-tokenized ``prompt_ids`` or text
+``prompt`` (tokenized with the provided HF tokenizer). Supports dynamic
+difficulty filtering by qid (≈ ``dataset.filter`` consumed at
+``model_worker.py:588-598`` / ``rollout_worker.py:157-166``).
+"""
+
+import logging
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from areal_tpu.api.data import SequenceSample
+from areal_tpu.api.dataset import DatasetUtility, load_shuffle_split_jsonl
+
+logger = logging.getLogger("areal_tpu.datasets")
+
+
+class PromptOnlyDataset:
+    def __init__(
+        self,
+        util: DatasetUtility,
+        path: str,
+        max_length: Optional[int] = None,
+    ):
+        self.util = util
+        self.records = load_shuffle_split_jsonl(path, util)
+        self._tokenize(max_length)
+
+    def _tokenize(self, max_length):
+        kept = []
+        for r in self.records:
+            if "prompt_ids" in r:
+                ids = list(map(int, r["prompt_ids"]))
+            else:
+                assert self.util.tokenizer is not None, "need tokenizer for text"
+                ids = self.util.tokenizer(r["prompt"])["input_ids"]
+            if max_length is not None and len(ids) > max_length:
+                continue
+            r["_ids"] = ids
+            kept.append(r)
+        dropped = len(self.records) - len(kept)
+        if dropped:
+            logger.info("dropped %d overlong prompts", dropped)
+        self.records = kept
+
+    def __len__(self):
+        return len(self.records)
+
+    def __getitem__(self, i: int) -> SequenceSample:
+        r = self.records[i]
+        qid = str(r.get("query_id", r.get("qid", i)))
+        return SequenceSample(
+            keys={"packed_prompts"},
+            ids=[qid],
+            seqlens={"packed_prompts": [[len(r["_ids"])]]},
+            data={"packed_prompts": np.asarray(r["_ids"], np.int64)},
+        )
+
+    def filter(self, keep_qids: Set[str]):
+        """Dynamic difficulty filtering: keep only the given qids."""
+        before = len(self.records)
+        self.records = [
+            r
+            for i, r in enumerate(self.records)
+            if str(r.get("query_id", r.get("qid", i))) in keep_qids
+        ]
+        logger.info("dataset filter: %d -> %d", before, len(self.records))
+
+
+class MathCodePromptDataset(PromptOnlyDataset):
+    """Adds per-qid task metadata (solutions / test cases)."""
+
+    def load_metadata(self) -> Dict[str, dict]:
+        meta: Dict[str, dict] = {}
+        for i, r in enumerate(self.records):
+            qid = str(r.get("query_id", r.get("qid", i)))
+            task = r.get("task", "math")
+            if task == "math":
+                meta[qid] = {"task": "math", "solutions": r.get("solutions", [])}
+            else:
+                meta[qid] = {
+                    "task": "code",
+                    "input_output": r.get("input_output", {}),
+                }
+        return meta

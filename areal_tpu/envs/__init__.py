@@ -1,0 +1,6 @@
+"""Environment services (≈ ``realhf/impl/environment/``)."""
+
+from areal_tpu.api.env import register_environment
+from areal_tpu.envs.math_code_single_step import MathCodeSingleStepEnv
+
+register_environment("math-code-single-step", MathCodeSingleStepEnv)

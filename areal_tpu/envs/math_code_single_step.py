@@ -1,0 +1,62 @@
+"""Single-step math/code verification environment.
+
+Counterpart of ``realhf/impl/environment/math_code_single_step_env.py:41``:
+one step takes ``(qid, answers)`` and returns per-answer binary success,
+dispatching to the local verifier or the remote sandbox
+(``AREAL_ENABLE_FUNCTION_CALL``). Task metadata (ground-truth solutions /
+test cases) comes from the dataset's id→metadata map.
+"""
+
+import asyncio
+from typing import Dict, List, Tuple
+
+from areal_tpu.api.env import EnvironmentService
+from areal_tpu.rewards import math_verify, code_verify, remote
+
+
+class MathCodeSingleStepEnv(EnvironmentService):
+    def __init__(self, dataset_metadata: Dict[str, dict], timeout: float = 100.0):
+        # qid -> {"task": "math"|"code", "solutions": [...] | "input_output": {...}}
+        self.metadata = dataset_metadata
+        self.timeout = timeout
+
+    async def reset(self, seed=None, options=None):
+        return None, {}
+
+    async def step(self, action: Tuple) -> Tuple:
+        qid, answers = action
+        meta = self.metadata[str(qid)]
+        task = meta.get("task", "math")
+        if remote.ENABLED and remote.service_domain():
+            if task == "math":
+                success = await remote.math_verify_remote(
+                    answers, [meta["solutions"]] * len(answers),
+                    [str(qid)] * len(answers),
+                )
+            else:
+                success = await remote.code_verify_remote(
+                    answers, [str(qid)] * len(answers)
+                )
+        else:
+            loop = asyncio.get_event_loop()
+            if task == "math":
+                success = await asyncio.gather(
+                    *(
+                        loop.run_in_executor(
+                            None, math_verify.verify_math_solution,
+                            a, meta["solutions"],
+                        )
+                        for a in answers
+                    )
+                )
+            else:
+                success = await asyncio.gather(
+                    *(
+                        loop.run_in_executor(
+                            None, code_verify.verify_code_solution,
+                            a, meta["input_output"],
+                        )
+                        for a in answers
+                    )
+                )
+        return None, [bool(s) for s in success], True, False, {}

@@ -1,0 +1,16 @@
+"""Experiment configuration layer (≈ ``realhf/experiments/``).
+
+Dataclass-first configs (the reference's hydra structured configs, minus
+hydra — plain dataclasses + yaml + dotted overrides) compiled into worker
+processes by the launcher (``areal_tpu/apps/launcher.py``).
+"""
+
+from areal_tpu.experiments.config import (  # noqa: F401
+    AsyncPPOExperiment,
+    DatasetSpec,
+    GenFleetSpec,
+    ModelSpec,
+    RolloutSpec,
+    SFTExperiment,
+    load_config,
+)

@@ -1,0 +1,232 @@
+"""Experiment config dataclasses.
+
+Counterpart of ``realhf/api/cli_args.py`` (1560 LoC of config dataclasses)
+plus the experiment bases (``realhf/experiments/common/common.py:71``,
+``async_exp/async_rl_exp.py:59``), compressed to what the TPU architecture
+needs: one trainer program + a generation fleet + rollout workers. Configs
+load from YAML with dotted-path overrides (``a.b.c=v``), the no-hydra
+equivalent of the reference's CLI.
+"""
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.api.data import MicroBatchSpec
+from areal_tpu.api.model import GenerationHyperparameters, PPOHyperparameters
+from areal_tpu.models.config import ModelConfig
+from areal_tpu.parallel.mesh import ParallelConfig
+from areal_tpu.train.engine import OptimizerConfig
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """One model role (actor/critic/ref): where weights come from and how
+    it is sharded (≈ ``ModelTrainEvalConfig``)."""
+
+    path: Optional[str] = None           # HF checkpoint dir
+    arch: Optional[Dict[str, Any]] = None  # ModelConfig kwargs (random init)
+    parallel: str = "d1m1"               # ParallelConfig.from_str format
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    init_critic_from_actor: bool = False
+
+    def model_config(self, is_critic: bool = False) -> ModelConfig:
+        if self.path is not None:
+            import dataclasses as dc
+            import os
+
+            from areal_tpu.models import hf as hf_conv
+
+            with open(os.path.join(self.path, "config.json")) as f:
+                hf_cfg = json.load(f)
+            fam = hf_conv.family_for_model_type(hf_cfg["model_type"])
+            cfg = fam.config_from_hf(hf_cfg)
+            return dc.replace(cfg, is_critic=is_critic)
+        assert self.arch is not None, "ModelSpec needs path or arch"
+        return ModelConfig(**{**self.arch, "is_critic": is_critic})
+
+    def parallel_config(self) -> ParallelConfig:
+        return ParallelConfig.from_str(self.parallel)
+
+
+@dataclasses.dataclass
+class DatasetSpec:
+    name: str = "math_code_prompt"   # registry name
+    path: str = ""
+    max_length: Optional[int] = None
+    seed: int = 1
+
+
+@dataclasses.dataclass
+class GenFleetSpec:
+    n_servers: int = 1
+    max_slots: int = 8
+    max_seqlen: int = 4096
+    max_new_tokens_cap: int = 2048
+    decode_steps_per_chunk: int = 16
+    stop_token_ids: List[int] = dataclasses.field(default_factory=list)
+    device: str = ""                 # "" = default; "cpu" forces CPU servers
+
+
+@dataclasses.dataclass
+class RolloutSpec:
+    n_workers: int = 1
+    max_concurrent_tasks: int = 16
+    new_tokens_per_chunk: int = 256
+    agent: str = "math-single-step"
+    agent_args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    env: str = "math-code-single-step"
+    env_args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ManagerSpec:
+    max_head_offpolicyness: int = 4
+    max_concurrent_rollouts: int = 128
+    schedule_policy: str = "round_robin"
+
+
+@dataclasses.dataclass
+class TrainerControlSpec:
+    total_train_steps: int = 100
+    save_freq_steps: Optional[int] = None
+    ckpt_freq_steps: Optional[int] = 50
+    ckpt_freq_secs: Optional[float] = 600.0
+    weight_sync_freq_steps: int = 1
+
+
+@dataclasses.dataclass
+class AsyncPPOExperiment:
+    """≈ ``AsyncPPOMATHConfig`` (``async_exp/async_ppo_math_exp.py``)."""
+
+    experiment_name: str = "async-ppo"
+    trial_name: str = "trial0"
+    fileroot: str = ""
+    seed: int = 1
+    actor: ModelSpec = dataclasses.field(default_factory=ModelSpec)
+    critic: Optional[ModelSpec] = None
+    use_ref_model: bool = True
+    hf_family: str = "qwen2"
+    dataset: DatasetSpec = dataclasses.field(default_factory=DatasetSpec)
+    gen: GenFleetSpec = dataclasses.field(default_factory=GenFleetSpec)
+    rollout: RolloutSpec = dataclasses.field(default_factory=RolloutSpec)
+    manager: ManagerSpec = dataclasses.field(default_factory=ManagerSpec)
+    ppo: PPOHyperparameters = dataclasses.field(default_factory=PPOHyperparameters)
+    gconfig: GenerationHyperparameters = dataclasses.field(
+        default_factory=GenerationHyperparameters
+    )
+    control: TrainerControlSpec = dataclasses.field(
+        default_factory=TrainerControlSpec
+    )
+    train_batch_size: int = 32
+    max_tokens_per_mb: int = 16384
+    recover_mode: str = "disabled"    # disabled | auto | resume
+    recover_retries: int = 1
+    trainer_device: str = ""
+
+    @property
+    def mb_spec(self) -> MicroBatchSpec:
+        return MicroBatchSpec(max_tokens_per_mb=self.max_tokens_per_mb)
+
+
+@dataclasses.dataclass
+class SFTExperiment:
+    """≈ ``SFTConfig`` (``common/sft_exp.py``)."""
+
+    experiment_name: str = "sft"
+    trial_name: str = "trial0"
+    fileroot: str = ""
+    seed: int = 1
+    model: ModelSpec = dataclasses.field(default_factory=ModelSpec)
+    hf_family: str = "qwen2"
+    dataset: DatasetSpec = dataclasses.field(
+        default_factory=lambda: DatasetSpec(name="prompt_answer")
+    )
+    eval_dataset: Optional[DatasetSpec] = None
+    control: TrainerControlSpec = dataclasses.field(
+        default_factory=TrainerControlSpec
+    )
+    batch_size: int = 32
+    max_tokens_per_mb: int = 16384
+    tokenizer_path: Optional[str] = None
+
+
+# --------------------------------------------------------------------------- #
+# YAML loading with dotted overrides
+# --------------------------------------------------------------------------- #
+
+
+def _from_dict(cls, d: Dict[str, Any]):
+    if d is None:
+        return None
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in d:
+            continue
+        v = d[f.name]
+        typ = f.type
+        sub = _DATACLASS_FIELDS.get((cls, f.name))
+        if sub is not None and isinstance(v, dict):
+            v = _from_dict(sub, v)
+        kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+_DATACLASS_FIELDS = {}
+
+
+def _register_nested(cls):
+    for f in dataclasses.fields(cls):
+        # resolve nested dataclass types for dict->dataclass conversion
+        t = f.type
+        if isinstance(t, str):
+            t = {
+                "ModelSpec": ModelSpec,
+                "Optional[ModelSpec]": ModelSpec,
+                "DatasetSpec": DatasetSpec,
+                "Optional[DatasetSpec]": DatasetSpec,
+                "GenFleetSpec": GenFleetSpec,
+                "RolloutSpec": RolloutSpec,
+                "ManagerSpec": ManagerSpec,
+                "TrainerControlSpec": TrainerControlSpec,
+                "PPOHyperparameters": PPOHyperparameters,
+                "GenerationHyperparameters": GenerationHyperparameters,
+                "OptimizerConfig": OptimizerConfig,
+            }.get(t)
+        if t is not None and dataclasses.is_dataclass(t):
+            _DATACLASS_FIELDS[(cls, f.name)] = t
+
+
+for _cls in (
+    AsyncPPOExperiment, SFTExperiment, ModelSpec, RolloutSpec, GenFleetSpec,
+    PPOHyperparameters,
+):
+    _register_nested(_cls)
+
+
+def _apply_override(d: Dict[str, Any], dotted: str, value: str):
+    keys = dotted.split(".")
+    cur = d
+    for k in keys[:-1]:
+        cur = cur.setdefault(k, {})
+    try:
+        value = json.loads(value)
+    except (json.JSONDecodeError, TypeError):
+        pass
+    cur[keys[-1]] = value
+
+
+def load_config(
+    cls, yaml_path: Optional[str] = None, overrides: Optional[List[str]] = None
+):
+    """Build an experiment config from YAML + ``a.b=c`` overrides."""
+    import yaml
+
+    d: Dict[str, Any] = {}
+    if yaml_path:
+        with open(yaml_path) as f:
+            d = yaml.safe_load(f) or {}
+    for ov in overrides or []:
+        key, _, val = ov.partition("=")
+        _apply_override(d, key, val)
+    return _from_dict(cls, d)

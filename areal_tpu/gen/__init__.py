@@ -1,0 +1,9 @@
+"""TPU generation fleet: sampling ops, continuous-batching engine, HTTP server.
+
+Counterpart of the reference's generation side: the in-house generation
+engine (``realhf/impl/model/nn/real_llm_generate.py``), the SGLang server
+wrapper + interruption patch (``realhf/system/generation_server.py``,
+``patch/sglang``), and the ``SGLangAPIClient`` HTTP protocol
+(``realhf/impl/model/backend/sglang.py:62``) — redesigned as a JAX slot-based
+continuous-batching engine with jitted decode chunks (SURVEY.md §7 step 7).
+"""

@@ -1,0 +1,100 @@
+"""Async HTTP client for the generation fleet.
+
+Counterpart of the reference's ``SGLangAPIClient``
+(``realhf/impl/model/backend/sglang.py:62``): generate + weight-update calls
+with the same retry/timeout posture.
+"""
+
+import asyncio
+import dataclasses
+from typing import Dict, List, Optional
+
+import aiohttp
+
+
+@dataclasses.dataclass
+class GenReqMeta:
+    """≈ ``model_api.GenReqMeta:46`` — what the router needs to pick a server."""
+
+    qid: str
+    prompt_len: int
+    group_size: int
+    new_token_budget: int
+    predicted_new_tokens: Optional[int] = None
+
+
+@dataclasses.dataclass
+class APIGenerateResult:
+    rid: str
+    output_ids: List[int]
+    output_logprobs: List[float]
+    finish_reason: str
+    version: int
+
+
+class GenAPIClient:
+    def __init__(self, timeout: float = 300.0):
+        self._timeout = aiohttp.ClientTimeout(total=timeout)
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def __aenter__(self):
+        self._session = aiohttp.ClientSession(timeout=self._timeout)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self._session.close()
+
+    async def generate(
+        self,
+        server_url: str,
+        rid: str,
+        input_ids: List[int],
+        sampling_params: Dict,
+    ) -> APIGenerateResult:
+        async with self._session.post(
+            f"{server_url}/generate",
+            json={
+                "rid": rid,
+                "input_ids": input_ids,
+                "sampling_params": sampling_params,
+            },
+        ) as resp:
+            resp.raise_for_status()
+            d = await resp.json()
+        return APIGenerateResult(
+            rid=d["rid"],
+            output_ids=d["output_ids"],
+            output_logprobs=d["output_logprobs"],
+            finish_reason=d["finish_reason"],
+            version=d["version"],
+        )
+
+    async def update_weights_from_disk(
+        self,
+        server_url: str,
+        model_path: str,
+        version: Optional[int] = None,
+        allow_interrupt: bool = True,
+    ) -> Dict:
+        async with self._session.post(
+            f"{server_url}/update_weights_from_disk",
+            json={
+                "model_path": model_path,
+                "version": version,
+                "allow_interrupt": allow_interrupt,
+            },
+        ) as resp:
+            resp.raise_for_status()
+            return await resp.json()
+
+    async def metrics(self, server_url: str) -> Dict:
+        async with self._session.get(f"{server_url}/metrics_json") as resp:
+            resp.raise_for_status()
+            return await resp.json()
+
+    async def health(self, server_url: str) -> bool:
+        try:
+            async with self._session.get(f"{server_url}/health") as resp:
+                return resp.status == 200
+        except aiohttp.ClientError:
+            return False

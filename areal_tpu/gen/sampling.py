@@ -1,0 +1,83 @@
+"""Logits warping + token sampling.
+
+Counterpart of ``realhf/impl/model/utils/logits_warper.py`` (225 LoC) and the
+sampling half of ``genstep`` (``real_llm_generate.py:30``): temperature,
+top-k, top-p, greedy — vectorized over a slot batch, jit-friendly (no
+data-dependent shapes; top-p uses sort + cumulative mass masking).
+"""
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e10
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-slot sampling hyperparameters (device arrays, [B])."""
+
+    temperature: jnp.ndarray   # f32; 0 => greedy
+    top_p: jnp.ndarray         # f32 in (0, 1]
+    top_k: jnp.ndarray         # i32; >= vocab => disabled
+
+    @classmethod
+    def filled(cls, batch: int, temperature=1.0, top_p=1.0, top_k=1 << 30):
+        return cls(
+            temperature=jnp.full((batch,), temperature, jnp.float32),
+            top_p=jnp.full((batch,), top_p, jnp.float32),
+            top_k=jnp.full((batch,), top_k, jnp.int32),
+        )
+
+
+def warp_logits(logits: jnp.ndarray, sp: SamplingParams) -> jnp.ndarray:
+    """[B, V] -> warped [B, V] (fp32). Greedy slots (temperature 0) pass
+    through — the sampler handles them with argmax."""
+    logits = logits.astype(jnp.float32)
+    B, V = logits.shape
+    temp = jnp.maximum(sp.temperature, 1e-6)[:, None]
+    logits = logits / temp
+
+    # top-k: threshold at the k-th largest value per row
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(sp.top_k - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+    logits = jnp.where(logits < kth, NEG_INF, logits)
+
+    # top-p: keep the smallest prefix of the sorted distribution with
+    # cumulative mass >= top_p (the first token always survives)
+    probs_desc = jax.nn.softmax(jnp.sort(logits, axis=-1)[:, ::-1], axis=-1)
+    cum = jnp.cumsum(probs_desc, axis=-1)
+    keep_desc = (cum - probs_desc) < sp.top_p[:, None]
+    # threshold value: smallest logit still kept
+    n_keep = jnp.maximum(keep_desc.sum(-1), 1)
+    sorted_logits_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    thresh = jnp.take_along_axis(
+        sorted_logits_desc, (n_keep - 1)[:, None], axis=-1
+    )
+    return jnp.where(logits < thresh, NEG_INF, logits)
+
+
+def sample_tokens(
+    rng: jax.Array,
+    logits: jnp.ndarray,
+    sp: SamplingParams,
+    greedy: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sample one token per slot. Returns (tokens [B] i32, logprobs [B] f32).
+
+    ``logprobs`` are w.r.t. the *warped* distribution (matching SGLang's
+    returned logprobs under sampling parameters).
+    """
+    warped = warp_logits(logits, sp)
+    logp = jax.nn.log_softmax(warped, axis=-1)
+    sampled = jax.random.categorical(rng, warped, axis=-1)
+    arg = jnp.argmax(logits, axis=-1)
+    if greedy is None:
+        greedy = sp.temperature <= 0.0
+    tokens = jnp.where(greedy, arg, sampled).astype(jnp.int32)
+    lp = jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
+    return tokens, lp

@@ -1,0 +1,215 @@
+"""Generation HTTP server.
+
+TPU-native counterpart of the reference's patched-SGLang server +
+``GenerationServer`` wrapper (``realhf/system/generation_server.py``): an
+aiohttp app around :class:`GenerationEngine` exposing the same protocol
+surface the rollout side depends on —
+
+- ``POST /generate``: submit a request, await completion (or interruption).
+- ``POST /update_weights_from_disk``: pause → harvest running requests as
+  interrupted (clients re-submit, ≈ the SGLang ``InterruptAllReq`` patch) →
+  reload params from an HF checkpoint dir → resume. Returns ``num_paused``.
+- ``POST /pause_generation`` / ``POST /continue_generation``.
+- ``GET /health``, ``GET /metrics_json`` (running/served counters, version).
+
+The engine's jitted chunks execute in a thread-pool executor so the asyncio
+loop stays responsive; one background task drives admission/decode
+continuously (the reference's event loop lives inside SGLang's scheduler).
+"""
+
+import asyncio
+import json
+import logging
+import os
+import time
+from typing import Dict, Optional
+
+from aiohttp import web
+
+from areal_tpu.gen.engine import GenerationEngine, GenOutput, GenRequest
+
+logger = logging.getLogger("areal_tpu.gen.server")
+
+
+class GenerationHTTPServer:
+    def __init__(self, engine: GenerationEngine, decode_steps: int = 16):
+        self.engine = engine
+        self.decode_steps = decode_steps
+        self._futures: Dict[str, asyncio.Future] = {}
+        self._served = 0
+        self._gen_tokens = 0
+        self._start = time.time()
+        self._lock = asyncio.Lock()
+        self.app = web.Application()
+        self.app.router.add_post("/generate", self._generate)
+        self.app.router.add_post(
+            "/update_weights_from_disk", self._update_weights
+        )
+        self.app.router.add_post("/pause_generation", self._pause)
+        self.app.router.add_post("/continue_generation", self._continue)
+        self.app.router.add_get("/health", self._health)
+        self.app.router.add_get("/metrics_json", self._metrics)
+        self.app.on_startup.append(self._on_startup)
+        self.app.on_cleanup.append(self._on_cleanup)
+        self._loop_task: Optional[asyncio.Task] = None
+
+    # ------------------------------------------------------------------ #
+    # engine loop
+    # ------------------------------------------------------------------ #
+
+    async def _on_startup(self, app):
+        self._loop_task = asyncio.get_event_loop().create_task(self._run())
+
+    async def _on_cleanup(self, app):
+        if self._loop_task:
+            self._loop_task.cancel()
+
+    def _resolve(self, outs):
+        for o in outs:
+            self._served += 1
+            self._gen_tokens += len(o.output_ids)
+            fut = self._futures.pop(o.rid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(o)
+
+    async def _run(self):
+        loop = asyncio.get_event_loop()
+        while True:
+            if self.engine.paused or (
+                not self.engine._pending and self.engine.n_running() == 0
+            ):
+                await asyncio.sleep(0.005)
+                continue
+            async with self._lock:
+                outs = await loop.run_in_executor(
+                    None, self.engine.step, self.decode_steps
+                )
+            self._resolve(outs)
+
+    # ------------------------------------------------------------------ #
+    # handlers
+    # ------------------------------------------------------------------ #
+
+    async def _generate(self, request: web.Request) -> web.Response:
+        try:
+            d = await request.json()
+            sp = d.get("sampling_params", {})
+            req = GenRequest(
+                rid=str(d["rid"]),
+                input_ids=list(d["input_ids"]),
+                max_new_tokens=int(sp.get("max_new_tokens", 256)),
+                min_new_tokens=int(sp.get("min_new_tokens", 0)),
+                temperature=float(sp.get("temperature", 1.0)),
+                top_p=float(sp.get("top_p", 1.0)),
+                top_k=int(sp.get("top_k", 1 << 30)),
+                greedy=bool(sp.get("greedy", False)),
+                stop_token_ids=list(sp.get("stop_token_ids", [])),
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            return web.json_response({"error": repr(e)}, status=400)
+        fut = asyncio.get_event_loop().create_future()
+        self._futures[req.rid] = fut
+        try:
+            self.engine.submit(req)
+        except ValueError as e:
+            self._futures.pop(req.rid, None)
+            return web.json_response({"error": str(e)}, status=400)
+        out: GenOutput = await fut
+        return web.json_response(
+            {
+                "rid": out.rid,
+                "output_ids": out.output_ids,
+                "output_logprobs": out.output_logprobs,
+                "finish_reason": out.finish_reason,
+                "version": out.version,
+            }
+        )
+
+    async def _update_weights(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        path = d["model_path"]
+        allow_interrupt = bool(d.get("allow_interrupt", True))
+        async with self._lock:
+            if allow_interrupt:
+                interrupted = self.engine.pause()
+                self._resolve(interrupted)
+                num_paused = len(interrupted)
+            else:
+                # drain: stop admission (new requests queue in _pending),
+                # decode the running slots to completion
+                self.engine.accepting = False
+                loop = asyncio.get_event_loop()
+                try:
+                    while self.engine.n_running():
+                        outs = await loop.run_in_executor(
+                            None, self.engine.step, self.decode_steps
+                        )
+                        self._resolve(outs)
+                finally:
+                    self.engine.accepting = True
+                self.engine.paused = True
+                num_paused = 0
+            try:
+                params = await asyncio.get_event_loop().run_in_executor(
+                    None, self._load_params, path
+                )
+                self.engine.update_params(
+                    params, version=d.get("version")
+                )
+                ok = True
+                msg = f"loaded weights from {path}"
+            except Exception as e:  # noqa: BLE001 - reported to the manager
+                ok = False
+                msg = f"weight update failed: {e!r}"
+                logger.exception("weight update failed")
+            self.engine.resume()
+        return web.json_response(
+            {"success": ok, "message": msg, "num_paused_requests": num_paused}
+        )
+
+    def _load_params(self, path: str):
+        from areal_tpu.models import hf as hf_conv
+
+        cfg, host_params = hf_conv.load_hf_checkpoint(path)
+        import jax
+        import jax.numpy as jnp
+
+        dt = jnp.dtype(self.engine.cfg.dtype)
+        return jax.tree.map(lambda x: jnp.asarray(x, dt), host_params)
+
+    async def _pause(self, request: web.Request) -> web.Response:
+        async with self._lock:
+            interrupted = self.engine.pause()
+            self._resolve(interrupted)
+        return web.json_response({"num_paused_requests": len(interrupted)})
+
+    async def _continue(self, request: web.Request) -> web.Response:
+        self.engine.resume()
+        return web.json_response({"success": True})
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "running": self.engine.n_running(),
+                "pending": len(self.engine._pending),
+                "served": self._served,
+                "gen_tokens": self._gen_tokens,
+                "gen_throughput": self._gen_tokens / max(time.time() - self._start, 1e-6),
+                "version": self.engine.version,
+                "max_slots": self.engine.B,
+            }
+        )
+
+
+async def serve(engine: GenerationEngine, host: str, port: int, **kw):
+    """Start serving; returns the aiohttp AppRunner (caller owns shutdown)."""
+    srv = GenerationHTTPServer(engine, **kw)
+    runner = web.AppRunner(srv.app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    logger.info("generation server on %s:%d", host, port)
+    return runner

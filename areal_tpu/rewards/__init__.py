@@ -1,0 +1,6 @@
+"""Reward computation: local math verifier, local code runner, remote sandbox.
+
+Counterpart of the reference's ``realhf/impl/dataset/math_parser.py`` (local
+sympy verifier), ``functioncall/`` (remote sandbox client, 3068 LoC) and
+``functioncall/code/local_verify.py`` (subprocess test runner).
+"""

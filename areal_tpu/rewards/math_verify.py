@@ -1,0 +1,117 @@
+"""Math answer verification (local, sympy-based).
+
+Counterpart of the reference's ``realhf/impl/dataset/math_parser.py`` (875
+LoC, latex2sympy-based): extract the final answer from a generated solution
+(``\\boxed{...}`` or the last number) and test equivalence against the ground
+truth via, in order: normalized string match, numeric comparison, sympy
+symbolic difference. Deliberately dependency-light — the heavy latex parsing
+of the reference's vendored latex2sympy is out of scope for parity
+(SURVEY.md §2.6); the remote sandbox (``areal_tpu.rewards.remote``) covers
+the hard cases in production.
+"""
+
+import re
+from typing import List, Optional
+
+
+def extract_boxed(text: str) -> Optional[str]:
+    r"""Content of the last ``\boxed{...}`` with balanced braces."""
+    idx = text.rfind("\\boxed")
+    if idx < 0:
+        return None
+    i = text.find("{", idx)
+    if i < 0:
+        return None
+    depth = 0
+    for j in range(i, len(text)):
+        if text[j] == "{":
+            depth += 1
+        elif text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[i + 1 : j]
+    return None
+
+
+_NUM_RE = re.compile(r"-?\d+(?:\.\d+)?(?:/\d+)?")
+
+
+def extract_answer(text: str) -> Optional[str]:
+    boxed = extract_boxed(text)
+    if boxed is not None:
+        return boxed
+    # "the answer is X" pattern, else the last number in the text
+    m = re.search(r"answer is[:\s]*\$?([^\n\.\$]+)", text, re.IGNORECASE)
+    if m:
+        return m.group(1).strip()
+    nums = _NUM_RE.findall(text.replace(",", ""))
+    return nums[-1] if nums else None
+
+
+def _normalize(s: str) -> str:
+    s = s.strip()
+    for tok in ("\\left", "\\right", "\\,", "\\;", "\\!", "$", " ", "\\%", "%"):
+        s = s.replace(tok, "")
+    s = s.replace("\\dfrac", "\\frac").replace("\\tfrac", "\\frac")
+    s = s.rstrip(".").strip("{}") if s.count("{") != s.count("}") else s.rstrip(".")
+    return s
+
+
+def _to_number(s: str) -> Optional[float]:
+    s = _normalize(s)
+    frac = re.fullmatch(r"\\frac\{(-?[\d\.]+)\}\{(-?[\d\.]+)\}", s)
+    if frac:
+        try:
+            return float(frac.group(1)) / float(frac.group(2))
+        except (ValueError, ZeroDivisionError):
+            return None
+    simple = re.fullmatch(r"(-?[\d\.]+)/(-?[\d\.]+)", s)
+    if simple:
+        try:
+            return float(simple.group(1)) / float(simple.group(2))
+        except (ValueError, ZeroDivisionError):
+            return None
+    try:
+        return float(s)
+    except ValueError:
+        return None
+
+
+def _sympy_equal(a: str, b: str) -> bool:
+    try:
+        import sympy
+        from sympy.parsing.sympy_parser import (
+            implicit_multiplication_application,
+            parse_expr,
+            standard_transformations,
+        )
+
+        tf = standard_transformations + (implicit_multiplication_application,)
+        ea = parse_expr(_normalize(a).replace("^", "**"), transformations=tf)
+        eb = parse_expr(_normalize(b).replace("^", "**"), transformations=tf)
+        return bool(sympy.simplify(ea - eb) == 0)
+    except Exception:  # noqa: BLE001 — unparseable => not equal
+        return False
+
+
+def answers_equal(given: str, truth: str) -> bool:
+    ng, nt = _normalize(given), _normalize(truth)
+    if ng == nt and ng != "":
+        return True
+    fg, ft = _to_number(given), _to_number(truth)
+    if fg is not None and ft is not None:
+        return abs(fg - ft) < 1e-6 * max(1.0, abs(ft))
+    return _sympy_equal(given, truth)
+
+
+def verify_math_solution(generated: str, solutions: List[str]) -> bool:
+    """True iff the generated text's final answer matches any ground-truth
+    solution (each possibly wrapped in ``\\boxed``)."""
+    ans = extract_answer(generated)
+    if ans is None:
+        return False
+    for sol in solutions:
+        truth = extract_boxed(sol) or sol
+        if answers_equal(ans, truth):
+            return True
+    return False

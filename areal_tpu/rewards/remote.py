@@ -1,0 +1,71 @@
+"""Remote reward-sandbox client.
+
+Counterpart of ``functioncall/base/call.py`` + ``math/verify.py`` +
+``code/verify.py``: batched async HTTP calls to an external verifier
+service. Enabled via ``AREAL_ENABLE_FUNCTION_CALL=1`` +
+``AREAL_FUNCTIONCALL_SERVICE_DOMAIN`` (≈ the reference's
+``ENABLE_FUNCTION_CALL`` / ``FUNCTIONCALL_SERVICE_DOMAIN`` env gate,
+``realhf/impl/environment/math_code_single_step_env.py:16-18``).
+"""
+
+import asyncio
+import logging
+import os
+from typing import Any, Dict, List
+
+import aiohttp
+
+logger = logging.getLogger("areal_tpu.rewards.remote")
+
+ENABLED = os.environ.get("AREAL_ENABLE_FUNCTION_CALL", "0") == "1"
+
+
+def service_domain() -> str:
+    return os.environ.get("AREAL_FUNCTIONCALL_SERVICE_DOMAIN", "")
+
+
+async def batch_function_call(
+    payloads: List[Dict[str, Any]],
+    task_type: str,
+    timeout: float = 100.0,
+    concurrency: int = 10,
+) -> List[Any]:
+    """POST each payload to ``{domain}/{task_type}_verify``; order-preserving."""
+    url = f"{service_domain()}/{task_type}_verify"
+    sem = asyncio.Semaphore(concurrency)
+
+    async def one(session, payload):
+        async with sem:
+            try:
+                async with session.post(url, json=payload) as resp:
+                    resp.raise_for_status()
+                    return await resp.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                logger.warning("function call failed: %r", e)
+                return None
+
+    async with aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=timeout)
+    ) as session:
+        return list(
+            await asyncio.gather(*(one(session, p) for p in payloads))
+        )
+
+
+async def math_verify_remote(
+    answers: List[str], solutions: List[List[str]], qids: List[str]
+) -> List[bool]:
+    payloads = [
+        {"answer": a, "solutions": s, "qid": q}
+        for a, s, q in zip(answers, solutions, qids)
+    ]
+    results = await batch_function_call(payloads, "math")
+    return [bool(r and r.get("success")) for r in results]
+
+
+async def code_verify_remote(
+    codes: List[str], qids: List[str]
+) -> List[bool]:
+    payloads = [{"code": c, "qid": q} for c, q in zip(codes, qids)]
+    results = await batch_function_call(payloads, "code")
+    return [bool(r and r.get("success")) for r in results]

@@ -1,0 +1,9 @@
+"""Runtime/system layer: workers, streams, router, rollout orchestration.
+
+Counterpart of ``realhf/system/`` (SURVEY.md §2.3): the five worker roles of
+the async RL architecture. On TPU the "model worker" fleet collapses into one
+trainer worker per pjit program (the redistribution plane is just batch
+assembly), while the generation-side services (gserver manager, rollout
+worker, partial rollout) port structurally intact — they are device-agnostic
+asyncio/HTTP/ZMQ code.
+"""

@@ -1,0 +1,298 @@
+"""Generation-fleet manager: request router + staleness gate + weight updates.
+
+TPU-native counterpart of ``realhf/system/gserver_manager.py`` (496 LoC).
+Semantics ported faithfully (they are the heart of async RL):
+
+- **Routing** (``/schedule_request``, ≈ :375-408): round-robin /
+  least-requests / least-token-usage, sticky per (qid, version) so all group
+  samples of one prompt share a server and its prefix cache.
+- **Staleness gate** (``/allocate_rollout``, ≈ :417-452 + ``is_staled:351``):
+  ``expected_version = (trained_samples + running) // train_batch_size``;
+  reject when ``expected_version > max_head_offpolicyness + version`` or when
+  ``running >= max_concurrent_rollouts``.
+- **Weight sync** (≈ :131-190): polls the trainer's ``model_version`` key in
+  name_resolve; on bump, pauses/updates every server from the published
+  checkpoint dir, then prunes old checkpoint dirs (keeping the newest few).
+"""
+
+import asyncio
+import dataclasses
+import logging
+import os
+import shutil
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from aiohttp import web
+
+from areal_tpu.base import name_resolve, names
+from areal_tpu.gen.client import GenAPIClient
+
+logger = logging.getLogger("areal_tpu.gserver_manager")
+
+
+@dataclasses.dataclass
+class GserverManagerConfig:
+    """≈ the manager slice of ``realhf/api/core/system_api.py:134``."""
+
+    experiment_name: str = "exp"
+    trial_name: str = "trial"
+    model_name: str = "actor"
+    train_batch_size: int = 64
+    max_head_offpolicyness: int = 4
+    max_concurrent_rollouts: int = 128
+    schedule_policy: str = "round_robin"
+    flush_request_timeout: float = 300.0
+    n_checkpoints_to_keep: int = 2
+
+
+@dataclasses.dataclass
+class RolloutStat:
+    submitted: int = 0
+    running: int = 0
+    accepted: int = 0
+
+
+class GserverManager:
+    def __init__(self, config: GserverManagerConfig, server_urls: Optional[List[str]] = None):
+        self.config = config
+        self.server_urls: List[str] = server_urls or []
+        self.rollout_stat = RolloutStat()
+        self._qid_to_server: Dict[str, str] = {}
+        self._request_counts: Dict[str, int] = defaultdict(int)
+        self._token_usage: Dict[str, float] = defaultdict(float)
+        # per-qid accounting so finish_rollout can release exactly what the
+        # qid's schedule_request calls accumulated (chunks × group members)
+        self._qid_sched: Dict[str, Dict[str, float]] = {}
+        self._rr_next = 0
+        # -1 so the trainer's initial v0 snapshot is pushed to the fleet
+        # (check_new_params requires version > self.version)
+        self.version = -1
+        self._ckpt_dirs: List[str] = []
+        self._lock = asyncio.Lock()
+        self.app = web.Application()
+        self.app.router.add_post("/schedule_request", self._schedule_request)
+        self.app.router.add_post("/allocate_rollout", self._allocate_rollout)
+        self.app.router.add_post("/finish_rollout", self._finish_rollout)
+        self.app.router.add_post("/get_model_version", self._get_version)
+        self.app.router.add_get("/health", self._health)
+        self.app.router.add_get("/metrics_json", self._metrics)
+        self.app.on_startup.append(self._on_startup)
+        self.app.on_cleanup.append(self._on_cleanup)
+        self._poll_task: Optional[asyncio.Task] = None
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+
+    def discover_servers(self):
+        """Read generation-server URLs from name_resolve (≈ server discovery
+        at manager startup)."""
+        root = names.gen_servers(self.config.experiment_name, self.config.trial_name)
+        try:
+            self.server_urls = sorted(name_resolve.get_subtree(root))
+        except name_resolve.NameEntryNotFoundError:
+            self.server_urls = []
+        return self.server_urls
+
+    async def _on_startup(self, app):
+        self._poll_task = asyncio.get_event_loop().create_task(self._poll_weights())
+
+    async def _on_cleanup(self, app):
+        if self._poll_task:
+            self._poll_task.cancel()
+
+    def _training_samples(self) -> int:
+        name = names.training_samples(
+            self.config.experiment_name, self.config.trial_name
+        )
+        try:
+            return int(name_resolve.get(name))
+        except name_resolve.NameEntryNotFoundError:
+            return 0
+
+    def is_staled(self) -> bool:
+        global_cnt = self._training_samples() + self.rollout_stat.running
+        expected_version = global_cnt // self.config.train_batch_size
+        return expected_version > self.config.max_head_offpolicyness + max(
+            self.version, 0
+        )
+
+    # ------------------------------------------------------------------ #
+    # weight-update polling
+    # ------------------------------------------------------------------ #
+
+    async def _poll_weights(self, interval: float = 0.5):
+        while True:
+            try:
+                await self.check_new_params()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("weight poll failed")
+            await asyncio.sleep(interval)
+
+    async def check_new_params(self) -> Optional[str]:
+        """If the trainer published a newer version, update every server."""
+        name = names.model_version(
+            self.config.experiment_name, self.config.trial_name,
+            self.config.model_name,
+        )
+        try:
+            raw = name_resolve.get(name)
+        except name_resolve.NameEntryNotFoundError:
+            return None
+        version, _, path = raw.partition(":")
+        version = int(version)
+        if version <= self.version:
+            return None
+        await self.flush_and_update_weights(path, version)
+        self.version = version
+        self._ckpt_dirs.append(path)
+        self._prune_checkpoints()
+        return path
+
+    async def flush_and_update_weights(self, path: str, version: int):
+        async with GenAPIClient(timeout=self.config.flush_request_timeout) as c:
+            results = await asyncio.gather(
+                *(
+                    c.update_weights_from_disk(
+                        url, path, version=version, allow_interrupt=True
+                    )
+                    for url in self.server_urls
+                )
+            )
+        n_paused = sum(r.get("num_paused_requests", 0) for r in results)
+        for r in results:
+            if not r.get("success"):
+                raise RuntimeError(f"weight update failed: {r}")
+        logger.info(
+            "updated %d servers to v%d (%d requests interrupted)",
+            len(self.server_urls), version, n_paused,
+        )
+
+    def _prune_checkpoints(self):
+        while len(self._ckpt_dirs) > self.config.n_checkpoints_to_keep:
+            old = self._ckpt_dirs.pop(0)
+            shutil.rmtree(old, ignore_errors=True)
+
+    # ------------------------------------------------------------------ #
+    # handlers
+    # ------------------------------------------------------------------ #
+
+    def _pick_server(self, meta: dict) -> str:
+        if self.config.schedule_policy == "least_requests":
+            return min(self.server_urls, key=lambda u: self._request_counts[u])
+        if self.config.schedule_policy == "least_token_usage":
+            return min(self.server_urls, key=lambda u: self._token_usage[u])
+        url = self.server_urls[self._rr_next % len(self.server_urls)]
+        self._rr_next += 1
+        return url
+
+    async def _schedule_request(self, request: web.Request) -> web.Response:
+        meta = await request.json()
+        async with self._lock:
+            prev_url = meta.get("previous_server_url")
+            if prev_url and meta.get("previous_version") == self.version:
+                return web.json_response({"url": prev_url, "version": self.version})
+            qid = str(meta["qid"])
+            url = self._qid_to_server.get(qid)
+            if url is None:
+                url = self._pick_server(meta)
+                self._qid_to_server[qid] = url
+            tokens = meta.get("prompt_len", 0) + 0.4 * meta.get(
+                "new_token_budget", 0
+            ) * meta.get("group_size", 1)
+            self._request_counts[url] += 1
+            self._token_usage[url] += tokens
+            acct = self._qid_sched.setdefault(qid, {"url": url, "n": 0, "tokens": 0.0})
+            acct["n"] += 1
+            acct["tokens"] += tokens
+            return web.json_response({"url": url, "version": self.version})
+
+    async def _allocate_rollout(self, request: web.Request) -> web.Response:
+        await request.json()
+        async with self._lock:
+            has_capacity = (
+                self.rollout_stat.running < self.config.max_concurrent_rollouts
+            )
+            staled = self.is_staled()
+            if has_capacity and not staled:
+                self.rollout_stat.submitted += 1
+                self.rollout_stat.running += 1
+                return web.json_response({"success": True, "reason": ""})
+            reason = []
+            if not has_capacity:
+                reason.append(
+                    f"capacity: {self.rollout_stat.running} >= "
+                    f"{self.config.max_concurrent_rollouts}"
+                )
+            if staled:
+                cnt = self._training_samples() + self.rollout_stat.running
+                reason.append(
+                    f"staled: expected version "
+                    f"{cnt // self.config.train_batch_size} > "
+                    f"{self.config.max_head_offpolicyness} + {self.version}"
+                )
+            return web.json_response({"success": False, "reason": "; ".join(reason)})
+
+    async def _finish_rollout(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        async with self._lock:
+            qid = str(d["qid"])
+            # release everything this rollout accumulated — including
+            # multi-turn agents' suffixed sub-qids ("<qid>-tK")
+            for key in [qid] + [
+                k for k in self._qid_sched if k.startswith(f"{qid}-t")
+            ]:
+                acct = self._qid_sched.pop(key, None)
+                self._qid_to_server.pop(key, None)
+                if acct:
+                    url = acct["url"]
+                    self._request_counts[url] = max(
+                        0, self._request_counts[url] - acct["n"]
+                    )
+                    self._token_usage[url] = max(
+                        0.0, self._token_usage[url] - acct["tokens"]
+                    )
+            self.rollout_stat.running = max(0, self.rollout_stat.running - 1)
+            if d.get("accepted"):
+                self.rollout_stat.accepted += 1
+            return web.json_response({"success": True})
+
+    async def _get_version(self, request: web.Request) -> web.Response:
+        return web.json_response({"version": self.version})
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "version": self.version,
+                "submitted": self.rollout_stat.submitted,
+                "running": self.rollout_stat.running,
+                "accepted": self.rollout_stat.accepted,
+                "servers": self.server_urls,
+                "request_counts": dict(self._request_counts),
+            }
+        )
+
+
+async def serve_manager(
+    manager: GserverManager, host: str, port: int
+):
+    runner = web.AppRunner(manager.app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    # publish our address for rollout workers
+    name_resolve.add(
+        names.gserver_manager(
+            manager.config.experiment_name, manager.config.trial_name
+        ),
+        f"http://{host}:{port}",
+        replace=True,
+    )
+    return runner

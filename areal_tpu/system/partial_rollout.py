@@ -1,0 +1,177 @@
+"""Chunked (interruptible) generation client.
+
+Counterpart of ``realhf/system/partial_rollout.py`` (289 LoC): issue at most
+``new_tokens_per_chunk`` tokens per request so a weight update only ever
+interrupts one chunk; unfinished sequences are re-scheduled with their
+accumulated tokens and per-sample version tags (version_start/version_end)
+for staleness accounting; the n samples of one qid are grouped into one
+:class:`BundledGenerationOutputs`.
+"""
+
+import asyncio
+import dataclasses
+import logging
+import uuid
+from typing import Dict, List, Optional
+
+import aiohttp
+
+from areal_tpu.api.agent import BundledGenerationOutputs
+from areal_tpu.api.model import GenerationHyperparameters
+from areal_tpu.gen.client import GenAPIClient
+
+logger = logging.getLogger("areal_tpu.partial_rollout")
+
+
+class PartialRolloutManager:
+    def __init__(
+        self,
+        request_queue: asyncio.Queue,
+        reply_queue: asyncio.Queue,
+        gserver_manager_url: str,
+        new_tokens_per_chunk: int = 256,
+        timeout: float = 300.0,
+    ):
+        self.request_queue = request_queue
+        self.reply_queue = reply_queue
+        self.manager_url = gserver_manager_url
+        self.new_tokens_per_chunk = new_tokens_per_chunk
+        self.timeout = timeout
+        self._tasks: Dict[str, asyncio.Task] = {}
+
+    async def _schedule(
+        self,
+        session: aiohttp.ClientSession,
+        qid: str,
+        prompt_len: int,
+        group_size: int,
+        budget: int,
+        prev_url: Optional[str],
+        prev_version: Optional[int],
+    ):
+        async with session.post(
+            f"{self.manager_url}/schedule_request",
+            json={
+                "qid": qid,
+                "prompt_len": prompt_len,
+                "group_size": group_size,
+                "new_token_budget": budget,
+                "previous_server_url": prev_url,
+                "previous_version": prev_version,
+            },
+        ) as resp:
+            resp.raise_for_status()
+            d = await resp.json()
+        return d["url"], d["version"]
+
+    async def _gen_one(
+        self,
+        session: aiohttp.ClientSession,
+        client: GenAPIClient,
+        qid: str,
+        prompt_ids: List[int],
+        gconfig: GenerationHyperparameters,
+    ):
+        """Generate one group member with chunked re-scheduling."""
+        acc_out: List[int] = []
+        acc_lp: List[float] = []
+        version_start = -1
+        version_end = -1
+        prev_url = None
+        prev_version = None
+        no_eos = True
+        while len(acc_out) < gconfig.max_new_tokens:
+            url, version = await self._schedule(
+                session, qid, len(prompt_ids), gconfig.n,
+                gconfig.max_new_tokens, prev_url, prev_version,
+            )
+            prev_url, prev_version = url, version
+            chunk = min(
+                self.new_tokens_per_chunk, gconfig.max_new_tokens - len(acc_out)
+            )
+            try:
+                res = await client.generate(
+                    url,
+                    rid=f"{qid}-{uuid.uuid4().hex[:8]}",
+                    input_ids=prompt_ids + acc_out,
+                    sampling_params={
+                        "max_new_tokens": chunk,
+                        "min_new_tokens": max(
+                            0, gconfig.min_new_tokens - len(acc_out)
+                        ),
+                        "temperature": gconfig.temperature,
+                        "top_p": gconfig.top_p,
+                        "top_k": gconfig.top_k,
+                        "greedy": gconfig.greedy,
+                        "stop_token_ids": list(gconfig.stop_token_ids),
+                    },
+                )
+            except aiohttp.ClientResponseError as e:
+                if e.status == 400:
+                    # sequence hit the server's context capacity: treat as a
+                    # length truncation (≈ SGLang behavior on max context)
+                    logger.warning("generate rejected for %s: %s", qid, e)
+                    break
+                raise
+            acc_out.extend(res.output_ids)
+            acc_lp.extend(res.output_logprobs)
+            if version_start < 0:
+                version_start = res.version
+            version_end = res.version
+            if res.finish_reason == "stop":
+                no_eos = False
+                break
+            if res.finish_reason == "length" and len(res.output_ids) < chunk:
+                # fewer tokens than the chunk budget: the server capped the
+                # sequence at its KV capacity — do not resubmit
+                break
+            # "length" (chunk exhausted) or "interrupted": re-schedule with
+            # the accumulated tokens
+        return acc_out, acc_lp, no_eos, version_start, version_end
+
+    async def _handle_group(
+        self, qid: str, prompt_ids: List[int], gconfig: GenerationHyperparameters
+    ):
+        # Always deliver a bundle and release the task slot — a stuck agent
+        # would strand a manager capacity slot forever (finish_rollout never
+        # fires) and eventually deadlock the staleness gate.
+        try:
+            async with GenAPIClient(timeout=self.timeout) as client:
+                async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=self.timeout)
+                ) as session:
+                    results = await asyncio.gather(
+                        *(
+                            self._gen_one(session, client, qid, prompt_ids, gconfig)
+                            for _ in range(gconfig.n)
+                        )
+                    )
+        except Exception:
+            logger.exception("generation for qid %s failed", qid)
+            results = [([], [], True, -1, -1) for _ in range(gconfig.n)]
+        finally:
+            self._tasks.pop(qid, None)
+        bundle = BundledGenerationOutputs(
+            qid=qid,
+            prompt_ids=list(prompt_ids),
+            output_ids=[r[0] for r in results],
+            logprobs=[r[1] for r in results],
+            no_eos=[r[2] for r in results],
+            version_start=[r[3] for r in results],
+            version_end=[r[4] for r in results],
+        )
+        await self.reply_queue.put(bundle)
+
+    async def run_step(self):
+        """Drain pending observations and spawn generation tasks."""
+        while not self.request_queue.empty():
+            qid, prompt_ids, gconfig = self.request_queue.get_nowait()
+            assert qid not in self._tasks, f"duplicate qid {qid}"
+            self._tasks[qid] = asyncio.get_event_loop().create_task(
+                self._handle_group(str(qid), list(prompt_ids), gconfig)
+            )
+        await asyncio.sleep(0.002)
+
+    @property
+    def n_running(self) -> int:
+        return len(self._tasks)

@@ -1,0 +1,89 @@
+"""ZMQ JSON push/pull streams (rollout → trainer data plane).
+
+Counterpart of ``realhf/system/push_pull_stream.py`` (177 LoC): N rollout
+workers PUSH json trajectories, M trainer-side pullers PULL them; addresses
+rendezvous through name_resolve. Uses stdlib json (orjson is not in the
+image) — trajectory payloads are token-id lists, cheap either way.
+"""
+
+import json
+import logging
+from queue import Empty
+from typing import Any, Dict, List, Optional
+
+import zmq
+
+from areal_tpu.base import name_resolve, names, network
+
+logger = logging.getLogger("areal_tpu.push_pull_stream")
+
+
+class ZMQJsonPusher:
+    def __init__(self, host: str, port: int, hwm: int = 1000):
+        self.ctx = zmq.Context.instance()
+        self.sock = self.ctx.socket(zmq.PUSH)
+        self.sock.setsockopt(zmq.SNDHWM, hwm)
+        self.sock.connect(f"tcp://{host}:{port}")
+
+    def push(self, data: Any):
+        self.sock.send(json.dumps(data).encode("utf-8"), flags=0)
+
+    def close(self):
+        self.sock.close(linger=0)
+
+
+class ZMQJsonPuller:
+    def __init__(self, host: str, port: int, hwm: int = 1000, default_timeout_ms: int = 1000):
+        self.ctx = zmq.Context.instance()
+        self.sock = self.ctx.socket(zmq.PULL)
+        self.sock.setsockopt(zmq.RCVHWM, hwm)
+        self.sock.bind(f"tcp://{host}:{port}")
+        self.default_timeout_ms = default_timeout_ms
+
+    def pull(self, timeout_ms: Optional[int] = None) -> Any:
+        t = self.default_timeout_ms if timeout_ms is None else timeout_ms
+        if not self.sock.poll(t, zmq.POLLIN):
+            raise Empty()
+        return json.loads(self.sock.recv().decode("utf-8"))
+
+    def close(self):
+        self.sock.close(linger=0)
+
+
+def grouping(n_pushers: int, n_pullers: int) -> Dict[int, List[int]]:
+    """Assign pushers to pullers round-robin (≈ reference ``grouping:125``)."""
+    out: Dict[int, List[int]] = {i: [] for i in range(n_pullers)}
+    for i in range(n_pushers):
+        out[i % n_pullers].append(i)
+    return out
+
+
+class NameResolvingZmqPuller(ZMQJsonPuller):
+    """Binds a free port and publishes it under the stream name."""
+
+    def __init__(self, experiment_name: str, trial_name: str, puller_index: int, **kw):
+        host, port = network.gethostip(), network.find_free_port()
+        name = names.push_pull_stream(
+            experiment_name, trial_name, f"puller{puller_index}"
+        )
+        name_resolve.add(name, f"{host}:{port}", replace=True)
+        super().__init__("*", port, **kw)
+
+
+class NameResolvingZmqPusher(ZMQJsonPusher):
+    """Connects to its assigned puller (by pusher/puller grouping)."""
+
+    def __init__(
+        self, experiment_name: str, trial_name: str, pusher_index: int,
+        n_pushers: int, n_pullers: int, **kw,
+    ):
+        groups = grouping(n_pushers, n_pullers)
+        puller_index = next(
+            p for p, pushers in groups.items() if pusher_index in pushers
+        )
+        name = names.push_pull_stream(
+            experiment_name, trial_name, f"puller{puller_index}"
+        )
+        addr = name_resolve.wait(name, timeout=60)
+        host, port = addr.rsplit(":", 1)
+        super().__init__(host, int(port), **kw)

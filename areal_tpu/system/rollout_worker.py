@@ -1,0 +1,191 @@
+"""Rollout worker: async trajectory collection.
+
+Counterpart of ``realhf/system/rollout_worker.py`` (372 LoC): load prompts,
+gate each rollout through the gserver manager (capacity + staleness), run
+``agent.collect_trajectory`` tasks against the chunked-generation client,
+push accepted trajectories as JSON to the trainer-side pullers, and report
+completion. Structure ported intact — this layer is device-agnostic.
+"""
+
+import asyncio
+import logging
+from typing import Dict, List, Optional
+
+import aiohttp
+
+from areal_tpu.api.agent import Agent, make_agent
+from areal_tpu.api.data import SequenceSample
+from areal_tpu.api.env import EnvironmentService, make_env
+from areal_tpu.base import name_resolve, names
+from areal_tpu.system.partial_rollout import PartialRolloutManager
+from areal_tpu.system.push_pull_stream import NameResolvingZmqPusher
+
+logger = logging.getLogger("areal_tpu.rollout_worker")
+
+
+class RolloutWorker:
+    def __init__(
+        self,
+        experiment_name: str,
+        trial_name: str,
+        worker_index: int,
+        n_workers: int,
+        n_pullers: int,
+        agent: Agent,
+        env: EnvironmentService,
+        dataset,
+        new_tokens_per_chunk: int = 256,
+        max_concurrent_tasks: int = 16,
+        pusher: Optional[object] = None,
+        manager_url: Optional[str] = None,
+    ):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self.worker_index = worker_index
+        self.agent = agent
+        self.env = env
+        self.dataset = dataset
+        self.max_concurrent_tasks = max_concurrent_tasks
+        self.pusher = pusher or NameResolvingZmqPusher(
+            experiment_name, trial_name, worker_index, n_workers, n_pullers
+        )
+        self.manager_url = manager_url or name_resolve.wait(
+            names.gserver_manager(experiment_name, trial_name), timeout=300
+        )
+        self.obs_queue: asyncio.Queue = asyncio.Queue()
+        self._act_queues: Dict[str, asyncio.Queue] = {}
+        self.prm = PartialRolloutManager(
+            request_queue=self.obs_queue,
+            reply_queue=asyncio.Queue(),
+            gserver_manager_url=self.manager_url,
+            new_tokens_per_chunk=new_tokens_per_chunk,
+        )
+        self._tasks: Dict[str, asyncio.Task] = {}
+        self._data_iter_idx = 0
+        self._epoch = 0
+        self.push_cnt = 0
+        self.accepted_cnt = 0
+        self._used_qids: set = set()  # recover: skip already-consumed ids
+
+    # ------------------------------------------------------------------ #
+
+    def load_next_data(self) -> Optional[SequenceSample]:
+        """Round-robin over the (possibly filtered) dataset; epoch wraps
+        (≈ ``load_next_data:136`` epoch barrier, simplified: no barrier
+        across workers — the staleness gate provides backpressure)."""
+        if len(self.dataset) == 0:
+            return None
+        for _ in range(len(self.dataset)):
+            if self._data_iter_idx >= len(self.dataset):
+                self._data_iter_idx = 0
+                self._epoch += 1
+                self._used_qids.clear()  # entries are per-epoch; bound memory
+            sample = self.dataset[self._data_iter_idx]
+            self._data_iter_idx += 1
+            qid = sample.ids[0]
+            if f"{qid}@{self._epoch}" not in self._used_qids:
+                return sample
+        return None
+
+    async def allocate_new_rollout(self, session, qid) -> bool:
+        async with session.post(
+            f"{self.manager_url}/allocate_rollout", json={"qid": str(qid)}
+        ) as resp:
+            resp.raise_for_status()
+            d = await resp.json()
+            return bool(d["success"])
+
+    async def finish_rollout(self, session, qid, accepted: bool):
+        async with session.post(
+            f"{self.manager_url}/finish_rollout",
+            json={"qid": str(qid), "accepted": accepted},
+        ) as resp:
+            resp.raise_for_status()
+
+    async def _rollout_task(self, session, prompt: SequenceSample):
+        qid = str(prompt.ids[0])
+        try:
+            trajs = await self.agent.collect_trajectory(
+                prompt, self.env, self.obs_queue, self._route_queue(qid)
+            )
+            accepted = len(trajs) > 0
+            for t in trajs:
+                self.pusher.push(t.as_json_compatible())
+                self.push_cnt += 1
+            if accepted:
+                self.accepted_cnt += 1
+            await self.finish_rollout(session, qid, accepted)
+        except Exception:
+            logger.exception("rollout task %s failed", qid)
+            await self.finish_rollout(session, qid, False)
+        finally:
+            self._tasks.pop(qid, None)
+            self._act_queues.pop(qid, None)
+
+    def _route_queue(self, qid: str) -> asyncio.Queue:
+        q = self._act_queues.get(qid)
+        if q is None:
+            q = asyncio.Queue()
+            self._act_queues[qid] = q
+        return q
+
+    async def _dispatch_replies(self):
+        """Route bundles from the PRM back to the agent that asked.
+        Multi-turn agents use suffixed qids ("qid-tK"); route on the exact
+        qid the agent put on the obs queue."""
+        while True:
+            bundle = await self.prm.reply_queue.get()
+            qid = str(bundle.qid)
+            q = self._act_queues.get(qid)
+            if q is None:
+                # multi-turn agents suffix their obs qids with "-tK"
+                import re
+
+                base = re.sub(r"-t\d+$", "", qid)
+                q = self._act_queues.get(base)
+            if q is None:
+                logger.warning("no consumer for bundle %s", bundle.qid)
+                continue
+            await q.put(bundle)
+
+    async def run_async(self, max_steps: Optional[int] = None):
+        """Main poll loop (≈ ``_poll_async:204``)."""
+        dispatch = asyncio.get_event_loop().create_task(self._dispatch_replies())
+        steps = 0
+        carry: Optional[SequenceSample] = None  # denied sample, retried first
+        try:
+            async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=300)
+            ) as session:
+                while max_steps is None or steps < max_steps:
+                    steps += 1
+                    if len(self._tasks) < self.max_concurrent_tasks:
+                        prompt = carry if carry is not None else self.load_next_data()
+                        carry = None
+                        if prompt is not None:
+                            qid = str(prompt.ids[0])
+                            if qid in self._tasks:
+                                pass  # duplicate in flight; move on
+                            elif await self.allocate_new_rollout(session, qid):
+                                self._used_qids.add(f"{qid}@{self._epoch}")
+                                self._route_queue(qid)
+                                self._tasks[qid] = asyncio.get_event_loop().create_task(
+                                    self._rollout_task(session, prompt)
+                                )
+                            else:
+                                # gate closed (capacity/staleness): keep this
+                                # sample and back off instead of spinning
+                                # through the dataset (≈ the reference's
+                                # retry-same-sample behavior)
+                                carry = prompt
+                                await asyncio.sleep(0.05)
+                    await self.prm.run_step()
+        finally:
+            dispatch.cancel()
+
+    async def drain(self, timeout: float = 300.0):
+        """Wait for all in-flight rollout tasks to finish."""
+        if self._tasks:
+            await asyncio.wait(
+                list(self._tasks.values()), timeout=timeout
+            )

@@ -1,0 +1,323 @@
+"""Trainer worker: the training side of sync SFT and async PPO.
+
+TPU-native counterpart of the reference's master worker + model workers +
+function executor (``realhf/system/{master_worker,model_worker,
+function_executor,model_function_call}.py``, ~3k LoC). On TPU every model
+role is ONE pjit program over the trainer mesh, so the ZMQ request-reply
+mesh, the flush/syn-ack ordering protocol, and the NCCL redistribution plane
+collapse into a plain in-process call sequence over the MFC graph
+(SURVEY.md §2.2 "Data redistribution plane"):
+
+    rollout stream → [ref_inf, actor_inf(prox), critic_inf] → actor/critic train
+
+What is kept from the reference, semantically intact:
+- epoch/step accounting + save/ckpt/eval frequency control
+  (``EpochStepTimeFreqCtl``),
+- the trainer→fleet weight-sync channel: save HF snapshot →
+  ``name_resolve`` version bump (``model_worker.py:787-812``),
+- the ``training_samples`` counter feeding the manager's staleness gate,
+- RecoverInfo dumps for restart-the-world recovery.
+"""
+
+import dataclasses
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model import PPOHyperparameters, make_interface
+from areal_tpu.base import constants, name_resolve, names, recover
+from areal_tpu.base.metrics import MetricLogger
+from areal_tpu.base.timeutil import EpochStepTimeFreqCtl
+from areal_tpu.train.engine import TrainEngine
+
+logger = logging.getLogger("areal_tpu.trainer_worker")
+
+
+@dataclasses.dataclass
+class TrainerControl:
+    """Save/eval/ckpt cadence (≈ ``ExperimentSaveEvalControl``,
+    ``cli_args.py:702``)."""
+
+    total_train_steps: int = 100
+    save_freq_steps: Optional[int] = None        # HF export for the user
+    ckpt_freq_steps: Optional[int] = 50          # recover checkpoint
+    ckpt_freq_secs: Optional[float] = 600.0
+    weight_sync_freq_steps: int = 1              # fleet weight push cadence
+
+
+class AsyncPPOTrainerWorker:
+    """Consumes the rollout stream, runs the PPO MFC sequence per step."""
+
+    def __init__(
+        self,
+        experiment_name: str,
+        trial_name: str,
+        actor_engine: TrainEngine,
+        stream,                              # PullerStreamDataset-like
+        hp: PPOHyperparameters,
+        control: TrainerControl,
+        train_batch_size: int = 32,          # items (prompt groups) per step
+        mb_spec: Optional[MicroBatchSpec] = None,
+        ref_engine: Optional[TrainEngine] = None,
+        critic_engine: Optional[TrainEngine] = None,
+        hf_family: str = "qwen2",
+        metric_logger: Optional[MetricLogger] = None,
+    ):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self.actor_engine = actor_engine
+        self.ref_engine = ref_engine
+        self.critic_engine = critic_engine
+        self.stream = stream
+        self.hp = hp
+        self.control = control
+        self.train_batch_size = train_batch_size
+        self.mb_spec = mb_spec or MicroBatchSpec(max_tokens_per_mb=16384)
+        self.hf_family = hf_family
+        self.metrics = metric_logger
+
+        self.actor_if = make_interface("ppo_actor", hp=hp, hf_family=hf_family)
+        self.critic_if = (
+            make_interface("ppo_critic", hp=hp) if critic_engine else None
+        )
+        self.step = 0
+        self.samples_consumed = 0
+        self._buffer: List[SequenceSample] = []
+        self._ckpt_ctl = EpochStepTimeFreqCtl(
+            freq_step=control.ckpt_freq_steps, freq_sec=control.ckpt_freq_secs
+        )
+
+    # ------------------------------------------------------------------ #
+    # weight sync + counters (the async critical path, §3.5)
+    # ------------------------------------------------------------------ #
+
+    def publish_weights(self):
+        version = self.actor_engine.version
+        path = os.path.join(
+            constants.get_param_sync_root(), f"v{version}"
+        )
+        self.actor_engine.save_hf(path, self.hf_family)
+        name_resolve.add(
+            names.model_version(self.experiment_name, self.trial_name, "actor"),
+            f"{version}:{path}",
+            replace=True,
+        )
+        logger.info("published weights v%d -> %s", version, path)
+        return path
+
+    def _bump_training_samples(self, n: int):
+        self.samples_consumed += n
+        name_resolve.add(
+            names.training_samples(self.experiment_name, self.trial_name),
+            str(self.samples_consumed),
+            replace=True,
+        )
+
+    # ------------------------------------------------------------------ #
+    # data intake
+    # ------------------------------------------------------------------ #
+
+    def _collect_batch(self, timeout: float = 600.0) -> Optional[SequenceSample]:
+        t0 = time.time()
+        while len(self._buffer) < self.train_batch_size:
+            got = self.stream.get_batch(
+                self.train_batch_size - len(self._buffer), timeout=0.2
+            )
+            self._buffer.extend(got)
+            if time.time() - t0 > timeout:
+                if not self._buffer:
+                    return None
+                break
+        batch, self._buffer = (
+            self._buffer[: self.train_batch_size],
+            self._buffer[self.train_batch_size :],
+        )
+        # only token-aligned / per-seq keys the train MFCs consume — agent
+        # extras like packed_prompts/birth_time stay out of the device batch
+        # (≈ MFC input_keys, realhf/api/core/dfg.py:56)
+        train_keys = {
+            "packed_input_ids", "prompt_mask", "packed_logprobs",
+            "packed_ref_logprobs", "rewards", "seq_no_eos_mask",
+        }
+        keys = set.intersection(*(set(s.keys) for s in batch)) & train_keys
+        return SequenceSample.gather(batch, keys=keys)
+
+    # ------------------------------------------------------------------ #
+    # one training step = one MFC-graph traversal
+    # ------------------------------------------------------------------ #
+
+    def train_step(self, sample: SequenceSample) -> Dict[str, float]:
+        stats: Dict[str, float] = {}
+        # ref_inf: frozen reference logprobs (skipped when kl_ctl == 0)
+        if self.ref_engine is not None:
+            ref_out = self.actor_if.inference(self.ref_engine, sample, self.mb_spec)
+            ref_out.remap_keys_({"prox_logp": "packed_ref_logprobs"})
+            sample.update_(ref_out)
+        # critic_inf
+        if self.critic_if is not None:
+            sample.update_(
+                self.critic_if.inference(self.critic_engine, sample, self.mb_spec)
+            )
+        # actor_inf: proximal logprob recompute (decoupled loss)
+        if self.hp.use_decoupled_loss or self.hp.recompute_logprob:
+            sample.update_(
+                self.actor_if.inference(self.actor_engine, sample, self.mb_spec)
+            )
+        # train
+        stats.update(self.actor_if.train_step(self.actor_engine, sample, self.mb_spec))
+        if self.critic_if is not None:
+            stats.update(
+                self.critic_if.train_step(self.critic_engine, sample, self.mb_spec)
+            )
+        return stats
+
+    def run_step(self) -> Optional[Dict[str, float]]:
+        sample = self._collect_batch()
+        if sample is None:
+            return None
+        t0 = time.perf_counter()
+        stats = self.train_step(sample)
+        stats["timeperf/e2e"] = time.perf_counter() - t0
+        n_tokens = sum(
+            sum(inner) for inner in sample.seqlens[sample.main_key()]
+        )
+        stats["n_tokens"] = n_tokens
+        stats["n_seqs_consumed"] = sum(
+            len(inner) for inner in sample.seqlens[sample.main_key()]
+        )
+        self._bump_training_samples(int(stats["n_seqs_consumed"]))
+        self.step += 1
+
+        if self.step % self.control.weight_sync_freq_steps == 0:
+            self.publish_weights()
+        if (
+            self.control.save_freq_steps
+            and self.step % self.control.save_freq_steps == 0
+        ):
+            self.actor_if.save(
+                self.actor_engine,
+                os.path.join(constants.get_save_root(), f"step{self.step}"),
+            )
+        if self._ckpt_ctl.check(steps=1):
+            self.save_recover_checkpoint()
+        if self.metrics is not None:
+            self.metrics.log(
+                {k: v for k, v in stats.items() if np.isscalar(v)}, self.step,
+                prefix="ppo",
+            )
+        return stats
+
+    def run(self):
+        while self.step < self.control.total_train_steps:
+            if self.run_step() is None:
+                logger.warning("no data from rollout stream; stopping")
+                break
+        return self.step
+
+    # ------------------------------------------------------------------ #
+    # recovery (≈ master_worker.__recover_save:585)
+    # ------------------------------------------------------------------ #
+
+    def save_recover_checkpoint(self):
+        root = os.path.join(constants.get_recover_root(), "trainer")
+        self.actor_engine.save_checkpoint(os.path.join(root, "actor"))
+        if self.critic_engine is not None:
+            self.critic_engine.save_checkpoint(os.path.join(root, "critic"))
+        step_info = recover.StepInfo(
+            epoch=0, epoch_step=self.step, global_step=self.step
+        )
+        info = recover.RecoverInfo(
+            recover_start=step_info, last_step_info=step_info
+        )
+        recover.dump(info)
+
+    def load_recover_checkpoint(self) -> bool:
+        root = os.path.join(constants.get_recover_root(), "trainer")
+        info = recover.load()
+        if info is None or not os.path.exists(os.path.join(root, "actor")):
+            return False
+        self.actor_engine.load_checkpoint(os.path.join(root, "actor"))
+        if self.critic_engine is not None and os.path.exists(
+            os.path.join(root, "critic")
+        ):
+            self.critic_engine.load_checkpoint(os.path.join(root, "critic"))
+        self.step = info.recover_start.global_step
+        logger.info("recovered trainer at step %d", self.step)
+        return True
+
+
+class SFTTrainerWorker:
+    """Sync SFT loop (≈ ``main_sft.py`` path; BASELINE config #1)."""
+
+    def __init__(
+        self,
+        experiment_name: str,
+        trial_name: str,
+        engine: TrainEngine,
+        dataset,
+        control: TrainerControl,
+        batch_size: int = 32,
+        mb_spec: Optional[MicroBatchSpec] = None,
+        eval_dataset=None,
+        hf_family: str = "qwen2",
+        metric_logger: Optional[MetricLogger] = None,
+        shuffle_seed: int = 1,
+    ):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self.engine = engine
+        self.dataset = dataset
+        self.eval_dataset = eval_dataset
+        self.control = control
+        self.batch_size = batch_size
+        self.mb_spec = mb_spec or MicroBatchSpec(max_tokens_per_mb=16384)
+        self.hf_family = hf_family
+        self.metrics = metric_logger
+        self.interface = make_interface("sft")
+        self.step = 0
+        self.epoch = 0
+        self._shuffle_seed = shuffle_seed
+
+    def _epoch_batches(self):
+        idx = np.random.RandomState(self._shuffle_seed + self.epoch).permutation(
+            len(self.dataset)
+        )
+        for lo in range(0, len(idx), self.batch_size):
+            items = [self.dataset[i] for i in idx[lo : lo + self.batch_size]]
+            if items:
+                yield SequenceSample.gather(items)
+
+    def run(self):
+        if len(self.dataset) == 0:
+            logger.warning("empty SFT dataset; nothing to train")
+            return 0
+        while self.step < self.control.total_train_steps:
+            for batch in self._epoch_batches():
+                stats = self.interface.train_step(self.engine, batch, self.mb_spec)
+                self.step += 1
+                if self.metrics is not None:
+                    self.metrics.log(stats, self.step, prefix="sft")
+                if (
+                    self.control.save_freq_steps
+                    and self.step % self.control.save_freq_steps == 0
+                ):
+                    self.engine.save_hf(
+                        os.path.join(constants.get_save_root(), f"step{self.step}"),
+                        self.hf_family,
+                    )
+                if self.step >= self.control.total_train_steps:
+                    break
+            self.epoch += 1
+            if self.eval_dataset is not None:
+                items = [self.eval_dataset[i] for i in range(len(self.eval_dataset))]
+                ev = self.interface.evaluate(
+                    self.engine, [SequenceSample.gather(items)]
+                )
+                logger.info("epoch %d eval: %s", self.epoch, ev)
+                if self.metrics is not None:
+                    self.metrics.log(ev, self.step, prefix="sft_eval")
+        return self.step

@@ -24,8 +24,23 @@ import jax
 # JAX_PLATFORMS; the config update wins over both.
 jax.config.update("jax_platforms", "cpu")
 
+import asyncio
+import inspect
+
 import numpy as np
 import pytest
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal async-test support (pytest-asyncio is not in the image)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            k: pyfuncitem.funcargs[k] for k in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
 
 
 @pytest.fixture

@@ -1,0 +1,145 @@
+"""Gserver manager tests with stub generation servers.
+
+Counterpart of ``tests/system/test_gserver_manager.py``: scheduling policies,
+sticky qid routing, staleness gating, weight-update fan-out.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from areal_tpu.base import name_resolve, names
+from areal_tpu.system.gserver_manager import (
+    GserverManager,
+    GserverManagerConfig,
+)
+
+class StubGenServer:
+    """Mock generation server recording update_weights calls."""
+
+    def __init__(self):
+        self.update_calls = []
+        self.app = web.Application()
+        self.app.router.add_post(
+            "/update_weights_from_disk", self._update
+        )
+        self.app.router.add_get("/health", lambda r: web.json_response({}))
+
+    async def _update(self, request):
+        d = await request.json()
+        self.update_calls.append(d)
+        return web.json_response(
+            {"success": True, "message": "ok", "num_paused_requests": 2}
+        )
+
+
+@pytest.fixture
+def cfg():
+    name_resolve.reset()
+    return GserverManagerConfig(
+        experiment_name="t", trial_name="t", train_batch_size=4,
+        max_head_offpolicyness=1, max_concurrent_rollouts=3,
+    )
+
+
+async def _client(manager):
+    server = TestServer(manager.app)
+    client = TestClient(server)
+    await client.start_server()
+    return client
+
+
+async def test_round_robin_and_sticky(cfg):
+    m = GserverManager(cfg, server_urls=["http://a", "http://b"])
+    c = await _client(m)
+    urls = []
+    for i in range(4):
+        r = await c.post(
+            "/schedule_request",
+            json={"qid": f"q{i}", "prompt_len": 10, "group_size": 2,
+                  "new_token_budget": 100},
+        )
+        urls.append((await r.json())["url"])
+    assert urls == ["http://a", "http://b", "http://a", "http://b"]
+    # same qid → same server (sticky)
+    r = await c.post("/schedule_request", json={"qid": "q0", "prompt_len": 1,
+                                                "group_size": 1, "new_token_budget": 1})
+    assert (await r.json())["url"] == "http://a"
+    await c.close()
+
+
+async def test_least_requests_policy(cfg):
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, schedule_policy="least_requests")
+    m = GserverManager(cfg, server_urls=["http://a", "http://b"])
+    m._request_counts["http://a"] = 5
+    c = await _client(m)
+    r = await c.post("/schedule_request", json={"qid": "x", "prompt_len": 1,
+                                                "group_size": 1, "new_token_budget": 1})
+    assert (await r.json())["url"] == "http://b"
+    await c.close()
+
+
+async def test_staleness_gate(cfg):
+    m = GserverManager(cfg, server_urls=["http://a"])
+    c = await _client(m)
+    # version 0, batch 4, offpolicyness 1 => allow until
+    # (trained + running) // 4 > 1, i.e. 8 running
+    oks = []
+    for i in range(10):
+        r = await c.post("/allocate_rollout", json={"qid": f"q{i}"})
+        oks.append((await r.json())["success"])
+    # capacity cap (3) kicks in first here
+    assert oks[:3] == [True] * 3 and not any(oks[3:])
+    # free capacity: finish two; staleness then still allows more
+    for i in range(2):
+        await c.post("/finish_rollout", json={"qid": f"q{i}", "accepted": True})
+    r = await c.post("/allocate_rollout", json={"qid": "q10"})
+    assert (await r.json())["success"]
+
+    # trainer reports many consumed samples without version bump -> staled
+    name_resolve.add(
+        names.training_samples("t", "t"), "64", replace=True
+    )
+    r = await c.post("/allocate_rollout", json={"qid": "q11"})
+    d = await r.json()
+    assert not d["success"] and "staled" in d["reason"]
+
+    # version bump unblocks
+    m.version = 100
+    r = await c.post("/allocate_rollout", json={"qid": "q12"})
+    assert (await r.json())["success"]
+    await c.close()
+
+
+async def test_weight_update_fanout(cfg, tmp_path):
+    stubs = [StubGenServer(), StubGenServer()]
+    servers = []
+    urls = []
+    for s in stubs:
+        ts = TestServer(s.app)
+        await ts.start_server()
+        servers.append(ts)
+        urls.append(str(ts.make_url("")).rstrip("/"))
+    m = GserverManager(cfg, server_urls=urls)
+
+    ckpt = tmp_path / "v1"
+    ckpt.mkdir()
+    name_resolve.add(
+        names.model_version("t", "t", "actor"), f"1:{ckpt}", replace=True
+    )
+    path = await m.check_new_params()
+    assert path == str(ckpt)
+    assert m.version == 1
+    for s in stubs:
+        assert len(s.update_calls) == 1
+        assert s.update_calls[0]["model_path"] == str(ckpt)
+        assert s.update_calls[0]["allow_interrupt"] is True
+    # no re-update on same version
+    assert await m.check_new_params() is None
+    for ts in servers:
+        await ts.close()

@@ -1,0 +1,59 @@
+"""Math/code verifier tests (≈ reference ``tests/reward``)."""
+
+import pytest
+
+from areal_tpu.rewards import code_verify, math_verify
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [
+        (r"The answer is \boxed{42}.", "42"),
+        (r"Thus \boxed{\frac{1}{2}} holds", r"\frac{1}{2}"),
+        (r"nested \boxed{x^{2}+1}", "x^{2}+1"),
+        ("so the answer is 3/4", "3/4"),
+        ("we get 1, 2, and finally 7", "7"),
+        ("no numbers here", None),
+    ],
+)
+def test_extract_answer(text, expected):
+    assert math_verify.extract_answer(text) == expected
+
+
+@pytest.mark.parametrize(
+    "a,b,eq",
+    [
+        ("42", "42", True),
+        ("42.0", "42", True),
+        (r"\frac{1}{2}", "0.5", True),
+        ("1/2", "0.5", True),
+        ("0.33", "1/3", False),
+        ("x+1", "1+x", True),
+        ("2x", "x*2", True),
+        ("7", "8", False),
+    ],
+)
+def test_answers_equal(a, b, eq):
+    assert math_verify.answers_equal(a, b) == eq
+
+
+def test_verify_math_solution():
+    sol = [r"... the result is \boxed{\frac{3}{4}}"]
+    assert math_verify.verify_math_solution(r"I think \boxed{0.75}", sol)
+    assert not math_verify.verify_math_solution(r"I think \boxed{0.7}", sol)
+    assert not math_verify.verify_math_solution("gibberish", sol)
+
+
+def test_code_verify_pass_and_fail():
+    gen = "Here is my solution:\n```python\nn = int(input())\nprint(n * 2)\n```"
+    io = {"inputs": ["3\n", "10\n"], "outputs": ["6\n", "20\n"]}
+    assert code_verify.verify_code_solution(gen, io)
+    io_bad = {"inputs": ["3\n"], "outputs": ["7\n"]}
+    assert not code_verify.verify_code_solution(gen, io_bad)
+    assert not code_verify.verify_code_solution("no code here", io)
+
+
+def test_code_verify_timeout():
+    gen = "```python\nwhile True: pass\n```"
+    io = {"inputs": ["1\n"], "outputs": ["1\n"]}
+    assert not code_verify.verify_code_solution(gen, io, timeout=1.0)
