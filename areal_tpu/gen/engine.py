@@ -327,10 +327,24 @@ class GenerationEngine:
         self._jit_chunk[n_steps] = jitted
         return jitted
 
-    def _harvest(self, b: int, reason: str) -> GenOutput:
-        n = int(self.state.n_gen[b])
-        toks = np.asarray(self.state.out_tokens[b, :n]).tolist()
-        lps = np.asarray(self.state.out_logprobs[b, :n]).tolist()
+    def _harvest(
+        self, b: int, reason: str, host_state: Optional[dict] = None
+    ) -> GenOutput:
+        if host_state is not None:
+            n = int(host_state["n_gen"][b])
+            toks = host_state["out_tokens"][b, :n].tolist()
+            lps = host_state["out_logprobs"][b, :n].tolist()
+        else:
+            n, toks, lps = jax.device_get(
+                (
+                    self.state.n_gen[b],
+                    self.state.out_tokens[b],
+                    self.state.out_logprobs[b],
+                )
+            )
+            n = int(n)
+            toks = toks[:n].tolist()
+            lps = lps[:n].tolist()
         rid = self._slot_rid[b]
         self._slot_rid[b] = None
         self.state = dataclasses.replace(

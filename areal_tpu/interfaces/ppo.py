@@ -160,8 +160,8 @@ class PPOActorInterface(ModelInterface):
 
         # KL-penalized dense rewards + task reward at the *last action* token
         ref_kl = behav_lp - ref_lp.astype(jnp.float32)
-        self._last_ref_kl = float(
-            jnp.sum(jnp.where(mask, ref_kl, 0.0)) / jnp.maximum(mask.sum(), 1)
+        ref_kl_mean = jnp.sum(jnp.where(mask, ref_kl, 0.0)) / jnp.maximum(
+            mask.sum(), 1
         )
         kl_rw = jnp.where(mask, -self.kl_ctl.value * ref_kl, 0.0)
         nxt_mask = jnp.concatenate([mask[1:], jnp.zeros((1,), bool)])
@@ -197,9 +197,14 @@ class PPOActorInterface(ModelInterface):
         elif hp.adv_norm:
             adv = ppo_ops.masked_normalization(adv, mask)
 
-        return self._attach(sample, pb, adv, ret, kl_rw)
+        return self._attach(sample, pb, adv, ret, kl_rw, ref_kl_mean)
 
-    def _attach(self, sample, pb, adv, ret, kl_rw):
+    def _attach(self, sample, pb, adv, ret, kl_rw, ref_kl_mean):
+        # ONE device->host transfer for everything the host needs
+        adv, ret, kl_rw, ref_kl_mean = jax.device_get(
+            (adv, ret, kl_rw, ref_kl_mean)
+        )
+        self._last_ref_kl = float(ref_kl_mean)
         main = sample.main_key()
         seqlens = {"advantages": [list(l) for l in sample.seqlens[main]],
                    "returns": [list(l) for l in sample.seqlens[main]],
@@ -227,9 +232,13 @@ class PPOActorInterface(ModelInterface):
         mbs = sample.split(min(hp.ppo_n_minibatches, sample.bs))
         all_stats = []
         for mb in mbs:
-            stats = engine.train_batch(mb, mb_spec, self._actor_loss_fn)
+            stats = engine.train_batch(
+                mb, mb_spec, self._actor_loss_fn, fetch_stats=False
+            )
             all_stats.append(stats)
         engine.version += 1
+        # one host pull for every minibatch's device scalars
+        all_stats = jax.device_get(all_stats)
         out = {k: float(np.mean([s[k] for s in all_stats])) for k in all_stats[0]}
         # Adaptive KL control tracks policy-vs-reference divergence (the
         # signed masked mean over action tokens), like the reference
@@ -291,7 +300,9 @@ class PPOCriticInterface(ModelInterface):
         sample = self._actor_helper._prepare(sample)
         mbs = sample.split(min(hp.ppo_n_minibatches, sample.bs))
         all_stats = [
-            engine.train_batch(mb, mb_spec, self._critic_loss_fn) for mb in mbs
+            engine.train_batch(mb, mb_spec, self._critic_loss_fn, fetch_stats=False)
+            for mb in mbs
         ]
         engine.version += 1
+        all_stats = jax.device_get(all_stats)
         return {k: float(np.mean([s[k] for s in all_stats])) for k in all_stats[0]}

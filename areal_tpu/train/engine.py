@@ -40,6 +40,15 @@ LossFn = Callable[[Any, ModelConfig, Dict[str, jnp.ndarray]], Tuple[jnp.ndarray,
 OutputFn = Callable[[Any, ModelConfig, Dict[str, jnp.ndarray]], jnp.ndarray]
 
 
+def fetch_stats_dict(stats: Dict[str, Any]) -> Dict[str, float]:
+    """Pull every device scalar in one transfer (a per-scalar ``float()``
+    costs a full host round trip on remote accelerators)."""
+    host = jax.device_get(stats)
+    return {
+        k: (float(v) if np.ndim(v) == 0 else v) for k, v in host.items()
+    }
+
+
 @dataclasses.dataclass
 class OptimizerConfig:
     """≈ the reference's ``OptimizerConfig`` (``realhf/api/cli_args.py:173``)."""
@@ -169,6 +178,26 @@ class TrainEngine:
             )
         self._lr_sched = sched
 
+        # host-side mirror of the schedule: optax schedules return device
+        # scalars, and a device->host pull per step is expensive on remote
+        # accelerators
+        def lr_host(step: int) -> float:
+            import math
+
+            if step < warmup:
+                return oc.lr * step / warmup
+            if oc.lr_scheduler_type == "cosine":
+                total = max(total_train_steps, warmup + 1)
+                frac = min(max((step - warmup) / max(total - warmup, 1), 0.0), 1.0)
+                return end + 0.5 * (oc.lr - end) * (1 + math.cos(math.pi * frac))
+            if oc.lr_scheduler_type == "linear":
+                total = max(total_train_steps - warmup, 1)
+                frac = min((step - warmup) / total, 1.0)
+                return oc.lr + (end - oc.lr) * frac
+            return oc.lr
+
+        self._lr_host = lr_host
+
         def decay_mask(params):
             return jax.tree.map(lambda x: x.ndim >= 2, params)
 
@@ -279,11 +308,19 @@ class TrainEngine:
         loss_fn: LossFn,
         loss_weight_fn: Callable[[batching.PackedBatch], float] = None,
         version_steps: Optional[int] = None,
-    ) -> Dict[str, float]:
+        fetch_stats: bool = True,
+    ) -> Dict[str, Any]:
         """One optimizer step over the sample, accumulating grads across
         micro-batches. Micro-batch grads are weighted by ``loss_weight_fn``
-        (default: valid-token count) and normalized by the total weight —
-        i.e. a global token-mean loss, like the reference."""
+        (default: action-token count) and normalized by the total weight —
+        i.e. a global token-mean loss, like the reference.
+
+        Device->host transfers are batched into ONE ``device_get`` at the
+        end (each pull costs a full round trip on remote accelerators).
+        With ``fetch_stats=False`` the scalar stats stay on device — callers
+        looping over minibatches fetch once at the end via
+        :func:`fetch_stats`.
+        """
         assert self.tx is not None, "call setup_optimizer() first"
         if loss_weight_fn is None:
             loss_weight_fn = batching.count_action_tokens
@@ -306,11 +343,11 @@ class TrainEngine:
         self.params, self.opt_state, gnorm = apply(
             self.params, self.opt_state, acc
         )
-        lr = float(self._lr_sched(self._step))
+        lr = self._lr_host(self._step)
         self._step += 1
-        out = {
-            "loss": float(jnp.sum(jnp.stack(losses))),
-            "grad_norm": float(gnorm),
+        out: Dict[str, Any] = {
+            "loss": sum(losses),          # lazy device scalar
+            "grad_norm": gnorm,
             "lr": lr,
             "n_mbs": len(packed),
         }
@@ -318,10 +355,8 @@ class TrainEngine:
         for k in all_stats[0]:
             vals = [s[k] for s in all_stats]
             if all(np.ndim(v) == 0 for v in vals):
-                out[k] = float(
-                    sum(float(v) * w for v, w in zip(vals, weights)) / total_w
-                )
-        return out
+                out[k] = sum(v * w for v, w in zip(vals, weights)) / total_w
+        return fetch_stats_dict(out) if fetch_stats else out
 
     def eval_batch(
         self, sample: SequenceSample, mb_spec: MicroBatchSpec, loss_fn: LossFn
