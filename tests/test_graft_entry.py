@@ -1,0 +1,27 @@
+"""Regression tests for the driver entry points (``__graft_entry__``).
+
+Round-1 threw away a whole round of multi-chip signal because
+``dryrun_multichip`` never forced the virtual CPU platform (VERDICT.md
+"Next round" #1). These tests pin both entry points so they can't silently
+regress. Mirrors the reference's CPU-testability doctrine
+(``realhf/base/testing.py:48,137``).
+"""
+
+import numpy as np
+
+import __graft_entry__ as graft
+
+
+def test_dryrun_multichip_8():
+    # conftest already forces an 8-device CPU platform; dryrun must also
+    # work when run under it (idempotent env setup).
+    graft.dryrun_multichip(8)
+
+
+def test_entry_compiles_and_runs():
+    import jax
+
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    logits = jax.device_get(out)
+    assert np.all(np.isfinite(np.asarray(logits, np.float32)))
