@@ -64,18 +64,7 @@ def _load_engine(spec, is_critic=False, with_optimizer=True, total_steps=100):
         spec.optimizer if with_optimizer else None,
     )
     if spec.path:
-        eng.load_hf(spec.path)
-        if is_critic:
-            # CausalLM checkpoints carry no value head; critic head stays
-            # at its random init (≈ init_critic_from_actor)
-            import jax
-
-            from areal_tpu.models import transformer as tfm
-
-            head = tfm.init_params(cfg, jax.random.key(0))["head"]
-            eng.params = {**eng.params, "head": jax.device_put(
-                head, eng._param_shardings["head"]
-            )}
+        eng.load_hf(spec.path, init_critic_head=is_critic)
     else:
         eng.init_random(0)
     if with_optimizer:

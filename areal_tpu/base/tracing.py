@@ -1,0 +1,36 @@
+"""Env-gated jax.profiler tracing.
+
+TPU-native counterpart of the reference's ``REAL_DUMP_TRACE`` torch-profiler
+gating (``realhf/system/model_worker.py:79-94,828-909``): set
+``AREAL_DUMP_TRACE=1`` and every block wrapped in :func:`maybe_trace` dumps
+an xplane/chrome trace under ``$AREAL_FILEROOT/traces/<tag>`` (inspect with
+xprof / tensorboard-plugin-profile).
+"""
+
+import contextlib
+import os
+
+from areal_tpu.base import constants
+
+
+def trace_enabled() -> bool:
+    return os.environ.get(constants.TRACE_ENV, "0") not in ("", "0", "false")
+
+
+def trace_dir(tag: str) -> str:
+    root = os.environ.get("AREAL_FILEROOT", "/tmp/areal_tpu")
+    return os.path.join(root, "traces", tag)
+
+
+@contextlib.contextmanager
+def maybe_trace(tag: str):
+    """Wrap a step in ``jax.profiler.trace`` when AREAL_DUMP_TRACE is set."""
+    if not trace_enabled():
+        yield
+        return
+    import jax
+
+    d = trace_dir(tag)
+    os.makedirs(d, exist_ok=True)
+    with jax.profiler.trace(d):
+        yield

@@ -254,10 +254,20 @@ class PPOActorInterface(ModelInterface):
 class PPOCriticInterface(ModelInterface):
     hp: PPOHyperparameters = dataclasses.field(default_factory=PPOHyperparameters)
     hf_family: Optional[str] = None
+    # Share the ACTOR's controller here: with use_adaptive_kl the coefficient
+    # adapts every actor step, and the critic's value targets must be shaped
+    # with the same coefficient or they diverge from the actor's advantages
+    # (the reference shares one kl_adapter, ``ppo_interface.py``).
+    kl_ctl: Optional[object] = None
 
     def __post_init__(self):
-        self.kl_ctl = ppo_ops.FixedKLController(self.hp.kl_ctl)
+        if self.kl_ctl is None:
+            self.kl_ctl = ppo_ops.FixedKLController(self.hp.kl_ctl)
         self._actor_helper = PPOActorInterface(hp=self.hp)
+        # the helper only runs _prepare (reward shaping + GAE); its KL
+        # coefficient must track the shared controller, and its update()
+        # must never fire (the actor owns updates)
+        self._actor_helper.kl_ctl = self.kl_ctl
         hp = self.hp
 
         def critic_loss(params, cfg, arrays):

@@ -79,8 +79,16 @@ class ModelConfig:
     # Compute dtype for activations (params kept fp32 master in the optimizer)
     dtype: str = "bfloat16"
 
-    # Attention backend toggle (flash only on TPU)
-    use_flash_attention: bool = False
+    # Attention backend: None = auto (Pallas flash on TPU, XLA dense on CPU,
+    # where pallas only runs interpreted); True/False force it.
+    use_flash_attention: Optional[bool] = None
+
+    def flash_enabled(self) -> bool:
+        if self.use_flash_attention is None:
+            import jax
+
+            return jax.devices()[0].platform == "tpu"
+        return self.use_flash_attention
 
     @property
     def n_rep(self) -> int:

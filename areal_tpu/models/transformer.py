@@ -339,7 +339,7 @@ def forward_packed(
             softmax_scale=cfg.softmax_scale,
             soft_cap=cfg.attn_logits_soft_cap,
             sliding_window=cfg.sliding_window,
-            use_flash=cfg.use_flash_attention,
+            use_flash=cfg.flash_enabled(),
         )
         x = x + _attn_out(lp["attn"], ctx)
         h = _norm(cfg, lp["ln2"], x)

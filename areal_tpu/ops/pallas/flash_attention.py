@@ -1,24 +1,40 @@
-"""Packed varlen flash attention (Pallas TPU kernel).
+"""Packed varlen flash attention (Pallas TPU kernels, forward AND backward).
 
 Block-wise online-softmax attention over one packed token axis with
 segment-id masking — the TPU counterpart of the reference's
 ``flash_attn_varlen_func(cu_seqlens)`` path
-(``realhf/impl/model/modules/attn.py:272-289``).
+(``realhf/impl/model/modules/attn.py:272-289``), which trains through fused
+varlen flash in both directions.
 
-Layout: ``q [H, T, D]``-major inside the kernel (the public wrapper
-transposes from the model's ``[T, H, D]``). Grid is
-``(heads, q_blocks, k_blocks)`` with the k axis innermost — TPU grids run
-sequentially minor-to-major, so the VMEM scratch accumulators carry the
-online-softmax state (m, l, acc) across k blocks of one (head, q block).
-Causal + segment masking means k blocks strictly above the diagonal are
-skipped via ``pl.when`` (no FLOPs, no DMA use of the loaded block).
+Layout: ``q [H, T, D]``-major inside the kernels (the public wrapper
+transposes from the model's ``[T, H, D]``). TPU grids run sequentially
+minor-to-major, so VMEM scratch accumulators carry state across the
+innermost grid axis:
 
-GQA folds the query-head group into the kv head index via the BlockSpec
-index maps (no materialized K/V repeat).
+- **forward**: grid ``(H, nq, nk)``; online-softmax state (m, l, acc) per
+  (head, q block); also emits the logsumexp ``lse [H, T]`` for the backward.
+- **dq**: grid ``(H, nq, nk)``; recomputes p from (q, k, lse) per block and
+  accumulates ``dq += ds @ k``.
+- **dkv**: grid ``(Hkv, nk, n_rep, nq)``; for one kv-head k block,
+  accumulates ``dv += pᵀ dо`` and ``dk += dsᵀ q`` over every grouped q head
+  and q block (GQA: no materialized K/V repeat — the group is a grid axis).
 
-Backward: flash recompute backward is TODO (tracked for the perf pass); the
-custom_vjp here recomputes attention with the O(T²) XLA path, which remat
-confines to one layer at a time.
+**Band-limited iteration.** Packed rows carry non-decreasing segment ids
+(padding 0 at the tail), so the only (q block, k block) pairs with any
+unmasked work form a band: causal diagonal on one side, the first k block
+containing the q block's minimum segment (`kstart`, narrowed further by a
+sliding window) on the other. The band bounds ride in as scalar-prefetch
+operands and feed the BlockSpec index maps: out-of-band grid steps clamp to
+the previous block index, and Pallas skips the DMA entirely when the index
+map output repeats. Inside the band the kernels run unconditionally (the
+token-level mask handles block-edge partials), so skipped steps cost neither
+FLOPs nor HBM traffic.
+
+The backward follows the flash-attention-2 recipe: residuals are
+``(q, k, v, out, lse)``; ``delta = rowsum(dо * out)`` is computed in XLA
+(cheap elementwise reduce), and ``ds = p * (dp - delta)`` inside the kernel.
+All matmuls take bf16 operands with f32 accumulation (operand-side f32
+casts would quarter MXU throughput).
 """
 
 import functools
@@ -33,16 +49,74 @@ NEG_INF = -2.3819763e38
 LANES = 128
 
 
-def _flash_kernel(
-    seg_q_ref,  # [1, block_q] int32
-    seg_k_ref,  # [1, block_k] int32
-    q_ref,      # [1, block_q, D]
-    k_ref,      # [1, block_k, D]
-    v_ref,      # [1, block_k, D]
-    o_ref,      # [1, block_q, D]
-    m_scr,      # [block_q, LANES] f32
-    l_scr,      # [block_q, LANES] f32
-    acc_scr,    # [block_q, D] f32
+def _interpret() -> bool:
+    # off-TPU (CPU tests) the kernels run in the pallas interpreter
+    return jax.devices()[0].platform != "tpu"
+
+
+def _band_bounds(segment_ids, block_q, block_k, sliding_window, T):
+    """Per-block band bounds for the packed row (all int32):
+
+    - ``kstart [nq]``: first k block with any key the q block may attend to
+      (segment- and window-derived; can exceed the causal diagonal for
+      all-pad q blocks — callers clamp to it).
+    - ``qlast [nk]``: last q block with any query attending into the k block
+      (-1 when the k block is all padding).
+    """
+    nq, nk = T // block_q, T // block_k
+    BIG = jnp.int32(2**30)
+    sq = segment_ids.reshape(nq, block_q)
+    sk = segment_ids.reshape(nk, block_k)
+    qmin = jnp.where(sq > 0, sq, BIG).min(axis=1).astype(jnp.int32)
+    kmax = sk.max(axis=1).astype(jnp.int32)
+    # monotone prefix: pad-tail kmax drops to 0, so search on the running max
+    kmax_mono = jax.lax.associative_scan(jnp.maximum, kmax)
+    kstart = jnp.searchsorted(kmax_mono, qmin, side="left").astype(jnp.int32)
+    # qmin is globally non-decreasing (BIG on the pad tail)
+    qlast = (
+        jnp.searchsorted(qmin, kmax, side="right").astype(jnp.int32) - 1
+    )
+    qlast = jnp.where(kmax > 0, qlast, -1)
+    if sliding_window is not None:
+        iq = jnp.arange(nq, dtype=jnp.int32)
+        ik = jnp.arange(nk, dtype=jnp.int32)
+        kstart = jnp.maximum(
+            kstart,
+            jnp.maximum(iq * block_q - (sliding_window - 1), 0) // block_k,
+        )
+        qlast = jnp.minimum(
+            qlast, (ik * block_k + block_k - 1 + sliding_window - 1) // block_q
+        )
+    return kstart, qlast
+
+
+def _last_k(iq, block_q, block_k):
+    """Causal diagonal: last k block with keys not after this q block."""
+    return (iq * block_q + block_q - 1) // block_k
+
+
+def _first_q(ik, block_q, block_k):
+    """Causal diagonal: first q block with queries not before this k block."""
+    return (ik * block_k) // block_q
+
+
+# --------------------------------------------------------------------------- #
+# forward
+# --------------------------------------------------------------------------- #
+
+
+def _fwd_kernel(
+    kstart_ref,  # [nq] int32 scalar-prefetch
+    seg_q_ref,   # [1, block_q] int32
+    seg_k_ref,   # [1, block_k] int32
+    q_ref,       # [1, block_q, D]
+    k_ref,       # [1, block_k, D]
+    v_ref,       # [1, block_k, D]
+    o_ref,       # [1, block_q, D]
+    lse_ref,     # [1, 1, block_q, 1] f32 (column layout; see _flash_forward)
+    m_scr,       # [block_q, LANES] f32
+    l_scr,       # [block_q, LANES] f32
+    acc_scr,     # [block_q, D] f32
     *,
     scale: float,
     block_q: int,
@@ -51,31 +125,22 @@ def _flash_kernel(
     sliding_window: Optional[int],
 ):
     iq = pl.program_id(1)
-    ik = pl.program_id(2)
+    j = pl.program_id(2)
     nk = pl.num_programs(2)
+    ik = kstart_ref[iq] + j  # band-relative -> absolute k block
 
-    @pl.when(ik == 0)
+    @pl.when(j == 0)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # Causal block structure: block contributes iff its first k index can be
-    # <= the last q index of this q block.
-    diag_ok = ik * block_k <= iq * block_q + block_q - 1
-    in_window = True
-    if sliding_window is not None:
-        # skip blocks entirely left of the window
-        in_window = (iq * block_q) - (ik * block_k + block_k - 1) < sliding_window
-
-    @pl.when(diag_ok & in_window)
+    @pl.when(ik <= _last_k(iq, block_q, block_k))
     def _body():
-        q = q_ref[0].astype(jnp.float32)          # [bq, D]
-        k = k_ref[0].astype(jnp.float32)          # [bk, D]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale                                  # [bq, bk]
+        ) * scale                                  # [bq, bk] f32
         if soft_cap is not None:
             s = soft_cap * jnp.tanh(s / soft_cap)
         q_idx = iq * block_q + jax.lax.broadcasted_iota(
@@ -94,8 +159,10 @@ def _flash_kernel(
         m_prev = m_scr[:, 0:1]                     # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
-        # exp(NEG_INF - m) underflows to 0 for fully-masked rows
-        p = jnp.exp(s - m_new)                     # [bq, bk]
+        # NEG_INF is finite, so exp(s - m_new) is 1 (not 0) on fully-masked
+        # rows — zero masked entries explicitly so pad rows keep l == 0 and
+        # output 0, matching the XLA path.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)  # [bq, bk]
         corr = jnp.exp(m_prev - m_new)             # [bq, 1]
         l_new = corr * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
@@ -105,17 +172,29 @@ def _flash_kernel(
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    @pl.when(ik == nk - 1)
+    @pl.when(j == nk - 1)
     def _done():
         l = l_scr[:, 0:1]
         safe_l = jnp.where(l > 0.0, l, 1.0)
         o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+        # logsumexp residual; NEG_INF on fully-masked (pad) rows
+        lse = jnp.where(
+            l > 0.0, m_scr[:, 0:1] + jnp.log(safe_l), NEG_INF
+        )                                          # [bq, 1]
+        lse_ref[0, 0] = lse
 
 
 def _flash_forward(
     q, k, v, segment_ids, scale, soft_cap, sliding_window, block_q, block_k
 ):
-    """q: [H, T, D]; k, v: [Hkv, T, D]; segment_ids: [T] -> out [H, T, D]."""
+    """q: [H, T, D]; k, v: [Hkv, T, D]; segment_ids: [T]
+    -> (out [H, T, D], lse [H, T] f32).
+
+    The kernel-side lse layout is ``[H, nq, block_q, 1]`` — Mosaic requires
+    the last two block dims be (÷8, ÷128) or full, and a trailing size-1 lane
+    dim keeps per-q-block logsumexp columns addressable per (head, q block)
+    without a 128-lane broadcast buffer. It is compacted to ``[H, T]`` in XLA
+    right after the call, so the padded layout never persists as a residual."""
     H, T, D = q.shape
     Hkv = k.shape[0]
     n_rep = H // Hkv
@@ -124,47 +203,311 @@ def _flash_forward(
     assert T % block_q == 0 and T % block_k == 0, (T, block_q, block_k)
     grid = (H, T // block_q, T // block_k)
     seg2d = segment_ids.reshape(1, T)
+    kstart, _ = _band_bounds(segment_ids, block_q, block_k, sliding_window, T)
+
+    def kmap(h, i, j, kstart, r=n_rep):
+        return (
+            h // r,
+            jnp.minimum(kstart[i] + j, _last_k(i, block_q, block_k)),
+            0,
+        )
 
     kernel = functools.partial(
-        _flash_kernel,
+        _fwd_kernel,
         scale=scale,
         block_q=block_q,
         block_k=block_k,
         soft_cap=soft_cap,
         sliding_window=sliding_window,
     )
-    return pl.pallas_call(
+    out, lse4 = pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q), lambda h, i, j: (0, i)),
-            pl.BlockSpec((1, block_k), lambda h, i, j: (0, j)),
-            pl.BlockSpec((1, block_q, D), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec(
-                (1, block_k, D), lambda h, i, j, r=n_rep: (h // r, j, 0)
-            ),
-            pl.BlockSpec(
-                (1, block_k, D), lambda h, i, j, r=n_rep: (h // r, j, 0)
-            ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q), lambda h, i, j, ks: (0, i)),
+                pl.BlockSpec(
+                    (1, block_k),
+                    lambda h, i, j, ks: (
+                        0,
+                        jnp.minimum(ks[i] + j, _last_k(i, block_q, block_k)),
+                    ),
+                ),
+                pl.BlockSpec((1, block_q, D), lambda h, i, j, ks: (h, i, 0)),
+                pl.BlockSpec((1, block_k, D), kmap),
+                pl.BlockSpec((1, block_k, D), kmap),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, D), lambda h, i, j, ks: (h, i, 0)),
+                pl.BlockSpec(
+                    (1, 1, block_q, 1), lambda h, i, j, ks: (h, i, 0, 0)
+                ),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, LANES), jnp.float32),
+                pltpu.VMEM((block_q, LANES), jnp.float32),
+                pltpu.VMEM((block_q, D), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((H, T // block_q, block_q, 1), jnp.float32),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda h, i, j: (h, i, 0)),
+        interpret=_interpret(),
+    )(kstart, seg2d, seg2d, q, k, v)
+    return out, lse4.reshape(H, T)
+
+
+# --------------------------------------------------------------------------- #
+# backward
+# --------------------------------------------------------------------------- #
+
+
+def _recompute_p_ds(
+    q_ref, k_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, do_ref, v_ref,
+    iq, ik, *, scale, block_q, block_k, soft_cap, sliding_window,
+):
+    """Shared block math for both backward kernels: returns (p, ds_raw) with
+    ds_raw = dL/d(q·kᵀ) BEFORE the `scale` factor (folded in by callers)."""
+    s_raw = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                      # [bq, bk] f32
+    if soft_cap is not None:
+        t = jnp.tanh(s_raw / soft_cap)
+        s = soft_cap * t
+    else:
+        s = s_raw
+    q_idx = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_idx = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    seg_q = seg_q_ref[0][:, None]
+    seg_k = seg_k_ref[0][None, :]
+    mask = (q_idx >= k_idx) & (seg_q == seg_k) & (seg_q > 0)
+    if sliding_window is not None:
+        mask &= q_idx - k_idx < sliding_window
+    lse = lse_ref[0, 0]                            # [bq, 1]
+    # pad rows have lse == NEG_INF -> masked out anyway
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)     # [bq, bk]
+    dp = jax.lax.dot_general(
+        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                              # [bq, bk] f32
+    delta = delta_ref[0, 0]                        # [bq, 1]
+    ds = p * (dp - delta)                          # dL/ds
+    if soft_cap is not None:
+        ds = ds * (1.0 - t * t)                    # through the tanh cap
+    return p, ds
+
+
+def _dq_kernel(
+    kstart_ref,
+    seg_q_ref, seg_k_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
+    dq_ref,
+    dq_scr,     # [block_q, D] f32
+    *,
+    scale, block_q, block_k, soft_cap, sliding_window,
+):
+    iq = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+    ik = kstart_ref[iq] + j
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when(ik <= _last_k(iq, block_q, block_k))
+    def _body():
+        _, ds = _recompute_p_ds(
+            q_ref, k_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, do_ref,
+            v_ref, iq, ik, scale=scale, block_q=block_q, block_k=block_k,
+            soft_cap=soft_cap, sliding_window=sliding_window,
+        )
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nk - 1)
+    def _done():
+        dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    qlast_ref,
+    seg_q_ref, seg_k_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
+    dk_ref, dv_ref,
+    dk_scr,     # [block_k, D] f32
+    dv_scr,     # [block_k, D] f32
+    *,
+    scale, block_q, block_k, soft_cap, sliding_window, n_rep,
+):
+    # grid: (Hkv, nk, n_rep, nq) — nq innermost; the (hkv, nk) output block
+    # stays resident while every grouped q head and q block accumulates.
+    ik = pl.program_id(1)
+    ir = pl.program_id(2)
+    jq = pl.program_id(3)
+    nq = pl.num_programs(3)
+    iq = _first_q(ik, block_q, block_k) + jq
+
+    @pl.when((ir == 0) & (jq == 0))
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(iq <= qlast_ref[ik])
+    def _body():
+        p, ds = _recompute_p_ds(
+            q_ref, k_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, do_ref,
+            v_ref, iq, ik, scale=scale, block_q=block_q, block_k=block_k,
+            soft_cap=soft_cap, sliding_window=sliding_window,
+        )
+        # dv += pᵀ @ do ; dk += dsᵀ @ q  (bf16 operands, f32 accumulate)
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when((ir == pl.num_programs(2) - 1) & (jq == nq - 1))
+    def _done():
+        dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, segment_ids, out, lse, do,
+    scale, soft_cap, sliding_window, block_q, block_k,
+):
+    """All [H|Hkv, T, D]-layout. Returns (dq, dk, dv)."""
+    H, T, D = q.shape
+    Hkv = k.shape[0]
+    n_rep = H // Hkv
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    seg2d = segment_ids.reshape(1, T)
+    # delta_i = rowsum(do * out) — cheap elementwise reduce, stays in XLA
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [H, T]
+    # kernel-side column layout (see _flash_forward docstring)
+    nq = T // block_q
+    lse4 = lse.reshape(H, nq, block_q, 1)
+    delta4 = delta.reshape(H, nq, block_q, 1)
+    kstart, qlast = _band_bounds(
+        segment_ids, block_q, block_k, sliding_window, T
+    )
+
+    common = dict(
+        scale=scale, block_q=block_q, block_k=block_k, soft_cap=soft_cap,
+        sliding_window=sliding_window,
+    )
+
+    def dq_kj(h, i, j, ks, r=n_rep):
+        return (
+            h // r,
+            jnp.minimum(ks[i] + j, _last_k(i, block_q, block_k)),
+            0,
+        )
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **common),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(H, T // block_q, T // block_k),
+            in_specs=[
+                pl.BlockSpec((1, block_q), lambda h, i, j, ks: (0, i)),
+                pl.BlockSpec(
+                    (1, block_k),
+                    lambda h, i, j, ks: (
+                        0,
+                        jnp.minimum(ks[i] + j, _last_k(i, block_q, block_k)),
+                    ),
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_q, 1), lambda h, i, j, ks: (h, i, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_q, 1), lambda h, i, j, ks: (h, i, 0, 0)
+                ),
+                pl.BlockSpec((1, block_q, D), lambda h, i, j, ks: (h, i, 0)),
+                pl.BlockSpec((1, block_k, D), dq_kj),
+                pl.BlockSpec((1, block_k, D), dq_kj),
+                pl.BlockSpec((1, block_q, D), lambda h, i, j, ks: (h, i, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, block_q, D), lambda h, i, j, ks: (h, i, 0)
+            ),
+            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        ),
         out_shape=jax.ShapeDtypeStruct((H, T, D), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, LANES), jnp.float32),
-            pltpu.VMEM((block_q, LANES), jnp.float32),
-            pltpu.VMEM((block_q, D), jnp.float32),
+        interpret=_interpret(),
+    )(kstart, seg2d, seg2d, lse4, delta4, q, k, v, do)
+
+    def dkv_qi(ql, j, i):
+        # clip: qlast can be -1 (all-pad k block); the step is inactive then
+        return jnp.clip(
+            _first_q(j, block_q, block_k) + i, 0, (T // block_q) - 1
+        )
+
+    def qi3(h, j, r, i, ql, nr=n_rep):
+        return (h * nr + r, dkv_qi(ql, j, i), 0)
+
+    def qi4(h, j, r, i, ql, nr=n_rep):
+        return (h * nr + r, dkv_qi(ql, j, i), 0, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, **common, n_rep=n_rep),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(Hkv, T // block_k, n_rep, T // block_q),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, block_q), lambda h, j, r, i, ql: (0, dkv_qi(ql, j, i))
+                ),
+                pl.BlockSpec((1, block_k), lambda h, j, r, i, ql: (0, j)),
+                pl.BlockSpec((1, 1, block_q, 1), qi4),
+                pl.BlockSpec((1, 1, block_q, 1), qi4),
+                pl.BlockSpec((1, block_q, D), qi3),
+                pl.BlockSpec((1, block_k, D), lambda h, j, r, i, ql: (h, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda h, j, r, i, ql: (h, j, 0)),
+                pl.BlockSpec((1, block_q, D), qi3),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, D), lambda h, j, r, i, ql: (h, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda h, j, r, i, ql: (h, j, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, D), jnp.float32),
+                pltpu.VMEM((block_k, D), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((Hkv, T, D), k.dtype),
+            jax.ShapeDtypeStruct((Hkv, T, D), v.dtype),
         ],
-        # off-TPU (CPU tests) the kernel runs in the pallas interpreter
-        interpret=jax.devices()[0].platform != "tpu",
-    )(seg2d, seg2d, q, k, v)
+        interpret=_interpret(),
+    )(qlast, seg2d, seg2d, lse4, delta4, q, k, v, do)
+    return dq, dk, dv
 
 
-@functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
-)
+# --------------------------------------------------------------------------- #
+# custom-vjp entry ([T, H, D] public layout)
+# --------------------------------------------------------------------------- #
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def _flash_thd(q, k, v, segment_ids, scale, soft_cap, sliding_window, block_q, block_k):
     """[T, H, D]-layout entry with custom vjp."""
-    out = _flash_forward(
+    out, _ = _flash_forward(
         q.swapaxes(0, 1),
         k.swapaxes(0, 1),
         v.swapaxes(0, 1),
@@ -179,27 +522,21 @@ def _flash_thd(q, k, v, segment_ids, scale, soft_cap, sliding_window, block_q, b
 
 
 def _flash_fwd_rule(q, k, v, segment_ids, scale, soft_cap, sliding_window, block_q, block_k):
-    out = _flash_thd(q, k, v, segment_ids, scale, soft_cap, sliding_window, block_q, block_k)
-    return out, (q, k, v, segment_ids)
+    out, lse = _flash_forward(
+        q.swapaxes(0, 1), k.swapaxes(0, 1), v.swapaxes(0, 1), segment_ids,
+        scale, soft_cap, sliding_window, block_q, block_k,
+    )
+    return out.swapaxes(0, 1), (q, k, v, segment_ids, out, lse)
 
 
 def _flash_bwd_rule(scale, soft_cap, sliding_window, block_q, block_k, res, g):
-    # Recompute with the XLA path and differentiate it. Memory-heavy but
-    # remat-confined to one layer; the fused flash backward kernel is the
-    # planned perf-pass replacement.
-    from areal_tpu.ops.attention import _attention_xla
-
-    q, k, v, segment_ids = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _attention_xla(
-            q_, k_, v_, segment_ids, scale, soft_cap, sliding_window
-        ),
-        q,
-        k,
-        v,
+    q, k, v, segment_ids, out_htd, lse = res
+    dq, dk, dv = _flash_backward(
+        q.swapaxes(0, 1), k.swapaxes(0, 1), v.swapaxes(0, 1), segment_ids,
+        out_htd, lse, g.swapaxes(0, 1),
+        scale, soft_cap, sliding_window, block_q, block_k,
     )
-    dq, dk, dv = vjp(g)
-    return dq, dk, dv, None
+    return dq.swapaxes(0, 1), dk.swapaxes(0, 1), dv.swapaxes(0, 1), None
 
 
 _flash_thd.defvjp(_flash_fwd_rule, _flash_bwd_rule)

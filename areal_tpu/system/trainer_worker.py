@@ -82,7 +82,9 @@ class AsyncPPOTrainerWorker:
 
         self.actor_if = make_interface("ppo_actor", hp=hp, hf_family=hf_family)
         self.critic_if = (
-            make_interface("ppo_critic", hp=hp) if critic_engine else None
+            make_interface("ppo_critic", hp=hp, kl_ctl=self.actor_if.kl_ctl)
+            if critic_engine
+            else None
         )
         self.step = 0
         self.samples_consumed = 0

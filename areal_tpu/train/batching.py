@@ -169,13 +169,55 @@ def split_into_micro_batches(
     sample: SequenceSample, n_mbs: int, max_tokens_per_mb: Optional[int], n_rows: int
 ) -> List[SequenceSample]:
     """Seqlen-balanced micro-batch split (≈ reference ``data_api.split``):
-    at least ``n_mbs`` parts, further split so no part exceeds
-    ``max_tokens_per_mb * n_rows`` total tokens."""
+    at least ``n_mbs`` parts, split further until every part actually PACKS
+    within ``max_tokens_per_mb`` per row — the token budget only bounds the
+    average, and ``pack_sequences`` hard-fails when the LPT max row load
+    exceeds capacity, so a part must be validated with the same row planner
+    the packer uses. Sequences that can never fit a row are rejected loudly
+    here (data intake) rather than mid-training."""
     if max_tokens_per_mb is not None:
-        total = sum(
-            sum(inner) for inner in sample.seqlens[sample.main_key()]
-        )
+        seqlens = sample.seqlens[sample.main_key()]
+        longest = max((max(inner) for inner in seqlens), default=0)
+        if longest > max_tokens_per_mb:
+            raise ValueError(
+                f"A single sequence of {longest} tokens exceeds "
+                f"max_tokens_per_mb={max_tokens_per_mb}; it can never be "
+                "packed. Filter over-long sequences at data intake or raise "
+                "the micro-batch token budget."
+            )
+        total = sum(sum(inner) for inner in seqlens)
         budget = max_tokens_per_mb * n_rows
         n_mbs = max(n_mbs, -(-total // budget))
+        n_mbs = min(n_mbs, sample.bs)
+
+        def fits(parts: List[SequenceSample]) -> bool:
+            for part in parts:
+                lens = [
+                    int(n)
+                    for inner in part.seqlens[part.main_key()]
+                    for n in inner
+                ]
+                rows = plan_rows(lens, n_rows)
+                loads = [0] * n_rows
+                for ln, r in zip(lens, rows):
+                    loads[r] += ln
+                if loads and max(loads) > max_tokens_per_mb:
+                    return False
+            return True
+
+        while True:
+            parts = sample.split(n_mbs)
+            if fits(parts) or n_mbs >= sample.bs:
+                break
+            n_mbs += 1
+        if not fits(parts):
+            # every item is its own micro-batch and one still overflows:
+            # a grouped item packs >1 sequence per row past the budget
+            raise ValueError(
+                "Cannot split into micro-batches fitting "
+                f"max_tokens_per_mb={max_tokens_per_mb} with n_rows={n_rows}: "
+                "a single (grouped) item overflows a row on its own."
+            )
+        return parts
     n_mbs = min(n_mbs, sample.bs)
     return sample.split(n_mbs)

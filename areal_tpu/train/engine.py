@@ -91,9 +91,14 @@ class TrainEngine:
         parallel: ParallelConfig = ParallelConfig(),
         optimizer: Optional[OptimizerConfig] = None,
         mesh=None,
+        param_dtype: str = "float32",
     ):
         self.cfg = model_cfg
         self.parallel = parallel
+        # fp32 master params by default; "bfloat16" halves param+grad memory
+        # (fits ~1B-param models with Adam on one 16GB chip) at some
+        # optimizer-precision cost
+        self.param_dtype = jnp.dtype(param_dtype)
         self.mesh = mesh if mesh is not None else make_mesh(parallel)
         self.optimizer_cfg = optimizer
         self.params = None
@@ -107,6 +112,11 @@ class TrainEngine:
             self.mesh, tfm.param_logical_axes(model_cfg)
         )
         self._batch_sharding = NamedSharding(self.mesh, batch_pspec())
+        # stacked micro-batches [n_mbs, D, T, ...]: rows still spread over
+        # the data axes, the micro-batch axis unsharded (scanned over)
+        self._stacked_sharding = NamedSharding(
+            self.mesh, P(None, ("data", "fsdp"), None)
+        )
 
     # ------------------------------------------------------------------ #
     # Initialization
@@ -118,13 +128,19 @@ class TrainEngine:
 
     def init_random(self, seed: int = 0):
         init = jax.jit(
-            functools.partial(tfm.init_params, self.cfg),
+            functools.partial(tfm.init_params, self.cfg, dtype=self.param_dtype),
             out_shardings=self._param_shardings,
         )
         self.params = init(jax.random.key(seed))
         return self
 
-    def load_hf(self, path: str):
+    def load_hf(self, path: str, init_critic_head: bool = False):
+        """Load a HF CausalLM checkpoint. With ``init_critic_head``, any
+        [E, V] lm head is dropped and a random [E, 1] value head inserted
+        HOST-side (the critic's sharding tree always includes "head", so
+        patching after device_put would trip a pytree mismatch on
+        tied-embedding families — ≈ the reference's init_critic_from_actor).
+        """
         import json
         import os
 
@@ -134,11 +150,19 @@ class TrainEngine:
         with open(os.path.join(path, "config.json")) as f:
             model_type = json.load(f)["model_type"]
         self.hf_family = hf_conv.family_for_model_type(model_type).name
+        if init_critic_head:
+            host_params.pop("head", None)
+            rng = np.random.default_rng(0)
+            host_params["head"] = {
+                "weight": (
+                    rng.standard_normal((self.cfg.hidden_dim, 1)) * 0.02
+                ).astype(np.float32)
+            }
         return self.load_params(host_params)
 
     def load_params(self, host_params):
         host_params = jax.tree.map(
-            lambda x: np.asarray(x, np.float32), host_params
+            lambda x: np.asarray(x, self.param_dtype), host_params
         )
         self.params = jax.device_put(host_params, self._param_shardings)
         return self
@@ -229,27 +253,59 @@ class TrainEngine:
             return self._jit_cache[key][1]
         cfg = self.cfg
 
-        if kind == "grad_acc":
-
-            def grad_acc(params, acc, arrays, weight):
-                def lf(p):
+        if kind == "train_step":
+            # ONE dispatch per optimizer step: micro-batch grad accumulation
+            # via lax.scan over stacked [n_mbs, D, T] buffers, the optax
+            # update fused in, and scalar stats merged on device. Params and
+            # optimizer state are donated — XLA aliases them in place, so no
+            # param-sized copies and no extra dispatch latency (the reference
+            # reaches the same shape via Megatron DDP grad buckets +
+            # DistributedOptimizer, ``realhf/impl/model/backend/megatron.py``).
+            def train_step(params, opt_state, stacked, weights):
+                def loss_of(p, arrays, w):
                     loss, stats = fn(p, cfg, arrays)
-                    return loss * weight, stats
+                    return loss * w, (loss, stats)
 
-                (loss, stats), grads = jax.value_and_grad(lf, has_aux=True)(params)
-                acc = jax.tree.map(jnp.add, acc, grads)
-                return acc, loss, stats
+                grad_fn = jax.value_and_grad(loss_of, has_aux=True)
+                n_mbs = weights.shape[0]
+                if n_mbs == 1:
+                    arrays = jax.tree.map(lambda x: x[0], stacked)
+                    (_, (loss, stats)), grads = grad_fn(
+                        params, arrays, weights[0]
+                    )
+                    losses = loss[None]
+                    statss = jax.tree.map(lambda s: s[None], stats)
+                else:
+                    def body(acc, xs):
+                        arrays, w = xs
+                        (_, (loss, stats)), g = grad_fn(params, arrays, w)
+                        return jax.tree.map(jnp.add, acc, g), (loss, stats)
 
-            jitted = jax.jit(grad_acc, donate_argnums=(1,))
-        elif kind == "apply":
-
-            def apply(params, opt_state, grads):
+                    zeros = jax.tree.map(
+                        lambda x: jnp.zeros(x.shape, jnp.float32), params
+                    )
+                    grads, (losses, statss) = jax.lax.scan(
+                        body, zeros, (stacked, weights)
+                    )
+                # accumulation stays f32; the update sees param-dtype grads
+                # so optimizer-state dtypes never drift (bf16 params + n_mbs
+                # > 1 would otherwise promote Adam moments to f32 and break
+                # donation on the next call)
+                grads = jax.tree.map(
+                    lambda g, p: g.astype(p.dtype), grads, params
+                )
                 gnorm = optax.global_norm(grads)
                 updates, opt_state = self.tx.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
-                return params, opt_state, gnorm
+                out = {"loss": jnp.sum(losses * weights), "grad_norm": gnorm}
+                # micro-batch scalar stats -> weighted means (weights are
+                # already normalized to sum 1 by the caller)
+                for k, v in statss.items():
+                    if v.ndim == 1:
+                        out[k] = jnp.sum(v * weights)
+                return params, opt_state, out
 
-            jitted = jax.jit(apply, donate_argnums=(0, 1, 2))
+            jitted = jax.jit(train_step, donate_argnums=(0, 1))
         elif kind == "forward":
 
             def fwd(params, arrays):
@@ -267,24 +323,22 @@ class TrainEngine:
         self._jit_cache[key] = (fn, jitted)
         return jitted
 
-    def _zeros_like_params(self):
-        if "zeros" not in self._jit_cache:
-            self._jit_cache["zeros"] = (
-                None,
-                jax.jit(
-                    lambda p: jax.tree.map(
-                        lambda x: jnp.zeros(x.shape, jnp.float32), p
-                    ),
-                    out_shardings=self._param_shardings,
-                ),
-            )
-        return self._jit_cache["zeros"][1](self.params)
-
     def _put_batch(self, packed: batching.PackedBatch) -> Dict[str, jnp.ndarray]:
         return {
             k: jax.device_put(v, self._batch_sharding)
             for k, v in packed.arrays.items()
         }
+
+    def _put_stacked(
+        self, packed: List[batching.PackedBatch]
+    ) -> Dict[str, jnp.ndarray]:
+        """Stack per-micro-batch host buffers to [n_mbs, D, T, ...] and ship
+        them in one transfer."""
+        keys = packed[0].arrays.keys()
+        stacked = {
+            k: np.stack([pb.arrays[k] for pb in packed]) for k in keys
+        }
+        return jax.device_put(stacked, self._stacked_sharding)
 
     def _make_micro_batches(
         self, sample: SequenceSample, mb_spec: MicroBatchSpec, capacity=None
@@ -293,9 +347,20 @@ class TrainEngine:
             sample, mb_spec.n_mbs, mb_spec.max_tokens_per_mb, self.n_rows
         )
         cap = capacity or mb_spec.max_tokens_per_mb
-        return mbs, [
+        packed = [
             batching.pack_sequences(mb, self.n_rows, capacity=cap) for mb in mbs
         ]
+        if cap is None and len(packed) > 1:
+            # uniform capacity so micro-batches stack into one [n_mbs, D, T]
+            # buffer (and share one compiled step)
+            cap = max(pb.capacity for pb in packed)
+            packed = [
+                pb
+                if pb.capacity == cap
+                else batching.pack_sequences(mb, self.n_rows, capacity=cap)
+                for mb, pb in zip(mbs, packed)
+            ]
+        return mbs, packed
 
     # ------------------------------------------------------------------ #
     # PipelinableEngine API (≈ model_api.py:514)
@@ -310,52 +375,37 @@ class TrainEngine:
         version_steps: Optional[int] = None,
         fetch_stats: bool = True,
     ) -> Dict[str, Any]:
-        """One optimizer step over the sample, accumulating grads across
-        micro-batches. Micro-batch grads are weighted by ``loss_weight_fn``
-        (default: action-token count) and normalized by the total weight —
-        i.e. a global token-mean loss, like the reference.
+        """One optimizer step over the sample — ONE jit dispatch: grads are
+        accumulated across micro-batches by a ``lax.scan`` inside the
+        compiled step and the optax update is fused in. Micro-batch grads
+        are weighted by ``loss_weight_fn`` (default: action-token count) and
+        normalized by the total weight — i.e. a global token-mean loss, like
+        the reference.
 
         Device->host transfers are batched into ONE ``device_get`` at the
         end (each pull costs a full round trip on remote accelerators).
         With ``fetch_stats=False`` the scalar stats stay on device — callers
         looping over minibatches fetch once at the end via
-        :func:`fetch_stats`.
+        :func:`fetch_stats_dict`.
         """
         assert self.tx is not None, "call setup_optimizer() first"
         if loss_weight_fn is None:
             loss_weight_fn = batching.count_action_tokens
         _, packed = self._make_micro_batches(sample, mb_spec)
-        weights = [loss_weight_fn(pb) for pb in packed]
-        total_w = sum(weights) or 1.0
+        weights = np.asarray([loss_weight_fn(pb) for pb in packed], np.float32)
+        total_w = weights.sum() or 1.0
+        weights = weights / total_w
 
-        grad_acc = self._get_jitted("grad_acc", loss_fn)
-        apply = self._get_jitted("apply", loss_fn)
-        acc = self._zeros_like_params()
-        losses = []
-        all_stats: List[Dict] = []
-        for pb, w in zip(packed, weights):
-            arrays = self._put_batch(pb)
-            acc, loss, stats = grad_acc(
-                self.params, acc, arrays, jnp.float32(w / total_w)
-            )
-            losses.append(loss)
-            all_stats.append(stats)
-        self.params, self.opt_state, gnorm = apply(
-            self.params, self.opt_state, acc
+        step = self._get_jitted("train_step", loss_fn)
+        stacked = self._put_stacked(packed)
+        self.params, self.opt_state, out = step(
+            self.params, self.opt_state, stacked, jnp.asarray(weights)
         )
         lr = self._lr_host(self._step)
         self._step += 1
-        out: Dict[str, Any] = {
-            "loss": sum(losses),          # lazy device scalar
-            "grad_norm": gnorm,
-            "lr": lr,
-            "n_mbs": len(packed),
-        }
-        # merge scalar stats from micro-batches (means weighted by mb weight)
-        for k in all_stats[0]:
-            vals = [s[k] for s in all_stats]
-            if all(np.ndim(v) == 0 for v in vals):
-                out[k] = sum(v * w for v, w in zip(vals, weights)) / total_w
+        out = dict(out)
+        out["lr"] = lr
+        out["n_mbs"] = len(packed)
         return fetch_stats_dict(out) if fetch_stats else out
 
     def eval_batch(

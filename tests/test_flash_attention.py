@@ -72,3 +72,55 @@ def test_flash_gradients_match(rng):
     g2 = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_flash_pad_rows_are_zero(rng):
+    """Fully-padded query rows must output exactly 0, like the XLA path
+    (ADVICE round 1: finite NEG_INF made exp(s - m) == 1 on masked rows)."""
+    T, H, Hkv, D = 256, 2, 2, 8
+    q, k, v, seg = _mk(rng, T, H, Hkv, D, [100])  # 156 pad tokens
+    got = np.asarray(
+        packed_flash_attention(q, k, v, seg, softmax_scale=D**-0.5, block_size=128)
+    )
+    pad = np.asarray(seg) == 0
+    np.testing.assert_array_equal(got[pad], 0.0)
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        dict(),                         # plain causal
+        dict(sliding_window=64),        # windowed
+        dict(soft_cap=20.0),            # logit soft-cap (gemma2-style)
+    ],
+)
+def test_flash_bwd_matches_xla_multiblock(rng, kwargs):
+    """Pallas backward kernels vs XLA autodiff: GQA (n_rep=3), multiple
+    q/k blocks, padding, uneven segments."""
+    T, H, Hkv, D = 384, 6, 2, 16
+    q, k, v, seg = _mk(rng, T, H, Hkv, D, [100, 156, 60])  # 68 pad tokens
+    scale = D**-0.5
+
+    def loss(attn):
+        def f(q, k, v):
+            o = attn(q, k, v)
+            w = jnp.asarray(
+                np.linspace(0.5, 1.5, o.size).reshape(o.shape), jnp.float32
+            )
+            return jnp.sum(jnp.where((seg > 0)[:, None, None], o * w, 0.0))
+        return f
+
+    g1 = jax.grad(
+        loss(lambda q, k, v: packed_flash_attention(
+            q, k, v, seg, softmax_scale=scale, block_size=128, **kwargs
+        )),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g2 = jax.grad(
+        loss(lambda q, k, v: _attention_xla(q, k, v, seg, scale, **kwargs)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4
+        )

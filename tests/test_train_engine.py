@@ -142,3 +142,34 @@ def test_checkpoint_roundtrip(engine, rng, tmp_path):
     engine.load_checkpoint(path)
     after = engine.eval_batch(sample, MicroBatchSpec(), _sft_loss)["loss"]
     assert before == pytest.approx(after, rel=1e-6)
+
+
+def test_micro_batch_split_respects_row_capacity():
+    """ADVICE round 1 (medium): the token budget only bounded the average;
+    a [16000, 500, 16000] batch with budget 16384 crashed the packer."""
+    from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+    from areal_tpu.train import batching
+
+    lens = [16000, 500, 16000]
+    sample = SequenceSample.from_default(
+        ids=[0, 1, 2],
+        seqlens=lens,
+        data={"packed_input_ids": np.zeros(sum(lens), np.int64)},
+    )
+    parts = batching.split_into_micro_batches(
+        sample, n_mbs=1, max_tokens_per_mb=16384, n_rows=1
+    )
+    for part in parts:
+        pb = batching.pack_sequences(part, n_rows=1, capacity=16384)
+        assert pb.capacity == 16384
+
+    # a single over-long sequence is rejected at intake with a clear error
+    big = SequenceSample.from_default(
+        ids=[0],
+        seqlens=[20000],
+        data={"packed_input_ids": np.zeros(20000, np.int64)},
+    )
+    with pytest.raises(ValueError, match="can never be packed"):
+        batching.split_into_micro_batches(
+            big, n_mbs=1, max_tokens_per_mb=16384, n_rows=1
+        )
