@@ -213,6 +213,12 @@ def rollout_worker_main(cfg, worker_idx: int):
 
 def trainer_main(cfg):
     _setup_worker_env(cfg, cfg.trainer_device)
+    # pod-scale runs: each host's launcher sets AREAL_COORDINATOR/_NUM_
+    # PROCESSES/_PROCESS_ID (or AREAL_COORDINATOR=auto on Cloud TPU) and the
+    # trainer joins the jax.distributed world before building its mesh
+    from areal_tpu.parallel import multihost
+
+    multihost.maybe_initialize_from_env()
     from areal_tpu.base import constants
     from areal_tpu.base.metrics import MetricLogger
     from areal_tpu.system.stream_dataset import PullerStreamDataset

@@ -14,8 +14,10 @@ from typing import Optional, Sequence
 from areal_tpu.models.config import ModelConfig
 
 
-def param_count(cfg: ModelConfig) -> int:
-    """Dense-equivalent parameter count (embeddings included once)."""
+def param_count(cfg: ModelConfig, activated: bool = False) -> int:
+    """Total parameter count (embeddings included once). With ``activated``,
+    MoE layers count only the ``top_k`` experts a token actually routes
+    through — the per-token FLOP proxy (total ≠ activated for MoE)."""
     E, D = cfg.hidden_dim, cfg.head_dim
     L, V, F = cfg.n_layers, cfg.vocab_size, cfg.intermediate_dim
     attn = E * (cfg.n_q_heads * D) + 2 * E * (cfg.n_kv_heads * D) + (
@@ -24,7 +26,8 @@ def param_count(cfg: ModelConfig) -> int:
     if cfg.mlp_type == "gated":
         mlp = 3 * E * F
     elif cfg.mlp_type == "moe":
-        mlp = cfg.moe.num_experts * 3 * E * F + E * cfg.moe.num_experts
+        n_active = cfg.moe.top_k if activated else cfg.moe.num_experts
+        mlp = n_active * 3 * E * F + E * cfg.moe.num_experts
     else:
         mlp = 2 * E * F
     per_layer = attn + mlp
@@ -41,7 +44,7 @@ def train_flops(
     (backward ≈ 2x forward for matmuls; attention backward ≈ 2.5x its
     forward). ``seqlens`` sharpens the attention term; without it the
     attention cost is omitted (matmul-dominated models)."""
-    fwd = 2 * param_count(cfg) * n_tokens
+    fwd = 2 * param_count(cfg, activated=True) * n_tokens
     attn_fwd = 0.0
     if seqlens:
         D = cfg.head_dim
@@ -56,7 +59,7 @@ def forward_flops(
     n_tokens: int,
     seqlens: Optional[Sequence[int]] = None,
 ) -> float:
-    fwd = 2 * param_count(cfg) * n_tokens
+    fwd = 2 * param_count(cfg, activated=True) * n_tokens
     attn_fwd = 0.0
     if seqlens:
         D, H = cfg.head_dim, cfg.n_q_heads

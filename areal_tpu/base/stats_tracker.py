@@ -5,11 +5,12 @@ masks) so means are computed over exactly the tokens/sequences that matter.
 Scopes compose hierarchically (``with tracker.scope("actor")``). ``export``
 reduces everything to plain python floats.
 
-In the reference, export performs a torch.distributed all-reduce; here the
-trainer is a single pjit program per host group, so values arriving at the
-tracker are already global (device arrays are converted via ``np.asarray``).
-Cross-process aggregation, when needed, happens at the master via metadata
-messages.
+In the reference, export performs a torch.distributed all-reduce
+(``realhf/base/stats_tracker.py:20``); here values recorded from inside pjit
+are already global, but HOST-side stats (reward scores, rollout latencies,
+python counters) are process-local. ``export(cross_host=True)`` reduces those
+across processes: each key's partial (numerator, denominator) or (min, max)
+pair is allgathered and combined, after a loud key-agreement check.
 """
 
 import contextlib
@@ -96,35 +97,72 @@ class DistributedStatsTracker:
             )
             self._meta[key] = dict(reduce_type=ReduceType.SCALAR)
 
-    def export(self, reset: bool = True) -> Dict[str, float]:
-        result: Dict[str, float] = {}
+    def _partials(self) -> Dict[str, tuple]:
+        """Per-key reduction partials: (reduce_type, a, b) where
+        AVG/SCALAR -> (weighted sum, count); SUM -> (sum, 0);
+        MIN/MAX -> (extreme, valid-count)."""
+        out: Dict[str, tuple] = {}
         for key, values in self._stats.items():
-            meta = self._meta[key]
-            rt = meta.get("reduce_type", ReduceType.SCALAR)
+            rt = self._meta[key].get("reduce_type", ReduceType.SCALAR)
             if rt == ReduceType.SCALAR:
-                result[key] = float(np.mean([v for v in values]))
+                out[key] = (rt, float(np.sum(values)), float(len(values)))
                 continue
             vcat = np.concatenate([v.reshape(-1) for v, _ in values])
             mcat = np.concatenate([m.reshape(-1) for _, m in values])
-            n = mcat.sum()
-            if rt == ReduceType.AVG:
-                result[key] = float((vcat * mcat).sum() / max(n, 1))
-            elif rt == ReduceType.SUM:
-                result[key] = float((vcat * mcat).sum())
+            n = float(mcat.sum())
+            if rt in (ReduceType.AVG, ReduceType.SUM):
+                out[key] = (rt, float((vcat * mcat).sum()), n)
             elif rt == ReduceType.MIN:
-                result[key] = float(
-                    np.where(mcat, vcat, np.inf).min()
-                ) if n else 0.0
+                out[key] = (rt, float(np.where(mcat, vcat, np.inf).min()) if n else np.inf, n)
             elif rt == ReduceType.MAX:
-                result[key] = float(
-                    np.where(mcat, vcat, -np.inf).max()
-                ) if n else 0.0
+                out[key] = (rt, float(np.where(mcat, vcat, -np.inf).max()) if n else -np.inf, n)
         for key, masks in self._denominators.items():
-            result[f"{key}/n"] = float(sum(m.sum() for m in masks))
+            out[f"{key}/n"] = (ReduceType.SUM, float(sum(m.sum() for m in masks)), 0.0)
+        return out
+
+    def export(self, reset: bool = True, cross_host: bool = False) -> Dict[str, float]:
+        parts = self._partials()
+        if cross_host:
+            parts = _cross_host_reduce(parts)
+        result: Dict[str, float] = {}
+        for key, (rt, a, b) in parts.items():
+            if rt in (ReduceType.AVG, ReduceType.SCALAR):
+                result[key] = a / max(b, 1)
+            elif rt == ReduceType.SUM:
+                result[key] = a
+            elif rt == ReduceType.MIN:
+                result[key] = a if b else 0.0
+            elif rt == ReduceType.MAX:
+                result[key] = a if b else 0.0
         if reset:
             self._stats.clear()
             self._denominators.clear()
         return result
+
+
+def _cross_host_reduce(parts: Dict[str, tuple]) -> Dict[str, tuple]:
+    """Combine per-process partials across all processes (no-op single-host).
+    Keys must agree across processes — divergence raises instead of silently
+    skewing metrics."""
+    from areal_tpu.parallel import multihost
+
+    if not multihost.is_multihost():
+        return parts
+    keys = sorted(parts)
+    multihost.assert_same_across_hosts("stats_tracker keys", "\x00".join(keys))
+    mat = np.asarray([[parts[k][1], parts[k][2]] for k in keys], np.float64)
+    gathered = multihost.allgather_rows(mat)  # [P, n_keys, 2]
+    out: Dict[str, tuple] = {}
+    for i, k in enumerate(keys):
+        rt = parts[k][0]
+        a_all, b_all = gathered[:, i, 0], gathered[:, i, 1]
+        if rt == ReduceType.MIN:
+            out[k] = (rt, float(a_all.min()), float(b_all.sum()))
+        elif rt == ReduceType.MAX:
+            out[k] = (rt, float(a_all.max()), float(b_all.sum()))
+        else:
+            out[k] = (rt, float(a_all.sum()), float(b_all.sum()))
+    return out
 
 
 # Default process-level tracker, mirroring reference module-level API.

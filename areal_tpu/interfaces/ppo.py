@@ -23,6 +23,7 @@ import numpy as np
 from areal_tpu.api.data import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model import ModelInterface, PPOHyperparameters
 from areal_tpu.ops import ppo as ppo_ops
+from areal_tpu.parallel import multihost
 from areal_tpu.train import batching
 from areal_tpu.train.engine import vmapped_forward
 
@@ -229,7 +230,13 @@ class PPOActorInterface(ModelInterface):
     ) -> Dict[str, float]:
         hp = self.hp
         sample = self._prepare(sample)
-        mbs = sample.split(min(hp.ppo_n_minibatches, sample.bs))
+        # engine.train_batch is collective: the minibatch COUNT must agree
+        # across hosts even when per-host batch sizes differ (a starved host
+        # with a partial batch must not run fewer collective calls)
+        n_mb = int(
+            multihost.allreduce_min(np.int64(min(hp.ppo_n_minibatches, sample.bs)))
+        )
+        mbs = sample.split(max(n_mb, 1))
         all_stats = []
         for mb in mbs:
             stats = engine.train_batch(
@@ -242,10 +249,15 @@ class PPOActorInterface(ModelInterface):
         out = {k: float(np.mean([s[k] for s in all_stats])) for k in all_stats[0]}
         # Adaptive KL control tracks policy-vs-reference divergence (the
         # signed masked mean over action tokens), like the reference
-        # (ppo_interface.py:973-978) — NOT the PPO update KL.
-        self.kl_ctl.update(self._last_ref_kl, sample.bs)
+        # (ppo_interface.py:973-978) — NOT the PPO update KL. The update is
+        # fed the GLOBAL mean so per-host controllers never drift apart.
+        tot = multihost.allreduce_sum(
+            np.asarray([self._last_ref_kl * sample.bs, sample.bs], np.float64)
+        )
+        ref_kl_global = float(tot[0] / max(tot[1], 1))
+        self.kl_ctl.update(ref_kl_global, int(tot[1]))
         out["kl_ctl"] = self.kl_ctl.value
-        out["ref_kl"] = self._last_ref_kl
+        out["ref_kl"] = ref_kl_global
         out["n_seqs"] = sample.bs
         return out
 
@@ -262,7 +274,16 @@ class PPOCriticInterface(ModelInterface):
 
     def __post_init__(self):
         if self.kl_ctl is None:
-            self.kl_ctl = ppo_ops.FixedKLController(self.hp.kl_ctl)
+            # standalone construction: mirror the actor's controller choice,
+            # or adaptive-KL critics silently fall back to a fixed coefficient
+            if self.hp.use_adaptive_kl:
+                self.kl_ctl = ppo_ops.AdaptiveKLController(
+                    self.hp.kl_ctl,
+                    self.hp.adaptive_kl_target,
+                    self.hp.adaptive_kl_horizon,
+                )
+            else:
+                self.kl_ctl = ppo_ops.FixedKLController(self.hp.kl_ctl)
         self._actor_helper = PPOActorInterface(hp=self.hp)
         # the helper only runs _prepare (reward shaping + GAE); its KL
         # coefficient must track the shared controller, and its update()
@@ -308,7 +329,10 @@ class PPOCriticInterface(ModelInterface):
     ) -> Dict[str, float]:
         hp = self.hp
         sample = self._actor_helper._prepare(sample)
-        mbs = sample.split(min(hp.ppo_n_minibatches, sample.bs))
+        n_mb = int(
+            multihost.allreduce_min(np.int64(min(hp.ppo_n_minibatches, sample.bs)))
+        )
+        mbs = sample.split(max(n_mb, 1))
         all_stats = [
             engine.train_batch(mb, mb_spec, self._critic_loss_fn, fetch_stats=False)
             for mb in mbs

@@ -73,8 +73,34 @@ DEFAULT_RULES: Dict[str, Optional[str]] = {
 def make_mesh(
     cfg: ParallelConfig, devices: Optional[Sequence[jax.Device]] = None
 ) -> Mesh:
+    """Build the global 3D mesh.
+
+    Multi-process runs (after ``jax.distributed.initialize``; see
+    ``parallel/multihost.py``) order devices by (process_index, id) so that
+
+    - every ``model`` (TP) group lives inside one process — its psums ride
+      ICI, never DCN (the reference pins TP within a node the same way,
+      ``realhf/base/topology.py:369``), and
+    - each process owns a *contiguous* block of batch rows, which is the
+      layout contract of per-host batch feeding
+      (``multihost.global_from_local`` / ``fetch_local_rows``).
+    """
     if devices is None:
         devices = jax.devices()
+    nproc = jax.process_count()
+    if nproc > 1:
+        devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+        if cfg.world_size != len(devices):
+            raise ValueError(
+                f"multi-host mesh must use all {len(devices)} devices, "
+                f"parallel config gives {cfg.world_size}"
+            )
+        per_proc = len(devices) // nproc
+        if per_proc % cfg.model != 0:
+            raise ValueError(
+                f"model={cfg.model} groups straddle process boundaries "
+                f"({per_proc} devices/process); keep TP within a host"
+            )
     if cfg.world_size > len(devices):
         raise ValueError(
             f"Parallel config needs {cfg.world_size} devices, have {len(devices)}"

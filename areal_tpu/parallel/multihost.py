@@ -1,0 +1,261 @@
+"""Multi-host (multi-process) runtime for pod-scale training.
+
+TPU-native counterpart of the reference's NCCL bootstrap + per-axis group
+construction (``realhf/impl/model/comm/global_comm.py:48-163``,
+``realhf/base/topology.py:369``). There, every host hand-builds process
+groups for dp/tp/pp and routes tensors explicitly; here the whole plane
+collapses to:
+
+1. ``jax.distributed.initialize`` — one GRPC coordinator, after which
+   ``jax.devices()`` returns the *global* device list;
+2. one global ``jax.sharding.Mesh`` over those devices (see
+   ``areal_tpu.parallel.mesh.make_mesh``);
+3. per-host batch feeding: each process materializes only its own rows of
+   the packed batch and ``jax.make_array_from_process_local_data`` assembles
+   the global array view (the analogue of the reference's per-DP-rank
+   dataloaders feeding into NCCL redistribution);
+4. XLA inserts all collectives, riding ICI within a slice and DCN across
+   slices.
+
+Everything here is a no-op in single-process runs, so the same trainer code
+serves laptop CPU tests and v5p-128 pods.
+"""
+
+import logging
+import os
+import zlib
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+logger = logging.getLogger("areal_tpu.multihost")
+
+# Env names understood by `maybe_initialize_from_env` (set by the launcher or
+# the cluster scheduler; on Cloud TPU pods jax.distributed auto-detects and
+# none of these are needed).
+COORDINATOR_ENV = "AREAL_COORDINATOR"
+NUM_PROCESSES_ENV = "AREAL_NUM_PROCESSES"
+PROCESS_ID_ENV = "AREAL_PROCESS_ID"
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> bool:
+    """Idempotent ``jax.distributed.initialize`` wrapper.
+
+    Returns True iff a multi-process runtime was (or already had been)
+    brought up. Single-process calls (num_processes in (None, 1) with no
+    coordinator) are a no-op so tests and laptops never pay GRPC setup.
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_count() > 1
+    if coordinator_address is None and num_processes in (None, 1):
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+    logger.info(
+        "jax.distributed up: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+    return True
+
+
+def maybe_initialize_from_env() -> bool:
+    """Bring up jax.distributed from AREAL_* env vars if they are set.
+
+    On Cloud TPU pod slices ``jax.distributed.initialize()`` with no args
+    auto-detects the topology; setting only ``AREAL_COORDINATOR=auto``
+    requests that path.
+    """
+    coord = os.environ.get(COORDINATOR_ENV)
+    if coord is None:
+        return False
+    if coord == "auto":
+        global _initialized
+        if not _initialized:
+            jax.distributed.initialize()
+            _initialized = True
+        return jax.process_count() > 1
+    return initialize(
+        coordinator_address=coord,
+        num_processes=int(os.environ[NUM_PROCESSES_ENV]),
+        process_id=int(os.environ[PROCESS_ID_ENV]),
+    )
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def is_main() -> bool:
+    """True on the process that owns logging/name_resolve/file writes."""
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "areal_barrier") -> None:
+    if is_multihost():
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def local_slice(n_global: int) -> Tuple[int, int]:
+    """Contiguous [lo, hi) slice of a leading global batch axis owned by this
+    process. Row-major over process index; requires even divisibility (the
+    packer always pads row counts to the mesh)."""
+    p, n = jax.process_index(), jax.process_count()
+    if n_global % n != 0:
+        raise ValueError(f"global axis {n_global} not divisible by {n} processes")
+    per = n_global // n
+    return p * per, (p + 1) * per
+
+
+def global_from_local(
+    local_arrays: Dict[str, np.ndarray],
+    sharding,
+    global_rows: int,
+    rows_axis: int = 0,
+) -> Dict[str, jax.Array]:
+    """Assemble global device arrays from this process's rows.
+
+    ``local_arrays`` hold the process-local shard of axis ``rows_axis`` (the
+    packed-batch row axis); every other axis is global. Single-process runs
+    take the plain ``device_put`` path.
+    """
+    if not is_multihost():
+        return {k: jax.device_put(v, sharding) for k, v in local_arrays.items()}
+    out = {}
+    for k, v in local_arrays.items():
+        gshape = list(v.shape)
+        gshape[rows_axis] = global_rows
+        out[k] = jax.make_array_from_process_local_data(
+            sharding, v, global_shape=tuple(gshape)
+        )
+    return out
+
+
+def allreduce_sum(x: np.ndarray) -> np.ndarray:
+    """Sum a small host-side numpy array across processes (stats, weights —
+    NOT the data path; XLA handles device collectives)."""
+    if not is_multihost():
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(np.asarray(x))).sum(axis=0)
+
+
+def allreduce_max(x: np.ndarray) -> np.ndarray:
+    if not is_multihost():
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(np.asarray(x))).max(axis=0)
+
+
+def allreduce_min(x: np.ndarray) -> np.ndarray:
+    if not is_multihost():
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(np.asarray(x))).min(axis=0)
+
+
+def main_decides(flag: bool) -> bool:
+    """Broadcast a host-side control decision from process 0 so every process
+    takes the same branch (per-host clocks/timers must never steer
+    collective-bearing paths — a straddled timer deadlocks the pod)."""
+    if not is_multihost():
+        return flag
+    return bool(allgather_rows(np.int64(flag))[0])
+
+
+def allgather_rows(x: np.ndarray) -> np.ndarray:
+    """[P, ...] stack of every process's copy of ``x`` (same shape everywhere)."""
+    if not is_multihost():
+        return np.asarray(x)[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(np.asarray(x)))
+
+
+def assert_same_across_hosts(tag: str, payload: str) -> None:
+    """Raise if ``payload`` (e.g. a sorted stats key list) differs across
+    processes — turning silent cross-host divergence into a loud error."""
+    if not is_multihost():
+        return
+    h = np.uint32(zlib.crc32(payload.encode()))
+    gathered = allgather_rows(h)
+    if not (gathered == gathered[0]).all():
+        raise RuntimeError(
+            f"cross-host divergence in {tag}: crc32 per process = {gathered.tolist()}"
+        )
+
+
+def fetch_local_rows(global_arr: jax.Array, n_local_rows: int) -> np.ndarray:
+    """Pull this process's rows of a row-sharded global array to host.
+
+    The packed batch is sharded over its leading row axis with rows laid out
+    contiguously per process (see ``mesh.make_mesh``), so the process's
+    addressable shards tile exactly its ``[lo, hi)`` row block.
+    """
+    if not is_multihost():
+        return np.asarray(global_arr)
+    lo, _ = local_slice(global_arr.shape[0])
+    out = None
+    for shard in global_arr.addressable_shards:
+        data = np.asarray(shard.data)
+        if out is None:
+            out = np.zeros((n_local_rows,) + global_arr.shape[1:], data.dtype)
+        idx = shard.index[0]
+        start = 0 if idx.start is None else idx.start
+        rest = shard.index[1:]
+        out[(slice(start - lo, start - lo + data.shape[0]),) + tuple(rest)] = data
+    return out
+
+
+def replicated_to_host(x) -> np.ndarray:
+    """Host copy of a fully-replicated global array (jit scalar outputs)."""
+    return np.asarray(x)
+
+
+def gather_params_to_host(params):
+    """Host copy of a (possibly cross-process sharded) param pytree for HF
+    weight export (counterpart of the reference's param-realloc gather before
+    save, ``realhf/impl/model/nn/real_llm_api.py`` save path).
+
+    Multi-host: every process must call this (the per-leaf resharding is a
+    collective), but only process 0 — the one that writes the file — pays the
+    device->host transfer; other processes get a tree of ``None``.
+    """
+    if not is_multihost():
+        return jax.tree.map(lambda x: np.asarray(x), params)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def leaf(x):
+        rep = jax.device_put(x, NamedSharding(x.sharding.mesh, PartitionSpec()))
+        return np.asarray(rep) if is_main() else None
+
+    return jax.tree.map(leaf, params)

@@ -32,6 +32,7 @@ from areal_tpu.api.model import PPOHyperparameters, make_interface
 from areal_tpu.base import constants, name_resolve, names, recover
 from areal_tpu.base.metrics import MetricLogger
 from areal_tpu.base.timeutil import EpochStepTimeFreqCtl
+from areal_tpu.parallel import multihost
 from areal_tpu.train.engine import TrainEngine
 
 logger = logging.getLogger("areal_tpu.trainer_worker")
@@ -60,7 +61,7 @@ class AsyncPPOTrainerWorker:
         stream,                              # PullerStreamDataset-like
         hp: PPOHyperparameters,
         control: TrainerControl,
-        train_batch_size: int = 32,          # items (prompt groups) per step
+        train_batch_size: int = 32,          # items/step; per-HOST when multihost
         mb_spec: Optional[MicroBatchSpec] = None,
         ref_engine: Optional[TrainEngine] = None,
         critic_engine: Optional[TrainEngine] = None,
@@ -102,22 +103,26 @@ class AsyncPPOTrainerWorker:
         path = os.path.join(
             constants.get_param_sync_root(), f"v{version}"
         )
+        # all hosts participate in the param gather; host 0 writes + announces
         self.actor_engine.save_hf(path, self.hf_family)
-        name_resolve.add(
-            names.model_version(self.experiment_name, self.trial_name, "actor"),
-            f"{version}:{path}",
-            replace=True,
-        )
-        logger.info("published weights v%d -> %s", version, path)
+        if multihost.is_main():
+            name_resolve.add(
+                names.model_version(self.experiment_name, self.trial_name, "actor"),
+                f"{version}:{path}",
+                replace=True,
+            )
+            logger.info("published weights v%d -> %s", version, path)
         return path
 
     def _bump_training_samples(self, n: int):
-        self.samples_consumed += n
-        name_resolve.add(
-            names.training_samples(self.experiment_name, self.trial_name),
-            str(self.samples_consumed),
-            replace=True,
-        )
+        # n is this host's count; the staleness gate needs the global one
+        self.samples_consumed += int(multihost.allreduce_sum(np.int64(n)))
+        if multihost.is_main():
+            name_resolve.add(
+                names.training_samples(self.experiment_name, self.trial_name),
+                str(self.samples_consumed),
+                replace=True,
+            )
 
     # ------------------------------------------------------------------ #
     # data intake
@@ -131,9 +136,13 @@ class AsyncPPOTrainerWorker:
             )
             self._buffer.extend(got)
             if time.time() - t0 > timeout:
-                if not self._buffer:
-                    return None
                 break
+        # The train step is collective, so EITHER every host proceeds or none
+        # does — one starved host exiting alone would leave the others
+        # blocked in the next allgather forever. (Single-host: allreduce_min
+        # is the identity, so this is just the empty-buffer check.)
+        if not multihost.allreduce_min(np.int64(bool(self._buffer))):
+            return None  # some host is starved; everyone keeps its buffer
         batch, self._buffer = (
             self._buffer[: self.train_batch_size],
             self._buffer[self.train_batch_size :],
@@ -204,9 +213,12 @@ class AsyncPPOTrainerWorker:
                 self.actor_engine,
                 os.path.join(constants.get_save_root(), f"step{self.step}"),
             )
-        if self._ckpt_ctl.check(steps=1):
+        # process 0's timer decides for everyone: save_recover_checkpoint
+        # contains collectives, so a wall-clock boundary straddled across
+        # hosts must not split the control flow
+        if multihost.main_decides(self._ckpt_ctl.check(steps=1)):
             self.save_recover_checkpoint()
-        if self.metrics is not None:
+        if self.metrics is not None and multihost.is_main():
             self.metrics.log(
                 {k: v for k, v in stats.items() if np.isscalar(v)}, self.step,
                 prefix="ppo",
@@ -235,7 +247,9 @@ class AsyncPPOTrainerWorker:
         info = recover.RecoverInfo(
             recover_start=step_info, last_step_info=step_info
         )
-        recover.dump(info)
+        if multihost.is_main():
+            recover.dump(info)
+        multihost.barrier("recover_ckpt")
 
     def load_recover_checkpoint(self) -> bool:
         root = os.path.join(constants.get_recover_root(), "trainer")

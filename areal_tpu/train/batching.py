@@ -149,6 +149,18 @@ def pack_sequences(
     )
 
 
+def empty_like(pb: PackedBatch) -> PackedBatch:
+    """An all-padding micro-batch with the same buffer shapes (weight 0).
+    Multi-host hosts with fewer items than the agreed micro-batch count pad
+    with these so every process enters the same jit dispatch."""
+    return PackedBatch(
+        arrays={k: np.zeros_like(v) for k, v in pb.arrays.items()},
+        placements=[],
+        n_rows=pb.n_rows,
+        capacity=pb.capacity,
+    )
+
+
 def count_action_tokens(pb: PackedBatch) -> float:
     """Host-side count of loss-bearing positions: tokens with a same-segment
     successor whose label is not a prompt token. Mirrors the mask used by the

@@ -28,6 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from areal_tpu.api.data import MicroBatchSpec, SequenceSample
 from areal_tpu.models.config import ModelConfig
 from areal_tpu.models import transformer as tfm
+from areal_tpu.parallel import multihost
 from areal_tpu.parallel.mesh import (
     ParallelConfig,
     batch_pspec,
@@ -124,7 +125,20 @@ class TrainEngine:
 
     @property
     def n_rows(self) -> int:
+        """Global packed-batch rows (over every process's devices)."""
         return self.parallel.data * self.parallel.fsdp
+
+    @property
+    def n_local_rows(self) -> int:
+        """Rows this process materializes (per-host batch feeding; the full
+        batch never exists on any one host — ≈ the reference's per-DP-rank
+        dataloaders, ``realhf/system/model_worker.py`` fetch path)."""
+        nproc = jax.process_count()
+        if self.n_rows % nproc != 0:
+            raise ValueError(
+                f"{self.n_rows} batch rows not divisible by {nproc} processes"
+            )
+        return self.n_rows // nproc
 
     def init_random(self, seed: int = 0):
         init = jax.jit(
@@ -164,13 +178,27 @@ class TrainEngine:
         host_params = jax.tree.map(
             lambda x: np.asarray(x, self.param_dtype), host_params
         )
-        self.params = jax.device_put(host_params, self._param_shardings)
+        if multihost.is_multihost():
+            # every process holds the full host copy (loaded from shared FS);
+            # each materializes only its addressable shards
+            self.params = jax.tree.map(
+                lambda x, s: jax.make_array_from_callback(
+                    x.shape, s, lambda idx: x[idx]
+                ),
+                host_params,
+                self._param_shardings,
+            )
+        else:
+            self.params = jax.device_put(host_params, self._param_shardings)
         return self
 
     def save_hf(self, path: str, family: str):
         from areal_tpu.models import hf as hf_conv
 
-        hf_conv.save_hf_checkpoint(self.params, self.cfg, family, path)
+        host_params = multihost.gather_params_to_host(self.params)
+        if multihost.is_main():
+            hf_conv.save_hf_checkpoint(host_params, self.cfg, family, path)
+        multihost.barrier("save_hf")
 
     # ------------------------------------------------------------------ #
     # Optimizer
@@ -324,42 +352,72 @@ class TrainEngine:
         return jitted
 
     def _put_batch(self, packed: batching.PackedBatch) -> Dict[str, jnp.ndarray]:
-        return {
-            k: jax.device_put(v, self._batch_sharding)
-            for k, v in packed.arrays.items()
-        }
+        return multihost.global_from_local(
+            packed.arrays, self._batch_sharding, self.n_rows, rows_axis=0
+        )
 
     def _put_stacked(
         self, packed: List[batching.PackedBatch]
     ) -> Dict[str, jnp.ndarray]:
-        """Stack per-micro-batch host buffers to [n_mbs, D, T, ...] and ship
-        them in one transfer."""
+        """Stack per-micro-batch host buffers to [n_mbs, D_local, T, ...] and
+        ship them in one transfer (global view [n_mbs, D, T, ...])."""
         keys = packed[0].arrays.keys()
         stacked = {
             k: np.stack([pb.arrays[k] for pb in packed]) for k in keys
         }
-        return jax.device_put(stacked, self._stacked_sharding)
+        return multihost.global_from_local(
+            stacked, self._stacked_sharding, self.n_rows, rows_axis=1
+        )
 
     def _make_micro_batches(
         self, sample: SequenceSample, mb_spec: MicroBatchSpec, capacity=None
     ):
+        """Split + pack this host's sample into micro-batches.
+
+        Multi-host: every process enters the same jit dispatch, so the
+        micro-batch COUNT and buffer CAPACITY must agree globally even though
+        each host packs its own (differently-sized) local rows. Hosts agree
+        by small allgathers; a host with fewer items than the agreed count
+        pads with empty (weight-0) micro-batches.
+        """
+        n_rows = self.n_local_rows
         mbs = batching.split_into_micro_batches(
-            sample, mb_spec.n_mbs, mb_spec.max_tokens_per_mb, self.n_rows
+            sample, mb_spec.n_mbs, mb_spec.max_tokens_per_mb, n_rows
         )
+        n_empty = 0
+        if multihost.is_multihost():
+            # fixed-point on the part count: identical allgather sequence on
+            # every host (the gathered vector is the same everywhere, so all
+            # hosts take the same branch each iteration)
+            for _ in range(8):
+                counts = multihost.allgather_rows(np.int64(len(mbs)))
+                g = int(counts.max())
+                if (counts == g).all():
+                    break
+                if len(mbs) < g:
+                    mbs = batching.split_into_micro_batches(
+                        sample, g, mb_spec.max_tokens_per_mb, n_rows
+                    )
+            g = int(multihost.allreduce_max(np.int64(len(mbs))))
+            n_empty = g - len(mbs)  # host has fewer items than the agreement
         cap = capacity or mb_spec.max_tokens_per_mb
         packed = [
-            batching.pack_sequences(mb, self.n_rows, capacity=cap) for mb in mbs
+            batching.pack_sequences(mb, n_rows, capacity=cap) for mb in mbs
         ]
-        if cap is None and len(packed) > 1:
+        if cap is None:
             # uniform capacity so micro-batches stack into one [n_mbs, D, T]
-            # buffer (and share one compiled step)
+            # buffer (and share one compiled step) — agreed across hosts
             cap = max(pb.capacity for pb in packed)
+            if multihost.is_multihost():
+                cap = int(multihost.allreduce_max(np.int64(cap)))
             packed = [
                 pb
                 if pb.capacity == cap
-                else batching.pack_sequences(mb, self.n_rows, capacity=cap)
+                else batching.pack_sequences(mb, n_rows, capacity=cap)
                 for mb, pb in zip(mbs, packed)
             ]
+        for _ in range(n_empty):
+            packed.append(batching.empty_like(packed[0]))
         return mbs, packed
 
     # ------------------------------------------------------------------ #
@@ -392,7 +450,13 @@ class TrainEngine:
         if loss_weight_fn is None:
             loss_weight_fn = batching.count_action_tokens
         _, packed = self._make_micro_batches(sample, mb_spec)
-        weights = np.asarray([loss_weight_fn(pb) for pb in packed], np.float32)
+        # Per-mb loss weights must be identical on every process (they enter
+        # the jit replicated), and the loss each mb computes inside pjit is
+        # already GLOBAL over all hosts' rows — so weight by the global
+        # action-token count of each micro-batch.
+        weights = multihost.allreduce_sum(
+            np.asarray([loss_weight_fn(pb) for pb in packed], np.float32)
+        )
         total_w = weights.sum() or 1.0
         weights = weights / total_w
 
@@ -413,13 +477,19 @@ class TrainEngine:
     ) -> Dict[str, float]:
         _, packed = self._make_micro_batches(sample, mb_spec)
         ev = self._get_jitted("eval", loss_fn)
-        tot, n = 0.0, 0
-        for pb in packed:
-            loss, _ = ev(self.params, self._put_batch(pb))
-            w = float((pb.arrays["segment_ids"] > 0).sum())
-            tot += float(loss) * w
-            n += w
-        return {"loss": tot / max(n, 1)}
+        # ONE cross-host reduce for all mb weights and ONE device pull for
+        # all losses (each costs a full round trip on remote accelerators)
+        weights = multihost.allreduce_sum(
+            np.asarray(
+                [(pb.arrays["segment_ids"] > 0).sum() for pb in packed],
+                np.float64,
+            )
+        )
+        losses = [ev(self.params, self._put_batch(pb))[0] for pb in packed]
+        losses = np.asarray(jax.device_get(losses), np.float64)
+        # all-padding mbs can yield nan means; their weight is 0
+        tot = float(np.sum(np.where(weights > 0, losses * weights, 0.0)))
+        return {"loss": tot / max(weights.sum(), 1)}
 
     def forward(
         self,
@@ -435,8 +505,15 @@ class TrainEngine:
         mbs, packed = self._make_micro_batches(sample, mb_spec)
         fwd = self._get_jitted("forward", output_fn)
         by_key: Dict[Any, np.ndarray] = {}
-        for mb, pb in zip(mbs, packed):
-            out = np.asarray(fwd(self.params, self._put_batch(pb)))
+        # iterate over `packed` (not zip) — trailing multi-host padding
+        # batches have no local mb but every process must dispatch them
+        for i, pb in enumerate(packed):
+            out = multihost.fetch_local_rows(
+                fwd(self.params, self._put_batch(pb)), self.n_local_rows
+            )
+            if i >= len(mbs):
+                continue
+            mb = mbs[i]
             for p, arr in zip(pb.placements, pb.unpack(out)):
                 by_key[(mb.ids[p.item_idx], p.seq_idx)] = arr
         outs: List[np.ndarray] = []
@@ -457,8 +534,11 @@ class TrainEngine:
         import orbax.checkpoint as ocp
 
         path = os.path.abspath(path)
-        if os.path.exists(path):
+        # main-only clean + barrier: concurrent rmtrees on a shared FS race
+        # each other and the distributed orbax save
+        if multihost.is_main() and os.path.exists(path):
             shutil.rmtree(path)
+        multihost.barrier("ckpt_clean")
         state = {"params": self.params, "step": self._step, "version": self.version}
         if with_optim and self.opt_state is not None:
             state["opt_state"] = self.opt_state

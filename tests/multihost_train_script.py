@@ -1,0 +1,139 @@
+"""Subprocess body for tests/test_multihost.py.
+
+Trains the tiny model for N steps on deterministic synthetic data over a
+(possibly multi-process) virtual CPU mesh and dumps per-step losses + reduced
+stats as JSON — the pjit analogue of the reference's multi-process NCCL tests
+(``tests/comm/test_param_realloc.py``'s 8-process world).
+
+Run single-process (baseline) or as one rank of a multi-process world:
+    python multihost_train_script.py --num-processes 2 --process-id 0 \
+        --coordinator localhost:12345 --local-devices 4 --out r0.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", default=None)
+    ap.add_argument("--num-processes", type=int, default=1)
+    ap.add_argument("--process-id", type=int, default=0)
+    ap.add_argument("--local-devices", type=int, default=8)
+    ap.add_argument("--parallel", default="d2f2m2")
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--n-mbs", type=int, default=1)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={args.local_devices}"
+    )
+
+    import jax
+
+    # the axon sitecustomize force-registers the TPU plugin and overrides
+    # JAX_PLATFORMS; the config update wins over both (as in tests/conftest.py)
+    jax.config.update("jax_platforms", "cpu")
+
+    from areal_tpu.parallel import multihost
+
+    if args.num_processes > 1:
+        multihost.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+    expected = args.local_devices * args.num_processes
+    assert jax.device_count() == expected, (
+        f"device_count={jax.device_count()} expected={expected} "
+        f"platform={jax.default_backend()}"
+    )
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+    from areal_tpu.base import stats_tracker
+    from areal_tpu.models.config import ModelConfig
+    from areal_tpu.ops import ppo as ppo_ops
+    from areal_tpu.parallel.mesh import ParallelConfig
+    from areal_tpu.train.engine import OptimizerConfig, TrainEngine, vmapped_forward
+
+    cfg = ModelConfig(
+        n_layers=2, n_q_heads=4, n_kv_heads=2, head_dim=8, hidden_dim=32,
+        intermediate_dim=64, vocab_size=128, dtype="float32",
+    )
+    eng = TrainEngine(
+        cfg,
+        parallel=ParallelConfig.from_str(args.parallel),
+        optimizer=OptimizerConfig(lr=1e-3, lr_scheduler_type="constant"),
+    )
+    eng.init_random(0)
+    eng.setup_optimizer(total_train_steps=100)
+
+    def sft_loss(params, mcfg, arrays):
+        logits = vmapped_forward(params, mcfg, arrays)
+        lp = jax.vmap(ppo_ops.gather_packed_shifted_log_probs)(
+            logits, arrays["input_ids"], arrays["segment_ids"]
+        )
+        seg = arrays["segment_ids"]
+        has_next = (seg > 0) & ~jax.vmap(ppo_ops.is_segment_end)(seg)
+        mask = has_next & ~arrays["prompt_mask"]
+        n = jnp.maximum(mask.sum(), 1)
+        return -jnp.sum(jnp.where(mask, lp, 0.0)) / n, {}
+
+    # The GLOBAL batch is identical in every configuration; each process
+    # takes a strided slice of the items (per-host data feeding).
+    rng = np.random.default_rng(0)
+    n_items = 12
+    seqlens = [int(n) for n in rng.integers(6, 14, size=n_items)]
+    ids_all = rng.integers(0, 128, size=sum(seqlens)).astype(np.int64)
+    pmask = np.concatenate(
+        [np.r_[np.ones(2, np.bool_), np.zeros(n - 2, np.bool_)] for n in seqlens]
+    )
+    offs = np.cumsum([0] + seqlens)
+    mine = list(range(args.process_id, n_items, args.num_processes))
+    sample = SequenceSample.from_default(
+        ids=mine,
+        seqlens=[seqlens[i] for i in mine],
+        data={
+            "packed_input_ids": np.concatenate(
+                [ids_all[offs[i] : offs[i + 1]] for i in mine]
+            ),
+            "prompt_mask": np.concatenate(
+                [pmask[offs[i] : offs[i + 1]] for i in mine]
+            ),
+        },
+    )
+
+    losses = []
+    for _ in range(args.steps):
+        stats = eng.train_batch(sample, MicroBatchSpec(n_mbs=args.n_mbs), sft_loss)
+        losses.append(stats["loss"])
+
+    # host-local stats -> cross-host reduction (each host records its rank)
+    stats_tracker.DEFAULT.scalar(rank_sum=float(args.process_id))
+    reduced = stats_tracker.DEFAULT.export(cross_host=args.num_processes > 1)
+
+    if args.out and multihost.is_main():
+        with open(args.out, "w") as f:
+            json.dump(
+                {
+                    "losses": losses,
+                    "rank_sum": reduced["rank_sum"],
+                    "process_count": jax.process_count(),
+                    "device_count": jax.device_count(),
+                },
+                f,
+            )
+    multihost.barrier("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
