@@ -1,0 +1,140 @@
+"""The dataflow graph (MFC) layer: algorithms as declared graphs.
+
+TPU-native counterpart of the reference's ``MFCDef`` + graph build
+(``realhf/api/core/dfg.py:56,238``). An algorithm is a set of *model function
+calls* — named (model, interface-method) pairs with declared input/output
+data keys — and the execution order is resolved from key dependencies, never
+hardcoded. New algorithms (critic on/off, EMA reference, fused calls, RM
+scoring) are graph edits, not trainer edits.
+
+What the reference does NOT need here: replica IDs, device-mesh placement
+per MFC, and the request-reply transfer plane — on TPU every model is one
+pjit program over the trainer mesh, so an MFC "call" is an in-process
+function call and data "transfer" is key selection on the host batch
+(SURVEY.md §2.2 "Data redistribution plane"). Hooks survive: parameter
+realloc between models becomes a jitted EMA/copy over identically-sharded
+pytrees.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple, Union
+
+from areal_tpu.api.data import MicroBatchSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamReallocHook:
+    """Transfer weights between two models around an MFC
+    (≈ ``realhf/api/core/dfg.py:29``): ``target = eta*source + (1-eta)*target``.
+
+    With ``eta=1`` this is a copy (the reference's default realloc); with
+    ``eta<1`` it is the EMA-reference-model recipe
+    (``realhf/experiments/common/ppo_math_exp.py:349-367``).
+    """
+
+    source: str
+    target: str
+    eta: float = 1.0
+
+
+RPCHook = Union[ParamReallocHook]
+
+
+@dataclasses.dataclass
+class MFCDef:
+    """One model function call node (≈ ``realhf/api/core/dfg.py:56``).
+
+    :param name: unique node id.
+    :param model_name: which engine runs this call (e.g. "actor", "critic",
+        "ref").
+    :param interface_type: "inference" | "train_step" | "generate".
+    :param interface_impl: registry name for ``make_interface`` — resolved by
+        the executor, so graphs are plain data (serializable config).
+    :param input_keys: batch keys this call consumes (dependency edges).
+    :param output_keys: batch keys this call produces, post-remap.
+    :param output_key_remap: interface-native key -> graph key.
+    """
+
+    name: str
+    model_name: str
+    interface_type: str
+    interface_impl: str = ""
+    interface_kwargs: dict = dataclasses.field(default_factory=dict)
+    input_keys: Tuple[str, ...] = ()
+    output_keys: Tuple[str, ...] = ()
+    output_key_remap: Dict[str, str] = dataclasses.field(default_factory=dict)
+    mb_spec: Optional[MicroBatchSpec] = None
+    pre_hooks: List[RPCHook] = dataclasses.field(default_factory=list)
+    post_hooks: List[RPCHook] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        if self.interface_type not in ("inference", "train_step", "generate"):
+            raise ValueError(f"{self.name}: bad interface_type {self.interface_type!r}")
+
+
+@dataclasses.dataclass
+class DataFlowGraph:
+    """Validated graph: MFCs in level order (each level's inputs are fully
+    produced by earlier levels or the source batch)."""
+
+    mfcs: List[MFCDef]
+    levels: List[List[MFCDef]]
+    producers: Dict[str, str]          # data key -> producing MFC name
+
+    @property
+    def names(self) -> List[str]:
+        return [m.name for m in self.mfcs]
+
+
+def build_graph(
+    mfcs: Sequence[MFCDef], batch_keys: Sequence[str] = ()
+) -> DataFlowGraph:
+    """Resolve edges from input/output keys and level-order the MFCs
+    (≈ ``realhf/api/core/dfg.py:238``'s nx.DiGraph build + the function
+    executor's level traversal, ``realhf/system/function_executor.py:211``).
+
+    ``batch_keys``: keys the source batch (rollout stream / dataset)
+    provides. Raises on duplicate names, duplicate producers, unsatisfiable
+    inputs, and cycles — at experiment build time, not mid-training.
+    """
+    names = [m.name for m in mfcs]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate MFC names: {names}")
+    producers: Dict[str, str] = {}
+    for m in mfcs:
+        for k in m.output_keys:
+            if k in producers:
+                raise ValueError(
+                    f"key {k!r} produced by both {producers[k]!r} and {m.name!r}"
+                )
+            producers[k] = m.name
+    base: Set[str] = set(batch_keys)
+    for m in mfcs:
+        for k in m.input_keys:
+            if k not in base and k not in producers:
+                raise ValueError(
+                    f"MFC {m.name!r} needs key {k!r}: not in the source batch "
+                    f"({sorted(base)}) and produced by no MFC"
+                )
+
+    # Kahn levels over name-dependencies
+    deps: Dict[str, Set[str]] = {
+        m.name: {
+            producers[k]
+            for k in m.input_keys
+            if k in producers and producers[k] != m.name
+        }
+        for m in mfcs
+    }
+    by_name = {m.name: m for m in mfcs}
+    done: Set[str] = set()
+    levels: List[List[MFCDef]] = []
+    remaining = set(names)
+    while remaining:
+        ready = sorted(n for n in remaining if deps[n] <= done)
+        if not ready:
+            raise ValueError(f"dependency cycle among MFCs: {sorted(remaining)}")
+        levels.append([by_name[n] for n in ready])
+        done |= set(ready)
+        remaining -= set(ready)
+    return DataFlowGraph(mfcs=list(mfcs), levels=levels, producers=producers)

@@ -211,6 +211,19 @@ def rollout_worker_main(cfg, worker_idx: int):
     asyncio.run(worker.run_async())
 
 
+def _load_ppo_engines(cfg, total_steps):
+    """actor / optional ref / optional critic from an experiment config —
+    ONE place for the gating rules shared by the sync and async recipes."""
+    actor = _load_engine(cfg.actor, total_steps=total_steps)
+    ref = None
+    if cfg.use_ref_model and (cfg.ppo.kl_ctl != 0 or cfg.ema_ref_eta is not None):
+        ref = _load_engine(cfg.actor, with_optimizer=False)
+    critic = None
+    if cfg.critic is not None and not cfg.ppo.disable_value:
+        critic = _load_engine(cfg.critic, is_critic=True, total_steps=total_steps)
+    return actor, ref, critic
+
+
 def trainer_main(cfg):
     _setup_worker_env(cfg, cfg.trainer_device)
     # pod-scale runs: each host's launcher sets AREAL_COORDINATOR/_NUM_
@@ -233,13 +246,7 @@ def trainer_main(cfg):
     stream = PullerStreamDataset(
         cfg.experiment_name, cfg.trial_name, 0, offline_dataset_size=10_000
     )
-    actor = _load_engine(cfg.actor, total_steps=total)
-    ref = None
-    if cfg.use_ref_model and cfg.ppo.kl_ctl != 0:
-        ref = _load_engine(cfg.actor, with_optimizer=False)
-    critic = None
-    if cfg.critic is not None and not cfg.ppo.disable_value:
-        critic = _load_engine(cfg.critic, is_critic=True, total_steps=total)
+    actor, ref, critic = _load_ppo_engines(cfg, total)
     worker = AsyncPPOTrainerWorker(
         experiment_name=cfg.experiment_name,
         trial_name=cfg.trial_name,
@@ -259,6 +266,7 @@ def trainer_main(cfg):
         critic_engine=critic,
         hf_family=cfg.hf_family,
         metric_logger=MetricLogger(constants.get_log_root()),
+        ema_ref_eta=cfg.ema_ref_eta,
     )
     if cfg.recover_mode in ("auto", "resume"):
         worker.load_recover_checkpoint()
@@ -267,11 +275,63 @@ def trainer_main(cfg):
     worker.run()
 
 
+def evaluator_main(cfg, stop_event=None):
+    """Checkpoint-watching evaluator role (≈ ``scheduler/evaluator.py:160``):
+    polls the save root, scores each new ``step{N}`` export on a held-out
+    set, appends to eval_result.jsonl + metric logs. ``stop_event`` (an
+    mp.Event) requests a graceful exit — one final sweep runs after it is
+    set so the LAST checkpoint is always evaluated."""
+    _setup_worker_env(cfg, cfg.evaluator.device)
+    from areal_tpu.api.dataset import DatasetUtility, make_dataset
+    from areal_tpu.base import constants
+    from areal_tpu.base.metrics import MetricLogger
+    from areal_tpu.system.evaluator import (
+        AutomaticEvaluator,
+        make_generation_eval_fn,
+    )
+
+    spec = cfg.evaluator
+    ds_spec = spec.dataset or cfg.dataset
+    tokenizer = None
+    tok_path = getattr(cfg, "tokenizer_path", None)
+    if tok_path:
+        import transformers
+
+        tokenizer = transformers.AutoTokenizer.from_pretrained(tok_path)
+    util = DatasetUtility(
+        seed=ds_spec.seed, dp_rank=0, world_size=1, tokenizer=tokenizer
+    )
+    dataset = make_dataset(
+        ds_spec.name, util, path=ds_spec.path, max_length=ds_spec.max_length
+    )
+    decode_fn = None
+    if tokenizer is not None:
+        decode_fn = lambda ids: tokenizer.decode(ids, skip_special_tokens=True)
+    eval_fn = make_generation_eval_fn(
+        cfg.actor.model_config(),
+        cfg.actor.parallel_config(),
+        dataset,
+        spec.gconfig,
+        decode_fn=decode_fn,
+        max_prompts=spec.max_prompts,
+    )
+    ev = AutomaticEvaluator(
+        constants.get_save_root(),
+        eval_fn,
+        os.path.join(constants.get_log_root(), "eval_result.jsonl"),
+        metric_logger=MetricLogger(constants.get_log_root()),
+        poll_interval=spec.poll_interval,
+    )
+    should_stop = stop_event.is_set if stop_event is not None else lambda: False
+    ev.run(should_stop=should_stop)
+
+
 ROLE_MAINS = {
     "gen_server": gen_server_main,
     "gserver_manager": gserver_manager_main,
     "rollout_worker": rollout_worker_main,
     "trainer": trainer_main,
+    "evaluator": evaluator_main,
 }
 
 
@@ -343,6 +403,12 @@ def _spawn_all(cfg) -> Dict[str, mp.Process]:
         ctx.Process(target=trainer_main, args=(cfg,), daemon=True),
         cfg.trainer_device == "cpu",
     )
+    if getattr(cfg, "evaluator", None) is not None and cfg.evaluator.enabled:
+        start(
+            "evaluator",
+            ctx.Process(target=evaluator_main, args=(cfg,), daemon=True),
+            cfg.evaluator.device == "cpu",
+        )
     return procs
 
 
@@ -361,7 +427,11 @@ def run_async_ppo(cfg) -> int:
             while trainer.is_alive():
                 trainer.join(timeout=5)
                 for name, p in procs.items():
-                    if name != "trainer" and not p.is_alive():
+                    # the evaluator is best-effort: its death never restarts
+                    # the world (matching the reference's detached eval jobs)
+                    if name in ("trainer", "evaluator"):
+                        continue
+                    if not p.is_alive():
                         logger.error("%s died (exit %s)", name, p.exitcode)
                         failed = True
                         break
@@ -378,6 +448,82 @@ def run_async_ppo(cfg) -> int:
         if cfg.recover_mode != "auto":
             break
     return trainer.exitcode if trainer.exitcode is not None else 1
+
+
+def run_sync_ppo(cfg) -> int:
+    """Sync PPO runs in-process: generation happens on the trainer's own
+    mesh/params (no fleet, no weight publish); the evaluator (if enabled)
+    runs as a side process on host 0."""
+    _setup_worker_env(cfg, cfg.trainer_device)
+    from areal_tpu.parallel import multihost
+
+    multihost.maybe_initialize_from_env()
+    from areal_tpu.api.dataset import DatasetUtility, make_dataset
+    from areal_tpu.base import constants
+    from areal_tpu.base.metrics import MetricLogger
+    from areal_tpu.system.sync_trainer import SyncPPOTrainerWorker
+    from areal_tpu.system.trainer_worker import TrainerControl
+
+    ev_proc = ev_stop = None
+    if cfg.evaluator.enabled and multihost.is_main():
+        ctx = mp.get_context("spawn")
+        ev_stop = ctx.Event()
+        with _cpu_child_env(cfg.evaluator.device == "cpu"):
+            ev_proc = ctx.Process(
+                target=evaluator_main, args=(cfg, ev_stop), daemon=True
+            )
+            ev_proc.start()
+
+    tokenizer = None
+    if cfg.tokenizer_path:
+        import transformers
+
+        tokenizer = transformers.AutoTokenizer.from_pretrained(cfg.tokenizer_path)
+    util = DatasetUtility(
+        seed=cfg.dataset.seed, dp_rank=0, world_size=1, tokenizer=tokenizer
+    )
+    dataset = make_dataset(
+        cfg.dataset.name, util, path=cfg.dataset.path,
+        max_length=cfg.dataset.max_length,
+    )
+    total = cfg.control.total_train_steps
+    actor, ref, critic = _load_ppo_engines(cfg, total)
+    decode_fn = None
+    if tokenizer is not None:
+        decode_fn = lambda ids: tokenizer.decode(ids, skip_special_tokens=True)
+    worker = SyncPPOTrainerWorker(
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        actor_engine=actor,
+        dataset=dataset,
+        hp=cfg.ppo,
+        ghp=cfg.gconfig,
+        control=TrainerControl(
+            total_train_steps=total,
+            save_freq_steps=cfg.control.save_freq_steps,
+        ),
+        batch_size=cfg.batch_size,
+        mb_spec=cfg.mb_spec,
+        ref_engine=ref,
+        critic_engine=critic,
+        ema_ref_eta=cfg.ema_ref_eta,
+        decode_fn=decode_fn,
+        hf_family=cfg.hf_family,
+        metric_logger=MetricLogger(constants.get_log_root()),
+        seed=cfg.seed,
+    )
+    try:
+        worker.run()
+    finally:
+        if ev_proc is not None:
+            # graceful stop: the evaluator runs one final sweep so the last
+            # checkpoint export is always scored
+            ev_stop.set()
+            ev_proc.join(timeout=300)
+            if ev_proc.is_alive():
+                ev_proc.terminate()
+                ev_proc.join(timeout=10)
+    return 0
 
 
 def run_sft(cfg) -> int:

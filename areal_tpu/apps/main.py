@@ -18,7 +18,7 @@ def main(argv=None):
     )
     parser = argparse.ArgumentParser(prog="areal_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
-    for cmd in ("sft", "async-ppo"):
+    for cmd in ("sft", "async-ppo", "sync-ppo"):
         p = sub.add_parser(cmd)
         p.add_argument("--config", default=None, help="YAML config path")
         p.add_argument(
@@ -30,12 +30,16 @@ def main(argv=None):
     from areal_tpu.experiments import (
         AsyncPPOExperiment,
         SFTExperiment,
+        SyncPPOExperiment,
         load_config,
     )
 
     if args.cmd == "sft":
         cfg = load_config(SFTExperiment, args.config, args.overrides)
         return launcher.run_sft(cfg)
+    if args.cmd == "sync-ppo":
+        cfg = load_config(SyncPPOExperiment, args.config, args.overrides)
+        return launcher.run_sync_ppo(cfg)
     cfg = load_config(AsyncPPOExperiment, args.config, args.overrides)
     return launcher.run_async_ppo(cfg)
 

@@ -8,9 +8,11 @@ processes by the launcher (``areal_tpu/apps/launcher.py``).
 from areal_tpu.experiments.config import (  # noqa: F401
     AsyncPPOExperiment,
     DatasetSpec,
+    EvaluatorSpec,
     GenFleetSpec,
     ModelSpec,
     RolloutSpec,
     SFTExperiment,
+    SyncPPOExperiment,
     load_config,
 )

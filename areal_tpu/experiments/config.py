@@ -96,6 +96,22 @@ class TrainerControlSpec:
 
 
 @dataclasses.dataclass
+class EvaluatorSpec:
+    """Checkpoint-watching evaluator (≈ ``cli_args.AutomaticEvaluator``)."""
+
+    enabled: bool = False
+    dataset: Optional[DatasetSpec] = None   # defaults to the train dataset
+    max_prompts: Optional[int] = 64
+    gconfig: GenerationHyperparameters = dataclasses.field(
+        default_factory=lambda: GenerationHyperparameters(
+            n=1, greedy=True, max_new_tokens=1024
+        )
+    )
+    poll_interval: float = 30.0
+    device: str = "cpu"   # evaluation runs off the training chip by default
+
+
+@dataclasses.dataclass
 class AsyncPPOExperiment:
     """≈ ``AsyncPPOMATHConfig`` (``async_exp/async_ppo_math_exp.py``)."""
 
@@ -123,6 +139,46 @@ class AsyncPPOExperiment:
     recover_mode: str = "disabled"    # disabled | auto | resume
     recover_retries: int = 1
     trainer_device: str = ""
+    ema_ref_eta: Optional[float] = None   # EMA reference-model update weight
+    evaluator: EvaluatorSpec = dataclasses.field(default_factory=EvaluatorSpec)
+
+    @property
+    def mb_spec(self) -> MicroBatchSpec:
+        return MicroBatchSpec(max_tokens_per_mb=self.max_tokens_per_mb)
+
+
+@dataclasses.dataclass
+class SyncPPOExperiment:
+    """Sync PPO: generate on the trainer's own weights, then update — zero
+    off-policyness (≈ ``realhf/experiments/common/ppo_math_exp.py:29``); the
+    staleness-ablation control for async experiments."""
+
+    experiment_name: str = "sync-ppo"
+    trial_name: str = "trial0"
+    fileroot: str = ""
+    seed: int = 1
+    actor: ModelSpec = dataclasses.field(default_factory=ModelSpec)
+    critic: Optional[ModelSpec] = None
+    use_ref_model: bool = True
+    ema_ref_eta: Optional[float] = None
+    hf_family: str = "qwen2"
+    tokenizer_path: Optional[str] = None
+    dataset: DatasetSpec = dataclasses.field(default_factory=DatasetSpec)
+    ppo: PPOHyperparameters = dataclasses.field(
+        default_factory=lambda: PPOHyperparameters(
+            use_decoupled_loss=False, recompute_logprob=False
+        )
+    )
+    gconfig: GenerationHyperparameters = dataclasses.field(
+        default_factory=GenerationHyperparameters
+    )
+    control: TrainerControlSpec = dataclasses.field(
+        default_factory=TrainerControlSpec
+    )
+    batch_size: int = 32              # prompts per step
+    max_tokens_per_mb: int = 16384
+    trainer_device: str = ""
+    evaluator: EvaluatorSpec = dataclasses.field(default_factory=EvaluatorSpec)
 
     @property
     def mb_spec(self) -> MicroBatchSpec:
@@ -176,30 +232,32 @@ _DATACLASS_FIELDS = {}
 
 
 def _register_nested(cls):
+    import typing
+
+    known = {
+        c.__name__: c
+        for c in (
+            ModelSpec, DatasetSpec, GenFleetSpec, RolloutSpec, ManagerSpec,
+            TrainerControlSpec, PPOHyperparameters, GenerationHyperparameters,
+            OptimizerConfig, EvaluatorSpec,
+        )
+    }
     for f in dataclasses.fields(cls):
-        # resolve nested dataclass types for dict->dataclass conversion
+        # resolve nested dataclass types (incl. Optional[X]) for the
+        # dict->dataclass conversion in _from_dict
         t = f.type
         if isinstance(t, str):
-            t = {
-                "ModelSpec": ModelSpec,
-                "Optional[ModelSpec]": ModelSpec,
-                "DatasetSpec": DatasetSpec,
-                "Optional[DatasetSpec]": DatasetSpec,
-                "GenFleetSpec": GenFleetSpec,
-                "RolloutSpec": RolloutSpec,
-                "ManagerSpec": ManagerSpec,
-                "TrainerControlSpec": TrainerControlSpec,
-                "PPOHyperparameters": PPOHyperparameters,
-                "GenerationHyperparameters": GenerationHyperparameters,
-                "OptimizerConfig": OptimizerConfig,
-            }.get(t)
+            t = known.get(t.removeprefix("Optional[").removesuffix("]"))
+        elif typing.get_origin(t) is typing.Union:
+            args = [a for a in typing.get_args(t) if a is not type(None)]
+            t = args[0] if len(args) == 1 else None
         if t is not None and dataclasses.is_dataclass(t):
             _DATACLASS_FIELDS[(cls, f.name)] = t
 
 
 for _cls in (
-    AsyncPPOExperiment, SFTExperiment, ModelSpec, RolloutSpec, GenFleetSpec,
-    PPOHyperparameters,
+    AsyncPPOExperiment, SyncPPOExperiment, SFTExperiment, ModelSpec,
+    RolloutSpec, GenFleetSpec, PPOHyperparameters, EvaluatorSpec,
 ):
     _register_nested(_cls)
 

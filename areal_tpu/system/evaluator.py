@@ -1,0 +1,164 @@
+"""Automatic evaluator: watch checkpoints, score them, publish results.
+
+Counterpart of the reference's ``AutomaticEvaluator``
+(``realhf/scheduler/evaluator.py:160``): a loop that discovers new
+checkpoints under the save root (``step{N}`` dirs written by the trainers),
+evaluates each exactly once, records results durably (so a restarted
+evaluator never re-runs finished steps — the reference recovers the same way
+from its eval_output dirs), and logs scores.
+
+Where the reference submits slurm containers running its offline eval stack,
+the TPU version calls a pluggable ``eval_fn(ckpt_path) -> {metric: value}``
+in-process; the default loads the checkpoint into a TrainEngine, generates
+over a held-out prompt set on the trainer mesh (``train/generation.py``),
+and math-verifies the answers (pass@1 / pass@k over the group).
+"""
+
+import json
+import logging
+import os
+import re
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.base.metrics import MetricLogger
+
+logger = logging.getLogger("areal_tpu.evaluator")
+
+_STEP_RE = re.compile(r"^step(\d+)$")
+
+
+def discover_checkpoints(save_root: str) -> Dict[int, str]:
+    """step number -> checkpoint dir, for every complete ``step{N}`` export
+    (a dir is complete once config.json exists — it is written last)."""
+    out: Dict[int, str] = {}
+    if not os.path.isdir(save_root):
+        return out
+    for name in os.listdir(save_root):
+        m = _STEP_RE.match(name)
+        path = os.path.join(save_root, name)
+        if m and os.path.exists(os.path.join(path, "config.json")):
+            out[int(m.group(1))] = path
+    return out
+
+
+class AutomaticEvaluator:
+    """Poll ``save_root`` and evaluate each new checkpoint exactly once.
+
+    :param eval_fn: ``(ckpt_path) -> {metric: float}``.
+    :param output_path: jsonl of ``{"step": N, "ckpt": ..., metrics...}`` —
+        doubles as the recovery record (already-present steps are skipped).
+    """
+
+    def __init__(
+        self,
+        save_root: str,
+        eval_fn: Callable[[str], Dict[str, float]],
+        output_path: str,
+        metric_logger: Optional[MetricLogger] = None,
+        poll_interval: float = 5.0,
+    ):
+        self.save_root = save_root
+        self.eval_fn = eval_fn
+        self.output_path = output_path
+        self.metrics = metric_logger
+        self.poll_interval = poll_interval
+        self.done: Dict[int, Dict[str, float]] = {}
+        if os.path.exists(output_path):
+            with open(output_path) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    self.done[int(rec["step"])] = {
+                        k: v for k, v in rec.items() if k not in ("step", "ckpt")
+                    }
+            logger.info(
+                "recovered %d finished evaluations: steps %s",
+                len(self.done),
+                sorted(self.done),
+            )
+
+    def step_once(self) -> List[int]:
+        """One poll: evaluate every unevaluated checkpoint (ascending step
+        order). Returns the steps evaluated this call."""
+        ckpts = discover_checkpoints(self.save_root)
+        todo = sorted(s for s in ckpts if s not in self.done)
+        for step in todo:
+            path = ckpts[step]
+            t0 = time.perf_counter()
+            try:
+                result = self.eval_fn(path)
+            except Exception:
+                logger.exception("evaluation of %s failed; will NOT retry", path)
+                result = {"eval_failed": 1.0}
+            dt = time.perf_counter() - t0
+            self.done[step] = result
+            os.makedirs(os.path.dirname(self.output_path) or ".", exist_ok=True)
+            with open(self.output_path, "a") as f:
+                f.write(json.dumps({"step": step, "ckpt": path, **result}) + "\n")
+            if self.metrics is not None:
+                self.metrics.log(result, step, prefix="eval")
+            logger.info("evaluated step %d in %.1fs: %s", step, dt, result)
+        return todo
+
+    def run(self, should_stop: Callable[[], bool], final_sweep: bool = True):
+        """Poll until ``should_stop()``; optionally sweep once more after the
+        stop signal so the last checkpoint is never missed."""
+        while not should_stop():
+            self.step_once()
+            time.sleep(self.poll_interval)
+        if final_sweep:
+            self.step_once()
+
+
+def make_generation_eval_fn(
+    model_cfg,
+    parallel,
+    dataset,
+    ghp,
+    decode_fn=None,
+    reward_fn=None,
+    max_prompts: Optional[int] = None,
+    seed: int = 0,
+):
+    """Default eval_fn: load the HF checkpoint, greedy-or-sampled generate
+    over the held-out prompt set, math-verify, return pass@1 and pass@group
+    (≈ the reference's eval_and_aggregate math path)."""
+    from areal_tpu.parallel.mesh import ParallelConfig
+    from areal_tpu.system.sync_trainer import math_reward_fn
+    from areal_tpu.train.engine import TrainEngine
+    from areal_tpu.train.generation import SyncGenerator
+
+    reward_fn = reward_fn or math_reward_fn
+    decode_fn = decode_fn or (lambda ids: " ".join(map(str, ids)))
+    # engine + generator live across checkpoints so the generation program
+    # compiles once, not per evaluation (only the weights change)
+    state: Dict[str, object] = {}
+
+    def eval_fn(ckpt_path: str) -> Dict[str, float]:
+        if "eng" not in state:
+            state["eng"] = TrainEngine(model_cfg, parallel)
+            state["gen"] = SyncGenerator(state["eng"])
+        eng, gen = state["eng"], state["gen"]
+        eng.load_hf(ckpt_path)
+        n = len(dataset) if max_prompts is None else min(max_prompts, len(dataset))
+        metadata = getattr(dataset, "metadata", {})
+        pass1, passk = [], []
+        for i in range(n):
+            s = dataset[i]
+            qid = str(s.ids[0])
+            prompt = np.asarray(s.data["packed_prompts"]).tolist()
+            (group,) = gen.generate([prompt], ghp, seed=seed + i)
+            answers = [decode_fn(o.tokens[len(prompt):].tolist()) for o in group]
+            rws = reward_fn(qid, answers, metadata.get(qid, {}))
+            oks = [r > 0 for r in rws]
+            pass1.append(float(np.mean(oks)))
+            passk.append(float(any(oks)))
+        return {
+            "pass@1": float(np.mean(pass1)) if pass1 else 0.0,
+            f"pass@{ghp.n}": float(np.mean(passk)) if passk else 0.0,
+            "n_prompts": float(n),
+        }
+
+    return eval_fn

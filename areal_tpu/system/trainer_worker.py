@@ -29,6 +29,8 @@ import numpy as np
 
 from areal_tpu.api.data import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model import PPOHyperparameters, make_interface
+from areal_tpu.experiments import graphs
+from areal_tpu.system.function_executor import FunctionExecutor
 from areal_tpu.base import constants, name_resolve, names, recover
 from areal_tpu.base.metrics import MetricLogger
 from areal_tpu.base.timeutil import EpochStepTimeFreqCtl
@@ -67,6 +69,9 @@ class AsyncPPOTrainerWorker:
         critic_engine: Optional[TrainEngine] = None,
         hf_family: str = "qwen2",
         metric_logger: Optional[MetricLogger] = None,
+        ema_ref_eta: Optional[float] = None,
+        graph=None,
+        interfaces=None,
     ):
         self.experiment_name = experiment_name
         self.trial_name = trial_name
@@ -81,12 +86,27 @@ class AsyncPPOTrainerWorker:
         self.hf_family = hf_family
         self.metrics = metric_logger
 
-        self.actor_if = make_interface("ppo_actor", hp=hp, hf_family=hf_family)
-        self.critic_if = (
-            make_interface("ppo_critic", hp=hp, kl_ctl=self.actor_if.kl_ctl)
-            if critic_engine
-            else None
+        # The training step is a declared dataflow graph (critic on/off,
+        # EMA-ref, custom algorithms = graph config, not trainer edits).
+        # Callers may inject their own (graph, interfaces) pair.
+        if graph is None:
+            graph, interfaces = graphs.build_ppo_graph(
+                hp,
+                use_ref=ref_engine is not None,
+                use_critic=critic_engine is not None,
+                ema_ref_eta=ema_ref_eta,
+                mb_spec=self.mb_spec,
+                hf_family=hf_family,
+            )
+        engines = {"actor": actor_engine}
+        if ref_engine is not None:
+            engines["ref"] = ref_engine
+        if critic_engine is not None:
+            engines["critic"] = critic_engine
+        self.executor = FunctionExecutor(
+            graph, engines, interfaces, default_mb_spec=self.mb_spec
         )
+        self.actor_if = self.executor.interfaces.get("actor_train")
         self.step = 0
         self.samples_consumed = 0
         self._buffer: List[SequenceSample] = []
@@ -162,29 +182,10 @@ class AsyncPPOTrainerWorker:
     # ------------------------------------------------------------------ #
 
     def train_step(self, sample: SequenceSample) -> Dict[str, float]:
-        stats: Dict[str, float] = {}
-        # ref_inf: frozen reference logprobs (skipped when kl_ctl == 0)
-        if self.ref_engine is not None:
-            ref_out = self.actor_if.inference(self.ref_engine, sample, self.mb_spec)
-            ref_out.remap_keys_({"prox_logp": "packed_ref_logprobs"})
-            sample.update_(ref_out)
-        # critic_inf
-        if self.critic_if is not None:
-            sample.update_(
-                self.critic_if.inference(self.critic_engine, sample, self.mb_spec)
-            )
-        # actor_inf: proximal logprob recompute (decoupled loss)
-        if self.hp.use_decoupled_loss or self.hp.recompute_logprob:
-            sample.update_(
-                self.actor_if.inference(self.actor_engine, sample, self.mb_spec)
-            )
-        # train
-        stats.update(self.actor_if.train_step(self.actor_engine, sample, self.mb_spec))
-        if self.critic_if is not None:
-            stats.update(
-                self.critic_if.train_step(self.critic_engine, sample, self.mb_spec)
-            )
-        return stats
+        """One level-ordered traversal of the declared MFC graph
+        (ref_inf/critic_inf/actor_inf → actor_train/critic_train by
+        default; see ``experiments/graphs.build_ppo_graph``)."""
+        return self.executor.run(sample)
 
     def run_step(self) -> Optional[Dict[str, float]]:
         sample = self._collect_batch()
@@ -209,10 +210,11 @@ class AsyncPPOTrainerWorker:
             self.control.save_freq_steps
             and self.step % self.control.save_freq_steps == 0
         ):
-            self.actor_if.save(
-                self.actor_engine,
-                os.path.join(constants.get_save_root(), f"step{self.step}"),
-            )
+            save_dir = os.path.join(constants.get_save_root(), f"step{self.step}")
+            if self.actor_if is not None:
+                self.actor_if.save(self.actor_engine, save_dir)
+            else:  # custom graph without an "actor_train" node
+                self.actor_engine.save_hf(save_dir, self.hf_family)
         # process 0's timer decides for everyone: save_recover_checkpoint
         # contains collectives, so a wall-clock boundary straddled across
         # hosts must not split the control flow

@@ -1,0 +1,182 @@
+"""Dataflow-graph (MFC) layer: build/validate/level-order + executor.
+
+Counterpart of the reference's DFG tests (``realhf/api/core/dfg.py:238``
+build path + ``realhf/system/function_executor.py`` traversal): algorithms
+are declared graphs, and critic on/off + EMA-ref are pure config changes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.dfg import MFCDef, ParamReallocHook, build_graph
+from areal_tpu.api.model import PPOHyperparameters
+from areal_tpu.experiments.graphs import ROLLOUT_BATCH_KEYS, build_ppo_graph
+from areal_tpu.models.config import ModelConfig
+from areal_tpu.parallel.mesh import ParallelConfig
+from areal_tpu.system.function_executor import FunctionExecutor
+from areal_tpu.train.engine import OptimizerConfig, TrainEngine
+
+TINY = ModelConfig(
+    n_layers=2, n_q_heads=4, n_kv_heads=2, head_dim=8, hidden_dim=32,
+    intermediate_dim=64, vocab_size=128, dtype="float32",
+)
+
+
+def _mfc(name, model="m", itype="inference", ins=(), outs=()):
+    return MFCDef(
+        name=name, model_name=model, interface_type=itype,
+        input_keys=tuple(ins), output_keys=tuple(outs),
+    )
+
+
+class TestBuildGraph:
+    def test_level_order_from_key_deps(self):
+        g = build_graph(
+            [
+                _mfc("train", itype="train_step", ins=("ids", "adv")),
+                _mfc("inf_a", ins=("ids",), outs=("lp",)),
+                _mfc("inf_b", ins=("ids", "lp"), outs=("adv",)),
+            ],
+            batch_keys=("ids",),
+        )
+        assert [m.name for level in g.levels for m in level] == [
+            "inf_a", "inf_b", "train"
+        ]
+        assert g.producers == {"lp": "inf_a", "adv": "inf_b"}
+
+    def test_missing_input_raises(self):
+        with pytest.raises(ValueError, match="needs key 'adv'"):
+            build_graph([_mfc("t", ins=("adv",))], batch_keys=("ids",))
+
+    def test_duplicate_producer_raises(self):
+        with pytest.raises(ValueError, match="produced by both"):
+            build_graph(
+                [_mfc("a", outs=("x",)), _mfc("b", outs=("x",))],
+                batch_keys=(),
+            )
+
+    def test_cycle_raises(self):
+        with pytest.raises(ValueError, match="cycle"):
+            build_graph(
+                [_mfc("a", ins=("y",), outs=("x",)), _mfc("b", ins=("x",), outs=("y",))],
+                batch_keys=(),
+            )
+
+    def test_bad_interface_type_raises(self):
+        with pytest.raises(ValueError, match="interface_type"):
+            MFCDef(name="x", model_name="m", interface_type="trane_step")
+
+
+class TestPPOGraph:
+    def test_grpo_minimal(self):
+        # critic-free, no ref model: 2 nodes only
+        g, ifaces = build_ppo_graph(
+            PPOHyperparameters(disable_value=True), use_ref=False, use_critic=False
+        )
+        assert g.names == ["actor_inf", "actor_train"]
+        assert ifaces["actor_inf"] is ifaces["actor_train"]  # one KL state
+
+    def test_full_ppo_levels(self):
+        g, ifaces = build_ppo_graph(
+            PPOHyperparameters(), use_ref=True, use_critic=True
+        )
+        level_names = [[m.name for m in lvl] for lvl in g.levels]
+        assert level_names == [
+            ["actor_inf", "critic_inf", "ref_inf"],
+            ["actor_train", "critic_train"],
+        ]
+        # critic shares the actor's KL controller
+        assert ifaces["critic_train"].kl_ctl is ifaces["actor_train"].kl_ctl
+
+    def test_ema_ref_is_config(self):
+        g, _ = build_ppo_graph(
+            PPOHyperparameters(), use_ref=True, use_critic=False, ema_ref_eta=0.3
+        )
+        (hook,) = next(m for m in g.mfcs if m.name == "actor_train").post_hooks
+        assert hook == ParamReallocHook(source="actor", target="ref", eta=0.3)
+        with pytest.raises(ValueError, match="EMA reference requires"):
+            build_ppo_graph(
+                PPOHyperparameters(), use_ref=False, use_critic=False,
+                ema_ref_eta=0.3,
+            )
+
+
+def _ppo_sample(rng, n=6):
+    lens = [int(x) for x in rng.integers(6, 12, size=n)]
+    lps = []
+    for ln in lens:
+        lp = np.zeros(ln, np.float32)
+        lp[2:] = -1.0
+        lps.append(lp)
+    return SequenceSample.from_default(
+        ids=list(range(n)), seqlens=lens,
+        data={
+            "packed_input_ids": rng.integers(0, 128, sum(lens)).astype(np.int64),
+            "prompt_mask": np.concatenate(
+                [np.r_[np.ones(2, bool), np.zeros(ln - 2, bool)] for ln in lens]
+            ),
+            "packed_logprobs": np.concatenate(lps),
+            "packed_ref_logprobs": np.concatenate(lps) * 0.95,
+            "rewards": rng.normal(size=n).astype(np.float32),
+            "seq_no_eos_mask": np.zeros(n, bool),
+        },
+    )
+
+
+@pytest.fixture(scope="module")
+def engines():
+    par = ParallelConfig(data=2, fsdp=2, model=2)
+    actor = TrainEngine(TINY, par, OptimizerConfig(lr=1e-3)).init_random(0)
+    actor.setup_optimizer(total_train_steps=20)
+    ref = TrainEngine(TINY, par).init_random(1)
+    return actor, ref
+
+
+class TestExecutor:
+    def test_graph_driven_ppo_step(self, engines, rng):
+        actor, ref = engines
+        hp = PPOHyperparameters(disable_value=True)
+        g, ifaces = build_ppo_graph(hp, use_ref=True, use_critic=False)
+        ex = FunctionExecutor(
+            g, {"actor": actor, "ref": ref}, ifaces,
+            default_mb_spec=MicroBatchSpec(),
+        )
+        sample = _ppo_sample(rng)
+        stats = ex.run(sample)
+        assert np.isfinite(stats["actor_loss"])
+        # the graph's inference nodes attached their keys to the batch
+        assert "prox_logp" in sample.keys
+        assert "packed_ref_logprobs" in sample.keys
+
+    def test_ema_hook_moves_ref_toward_actor(self, engines, rng):
+        actor, ref = engines
+        hp = PPOHyperparameters(disable_value=True)
+        g, ifaces = build_ppo_graph(
+            hp, use_ref=True, use_critic=False, ema_ref_eta=0.5
+        )
+        ex = FunctionExecutor(
+            g, {"actor": actor, "ref": ref}, ifaces,
+            default_mb_spec=MicroBatchSpec(),
+        )
+        a0 = np.asarray(jax.tree.leaves(actor.params)[0])
+        r0 = np.asarray(jax.tree.leaves(ref.params)[0])
+        ex.run(_ppo_sample(rng))
+        a1 = np.asarray(jax.tree.leaves(actor.params)[0])
+        r1 = np.asarray(jax.tree.leaves(ref.params)[0])
+        np.testing.assert_allclose(r1, 0.5 * r0 + 0.5 * a1, atol=1e-5)
+
+    def test_undeclared_output_raises(self, engines, rng):
+        actor, ref = engines
+        mfc = MFCDef(
+            name="inf", model_name="actor", interface_type="inference",
+            interface_impl="ppo_actor",
+            input_keys=("packed_input_ids",),
+            output_keys=("nonexistent_key",),
+        )
+        g = build_graph([mfc], batch_keys=ROLLOUT_BATCH_KEYS)
+        ex = FunctionExecutor(g, {"actor": actor}, default_mb_spec=MicroBatchSpec())
+        with pytest.raises(ValueError, match="declared outputs"):
+            ex.run(_ppo_sample(rng))

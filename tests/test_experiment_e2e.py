@@ -77,6 +77,46 @@ def test_sft_experiment(tmp_path):
     assert len(lines) == 3 and "sft/loss" in lines[0]
 
 
+def test_sync_ppo_experiment(tmp_path):
+    """In-process sync-PPO (generate-on-trainer) for 2 steps with a save."""
+    from areal_tpu.apps import launcher
+    from areal_tpu.experiments import SyncPPOExperiment, load_config
+
+    data = str(tmp_path / "math.jsonl")
+    _write_prompt_dataset(data)
+    cfg = load_config(SyncPPOExperiment, None, [
+        "experiment_name=sppo-test",
+        "trial_name=t0",
+        f"fileroot={tmp_path}/root",
+        f"dataset.path={data}",
+        "batch_size=2",
+        "max_tokens_per_mb=512",
+        "control.total_train_steps=2",
+        "control.save_freq_steps=2",
+        f"actor.arch={json.dumps(TINY_ARCH)}",
+        "actor.parallel=d2m1",
+        "actor.optimizer.lr=0.0001",
+        "use_ref_model=true",
+        "trainer_device=cpu",
+        'gconfig={"n": 2, "max_new_tokens": 12}',
+        'ppo={"ppo_n_minibatches": 1, "disable_value": true,'
+        ' "use_decoupled_loss": false, "recompute_logprob": false}',
+    ])
+    rc = launcher.run_sync_ppo(cfg)
+    assert rc == 0
+    metrics = os.path.join(
+        f"{tmp_path}/root", "logs", "sppo-test", "t0", "metrics.jsonl"
+    )
+    lines = [json.loads(l) for l in open(metrics)]
+    assert len(lines) == 2
+    assert np.isfinite(lines[-1]["sync_ppo/actor_loss"])
+    assert "sync_ppo/reward_mean" in lines[-1]
+    save_dir = os.path.join(
+        f"{tmp_path}/root", "checkpoints", "sppo-test", "t0", "step2"
+    )
+    assert os.path.exists(os.path.join(save_dir, "model.safetensors"))
+
+
 @pytest.mark.slow
 def test_async_ppo_experiment(tmp_path):
     """Full multiprocess async-PPO world for 2 training steps."""
