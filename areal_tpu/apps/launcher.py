@@ -111,9 +111,11 @@ def gen_server_main(cfg, server_idx: int):
     )
 
     async def main():
+        from areal_tpu.system.worker_base import ExperimentStatusWatch, Heartbeat
+
         port = network.find_free_port()
         host = "127.0.0.1"
-        await serve(
+        runner = await serve(
             engine, host, port, decode_steps=cfg.gen.decode_steps_per_chunk
         )
         name_resolve.add(
@@ -121,8 +123,16 @@ def gen_server_main(cfg, server_idx: int):
             f"http://{host}:{port}",
             replace=True,
         )
-        while True:
-            await asyncio.sleep(3600)
+        # orphan protection: exit when the experiment dies
+        # (≈ reference generation_server.py:209-222)
+        watch = ExperimentStatusWatch(cfg.experiment_name, cfg.trial_name)
+        hb = Heartbeat(
+            cfg.experiment_name, cfg.trial_name, f"gen_server/{server_idx}"
+        ).start()
+        while watch.alive():
+            await asyncio.sleep(1.0)
+        hb.stop()
+        await runner.cleanup()
 
     asyncio.run(main())
 
@@ -153,6 +163,8 @@ def gserver_manager_main(cfg):
     )
 
     async def main():
+        from areal_tpu.system.worker_base import ExperimentStatusWatch, Heartbeat
+
         manager = GserverManager(mcfg)
         # wait for all advertised gen servers
         for i in range(cfg.gen.n_servers):
@@ -162,8 +174,11 @@ def gserver_manager_main(cfg):
             )
         manager.discover_servers()
         await serve_manager(manager, "127.0.0.1", network.find_free_port())
-        while True:
-            await asyncio.sleep(3600)
+        watch = ExperimentStatusWatch(cfg.experiment_name, cfg.trial_name)
+        hb = Heartbeat(cfg.experiment_name, cfg.trial_name, "gserver_manager").start()
+        while watch.alive():
+            await asyncio.sleep(1.0)
+        hb.stop()
 
     asyncio.run(main())
 
@@ -208,7 +223,16 @@ def rollout_worker_main(cfg, worker_idx: int):
         new_tokens_per_chunk=cfg.rollout.new_tokens_per_chunk,
         max_concurrent_tasks=cfg.rollout.max_concurrent_tasks,
     )
-    asyncio.run(worker.run_async())
+    from areal_tpu.system.worker_base import ExperimentStatusWatch, Heartbeat
+
+    watch = ExperimentStatusWatch(cfg.experiment_name, cfg.trial_name)
+    hb = Heartbeat(
+        cfg.experiment_name, cfg.trial_name, f"rollout_worker/{worker_idx}"
+    ).start()
+    try:
+        asyncio.run(worker.run_async(should_stop=lambda: not watch.alive()))
+    finally:
+        hb.stop()
 
 
 def _load_ppo_engines(cfg, total_steps):
@@ -267,6 +291,7 @@ def trainer_main(cfg):
         hf_family=cfg.hf_family,
         metric_logger=MetricLogger(constants.get_log_root()),
         ema_ref_eta=cfg.ema_ref_eta,
+        max_head_offpolicyness=cfg.manager.max_head_offpolicyness,
     )
     if cfg.recover_mode in ("auto", "resume"):
         worker.load_recover_checkpoint()
@@ -294,6 +319,9 @@ def evaluator_main(cfg, stop_event=None):
     ds_spec = spec.dataset or cfg.dataset
     tokenizer = None
     tok_path = getattr(cfg, "tokenizer_path", None)
+    if not tok_path and getattr(cfg, "rollout", None) is not None:
+        # async experiments configure the tokenizer on the rollout agent
+        tok_path = cfg.rollout.agent_args.get("tokenizer_path")
     if tok_path:
         import transformers
 
@@ -322,7 +350,15 @@ def evaluator_main(cfg, stop_event=None):
         metric_logger=MetricLogger(constants.get_log_root()),
         poll_interval=spec.poll_interval,
     )
-    should_stop = stop_event.is_set if stop_event is not None else lambda: False
+    from areal_tpu.system.worker_base import ExperimentStatusWatch
+
+    watch = ExperimentStatusWatch(cfg.experiment_name, cfg.trial_name)
+
+    def should_stop():
+        if stop_event is not None and stop_event.is_set():
+            return True
+        return not watch.alive()
+
     ev.run(should_stop=should_stop)
 
 
@@ -416,10 +452,16 @@ def run_async_ppo(cfg) -> int:
     """Launch the full async-PPO world; restart on failure per recover_mode.
     Returns the trainer's exit code of the final attempt."""
     attempts = 1 + (cfg.recover_retries if cfg.recover_mode == "auto" else 0)
+    # the launcher owns the experiment lifecycle record: workers poll it and
+    # self-terminate when it goes away (system/worker_base.py)
+    _setup_worker_env(cfg, "")
+    from areal_tpu.system import worker_base
+
     for attempt in range(attempts):
         if attempt > 0:
             logger.warning("recover attempt %d/%d", attempt, attempts - 1)
             cfg = dataclasses.replace(cfg, recover_mode="resume")
+        worker_base.mark_experiment_running(cfg.experiment_name, cfg.trial_name)
         procs = _spawn_all(cfg)
         trainer = procs["trainer"]
         failed = False
@@ -438,6 +480,22 @@ def run_async_ppo(cfg) -> int:
                 if failed:
                     break
         finally:
+            # graceful first: flip the status so watchers exit on their own,
+            # then terminate stragglers
+            worker_base.mark_experiment_stopped(cfg.experiment_name, cfg.trial_name)
+            deadline = time.time() + 5
+            for name, p in procs.items():
+                if name != "evaluator":
+                    p.join(timeout=max(0.1, deadline - time.time()))
+            for name, p in procs.items():
+                if name != "evaluator" and p.is_alive():
+                    p.terminate()
+            ev = procs.get("evaluator")
+            if ev is not None:
+                # the evaluator notices the stop on its next poll and runs a
+                # final sweep so the LAST checkpoint is always scored — give
+                # it real time before terminating
+                ev.join(timeout=300)
             for p in procs.values():
                 if p.is_alive():
                     p.terminate()
@@ -464,6 +522,10 @@ def run_sync_ppo(cfg) -> int:
     from areal_tpu.system.sync_trainer import SyncPPOTrainerWorker
     from areal_tpu.system.trainer_worker import TrainerControl
 
+    from areal_tpu.system import worker_base
+
+    if multihost.is_main():
+        worker_base.mark_experiment_running(cfg.experiment_name, cfg.trial_name)
     ev_proc = ev_stop = None
     if cfg.evaluator.enabled and multihost.is_main():
         ctx = mp.get_context("spawn")
@@ -515,6 +577,8 @@ def run_sync_ppo(cfg) -> int:
     try:
         worker.run()
     finally:
+        if multihost.is_main():
+            worker_base.mark_experiment_stopped(cfg.experiment_name, cfg.trial_name)
         if ev_proc is not None:
             # graceful stop: the evaluator runs one final sweep so the last
             # checkpoint export is always scored

@@ -140,6 +140,7 @@ class AsyncPPOExperiment:
     recover_retries: int = 1
     trainer_device: str = ""
     ema_ref_eta: Optional[float] = None   # EMA reference-model update weight
+    tokenizer_path: Optional[str] = None  # for the evaluator's answer decode
     evaluator: EvaluatorSpec = dataclasses.field(default_factory=EvaluatorSpec)
 
     @property

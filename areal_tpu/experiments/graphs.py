@@ -56,13 +56,18 @@ def build_ppo_graph(
     interfaces: Dict[str, ModelInterface] = {}
     mfcs: List[MFCDef] = []
     batch_keys = tuple(batch_keys)
-    if ref_logprobs_in_batch and not use_ref:
+    # The ref_inf node only exists to feed the KL penalty; with kl_ctl == 0
+    # (e.g. an EMA-only reference) it would be a full-model forward per step
+    # producing logprobs a zero coefficient multiplies away — skip the node
+    # (the "ref" ENGINE may still exist for ParamReallocHooks).
+    use_ref_inf = use_ref and hp.kl_ctl != 0
+    if ref_logprobs_in_batch and not use_ref_inf:
         batch_keys += ("packed_ref_logprobs",)
 
-    have_ref_lp = use_ref or "packed_ref_logprobs" in batch_keys
+    have_ref_lp = use_ref_inf or "packed_ref_logprobs" in batch_keys
     ref_lp_key = ("packed_ref_logprobs",) if have_ref_lp else ()
 
-    if use_ref:
+    if use_ref_inf:
         mfcs.append(
             MFCDef(
                 name="ref_inf",

@@ -1,0 +1,107 @@
+"""Trainer-side sequence buffer: staleness-ordered, capacity-bounded intake.
+
+Counterpart of the reference's ``AsyncIOSequenceBuffer``
+(``realhf/system/buffer.py:117``). The reference's key-readiness machinery
+(producers fill keys incrementally) collapses here — trajectories arrive
+complete from the rollout stream — so what remains is the part that matters
+at scale:
+
+- **staleness priority**: batches pop oldest-version-first, bounding the
+  off-policyness actually consumed (the fleet gate bounds what's *started*;
+  this bounds what's *trained on*);
+- **version-window drop**: samples older than ``max_head_offpolicyness``
+  versions behind the trainer are discarded at intake/pop, never reaching
+  the optimizer (the reference discards by version window on arrival);
+- **capacity bound**: the buffer never grows unbounded when rollouts outrun
+  training (oldest dropped first, loudly).
+"""
+
+import logging
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from areal_tpu.api.data import SequenceSample
+
+logger = logging.getLogger("areal_tpu.buffer")
+
+
+def sample_version_start(sample: SequenceSample) -> Optional[int]:
+    """Minimum generation-start version across the group's sequences, or
+    None when the sample carries no version tags (sync data, tests)."""
+    if sample.data is None or "version_start" not in (sample.data or {}):
+        return None
+    v = np.asarray(sample.data["version_start"])
+    return int(v.min()) if v.size else None
+
+
+class SequenceBuffer:
+    """Not thread-safe; the trainer is the only consumer (the stream dataset
+    already serializes arrivals through its queue)."""
+
+    def __init__(
+        self,
+        capacity: int = 16384,
+        max_version_lag: Optional[int] = None,
+    ):
+        self.capacity = capacity
+        self.max_version_lag = max_version_lag
+        self._items: List[Tuple[int, int, SequenceSample]] = []  # (ver, seq, s)
+        self._arrival = 0
+        self.n_dropped_stale = 0
+        self.n_dropped_capacity = 0
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def put(self, sample: SequenceSample, current_version: int = 0):
+        v = sample_version_start(sample)
+        if self._too_stale(v, current_version):
+            self.n_dropped_stale += 1
+            logger.warning(
+                "dropping stale sample %s: version_start=%s, trainer at v%d "
+                "(window %s)",
+                sample.ids, v, current_version, self.max_version_lag,
+            )
+            return
+        self._items.append((v if v is not None else current_version,
+                            self._arrival, sample))
+        self._arrival += 1
+        if len(self._items) > self.capacity:
+            self._items.sort(key=lambda t: (t[0], t[1]))
+            dropped = self._items.pop(0)
+            self.n_dropped_capacity += 1
+            logger.warning(
+                "buffer over capacity %d: dropped oldest sample %s",
+                self.capacity, dropped[2].ids,
+            )
+
+    def _too_stale(self, v: Optional[int], current_version: int) -> bool:
+        return (
+            self.max_version_lag is not None
+            and v is not None
+            and current_version - v > self.max_version_lag
+        )
+
+    def pop_batch(
+        self, n: int, current_version: int = 0
+    ) -> List[SequenceSample]:
+        """Up to ``n`` samples, oldest version first (ties: arrival order).
+        Samples that became over-stale while queued are discarded here —
+        they never reach the optimizer."""
+        self._items.sort(key=lambda t: (t[0], t[1]))
+        kept: List[Tuple[int, int, SequenceSample]] = []
+        out: List[SequenceSample] = []
+        for v, a, s in self._items:
+            if self._too_stale(v, current_version):
+                self.n_dropped_stale += 1
+                logger.warning(
+                    "dropping stale queued sample %s (v%s << v%d)",
+                    s.ids, v, current_version,
+                )
+            elif len(out) < n:
+                out.append(s)
+            else:
+                kept.append((v, a, s))
+        self._items = kept
+        return out

@@ -81,7 +81,12 @@ class AutomaticEvaluator:
 
     def step_once(self) -> List[int]:
         """One poll: evaluate every unevaluated checkpoint (ascending step
-        order). Returns the steps evaluated this call."""
+        order). Returns the steps attempted this call.
+
+        Failures are remembered only in-memory (no retry storm within this
+        process) and are NOT persisted — a restarted evaluator retries them,
+        so a transient error never leaves a permanent hole in the curve.
+        """
         ckpts = discover_checkpoints(self.save_root)
         todo = sorted(s for s in ckpts if s not in self.done)
         for step in todo:
@@ -90,8 +95,11 @@ class AutomaticEvaluator:
             try:
                 result = self.eval_fn(path)
             except Exception:
-                logger.exception("evaluation of %s failed; will NOT retry", path)
-                result = {"eval_failed": 1.0}
+                logger.exception(
+                    "evaluation of %s failed; will retry after restart", path
+                )
+                self.done[step] = {"eval_failed": 1.0}
+                continue
             dt = time.perf_counter() - t0
             self.done[step] = result
             os.makedirs(os.path.dirname(self.output_path) or ".", exist_ok=True)
@@ -144,12 +152,14 @@ def make_generation_eval_fn(
         eng.load_hf(ckpt_path)
         n = len(dataset) if max_prompts is None else min(max_prompts, len(dataset))
         metadata = getattr(dataset, "metadata", {})
+        samples = [dataset[i] for i in range(n)]
+        qids = [str(s.ids[0]) for s in samples]
+        prompts = [np.asarray(s.data["packed_prompts"]).tolist() for s in samples]
+        # ONE batched generate for the whole eval set: a per-prompt loop
+        # would pay n padded device dispatches + a compile per length bucket
+        groups = gen.generate(prompts, ghp, seed=seed) if prompts else []
         pass1, passk = [], []
-        for i in range(n):
-            s = dataset[i]
-            qid = str(s.ids[0])
-            prompt = np.asarray(s.data["packed_prompts"]).tolist()
-            (group,) = gen.generate([prompt], ghp, seed=seed + i)
+        for qid, prompt, group in zip(qids, prompts, groups):
             answers = [decode_fn(o.tokens[len(prompt):].tolist()) for o in group]
             rws = reward_fn(qid, answers, metadata.get(qid, {}))
             oks = [r > 0 for r in rws]

@@ -148,8 +148,11 @@ class RolloutWorker:
                 continue
             await q.put(bundle)
 
-    async def run_async(self, max_steps: Optional[int] = None):
-        """Main poll loop (≈ ``_poll_async:204``)."""
+    async def run_async(self, max_steps: Optional[int] = None, should_stop=None):
+        """Main poll loop (≈ ``_poll_async:204``). ``should_stop`` is polled
+        each iteration — the launcher passes the experiment death watch so an
+        orphaned worker exits instead of spinning forever
+        (≈ reference rollout_worker.py:216-228)."""
         dispatch = asyncio.get_event_loop().create_task(self._dispatch_replies())
         steps = 0
         carry: Optional[SequenceSample] = None  # denied sample, retried first
@@ -158,6 +161,8 @@ class RolloutWorker:
                 timeout=aiohttp.ClientTimeout(total=300)
             ) as session:
                 while max_steps is None or steps < max_steps:
+                    if should_stop is not None and should_stop():
+                        break
                     steps += 1
                     if len(self._tasks) < self.max_concurrent_tasks:
                         prompt = carry if carry is not None else self.load_next_data()

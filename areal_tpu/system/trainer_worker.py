@@ -30,6 +30,7 @@ import numpy as np
 from areal_tpu.api.data import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model import PPOHyperparameters, make_interface
 from areal_tpu.experiments import graphs
+from areal_tpu.system.buffer import SequenceBuffer
 from areal_tpu.system.function_executor import FunctionExecutor
 from areal_tpu.base import constants, name_resolve, names, recover
 from areal_tpu.base.metrics import MetricLogger
@@ -72,6 +73,8 @@ class AsyncPPOTrainerWorker:
         ema_ref_eta: Optional[float] = None,
         graph=None,
         interfaces=None,
+        max_head_offpolicyness: Optional[int] = None,
+        buffer_capacity: int = 16384,
     ):
         self.experiment_name = experiment_name
         self.trial_name = trial_name
@@ -109,7 +112,19 @@ class AsyncPPOTrainerWorker:
         self.actor_if = self.executor.interfaces.get("actor_train")
         self.step = 0
         self.samples_consumed = 0
-        self._buffer: List[SequenceSample] = []
+        # keys the graph needs from the rollout stream (everything else the
+        # MFCs produce themselves) — used for loud intake validation
+        self._required_keys = {
+            k
+            for m in self.executor.graph.mfcs
+            for k in m.input_keys
+            if k not in self.executor.graph.producers
+        }
+        # staleness-ordered intake; over-stale samples never reach the
+        # optimizer (reference discards by version window on arrival)
+        self._buffer = SequenceBuffer(
+            capacity=buffer_capacity, max_version_lag=max_head_offpolicyness
+        )
         self._ckpt_ctl = EpochStepTimeFreqCtl(
             freq_step=control.ckpt_freq_steps, freq_sec=control.ckpt_freq_secs
         )
@@ -148,34 +163,56 @@ class AsyncPPOTrainerWorker:
     # data intake
     # ------------------------------------------------------------------ #
 
+    def _intake(self, samples: List[SequenceSample]):
+        """Validate + buffer arrivals. A trajectory missing a key the graph
+        needs is dropped with an ERROR — silently intersecting keys across
+        the batch would strip (e.g.) ref logprobs from everyone and zero the
+        KL penalty without a trace."""
+        version = self.actor_engine.version
+        for s in samples:
+            missing = self._required_keys - set(s.keys)
+            if missing:
+                logger.error(
+                    "malformed rollout %s: missing required keys %s "
+                    "(has %s) — dropped",
+                    s.ids, sorted(missing), sorted(s.keys),
+                )
+                continue
+            self._buffer.put(s, current_version=version)
+
     def _collect_batch(self, timeout: float = 600.0) -> Optional[SequenceSample]:
+        """Multi-host note: the train step is collective, so EITHER every
+        host proceeds or none does — the have-data decisions are allreduced
+        in a fixed sequence every loop iteration, so hosts never diverge into
+        mismatched collectives. (Single-host: the allreduces are identities.)
+        """
         t0 = time.time()
-        while len(self._buffer) < self.train_batch_size:
-            got = self.stream.get_batch(
-                self.train_batch_size - len(self._buffer), timeout=0.2
+        while True:
+            while len(self._buffer) < self.train_batch_size:
+                self._intake(
+                    self.stream.get_batch(
+                        self.train_batch_size - len(self._buffer), timeout=0.2
+                    )
+                )
+                if time.time() - t0 > timeout:
+                    break
+            if not multihost.allreduce_min(np.int64(bool(len(self._buffer)))):
+                return None  # some host is starved; everyone keeps its buffer
+            batch = self._buffer.pop_batch(
+                self.train_batch_size, current_version=self.actor_engine.version
             )
-            self._buffer.extend(got)
-            if time.time() - t0 > timeout:
+            if multihost.allreduce_min(np.int64(bool(batch))):
                 break
-        # The train step is collective, so EITHER every host proceeds or none
-        # does — one starved host exiting alone would leave the others
-        # blocked in the next allgather forever. (Single-host: allreduce_min
-        # is the identity, so this is just the empty-buffer check.)
-        if not multihost.allreduce_min(np.int64(bool(self._buffer))):
-            return None  # some host is starved; everyone keeps its buffer
-        batch, self._buffer = (
-            self._buffer[: self.train_batch_size],
-            self._buffer[self.train_batch_size :],
-        )
-        # only token-aligned / per-seq keys the train MFCs consume — agent
-        # extras like packed_prompts/birth_time stay out of the device batch
+            # some host's queue was entirely over-stale: put ours back
+            # (re-checked against the window) and refill together
+            for s in batch:
+                self._buffer.put(s, current_version=self.actor_engine.version)
+            if multihost.allreduce_max(np.int64(time.time() - t0 > timeout)):
+                return None  # agreed timeout: all hosts give up together
+        # only the keys the train MFCs consume — agent extras like
+        # packed_prompts/birth_time stay out of the device batch
         # (≈ MFC input_keys, realhf/api/core/dfg.py:56)
-        train_keys = {
-            "packed_input_ids", "prompt_mask", "packed_logprobs",
-            "packed_ref_logprobs", "rewards", "seq_no_eos_mask",
-        }
-        keys = set.intersection(*(set(s.keys) for s in batch)) & train_keys
-        return SequenceSample.gather(batch, keys=keys)
+        return SequenceSample.gather(batch, keys=self._required_keys)
 
     # ------------------------------------------------------------------ #
     # one training step = one MFC-graph traversal
@@ -300,14 +337,23 @@ class SFTTrainerWorker:
         self.epoch = 0
         self._shuffle_seed = shuffle_seed
 
+    def _batches(self, dataset, order):
+        """Batch-sized gathered chunks of ``dataset`` in the given index
+        order — materializing a whole split as ONE sample OOMs at any
+        realistic size (each chunk is packed/micro-batched by the engine)."""
+        for lo in range(0, len(order), self.batch_size):
+            items = [dataset[i] for i in order[lo : lo + self.batch_size]]
+            if items:
+                yield SequenceSample.gather(items)
+
     def _epoch_batches(self):
         idx = np.random.RandomState(self._shuffle_seed + self.epoch).permutation(
             len(self.dataset)
         )
-        for lo in range(0, len(idx), self.batch_size):
-            items = [self.dataset[i] for i in idx[lo : lo + self.batch_size]]
-            if items:
-                yield SequenceSample.gather(items)
+        yield from self._batches(self.dataset, list(idx))
+
+    def _eval_batches(self):
+        yield from self._batches(self.eval_dataset, range(len(self.eval_dataset)))
 
     def run(self):
         if len(self.dataset) == 0:
@@ -331,10 +377,7 @@ class SFTTrainerWorker:
                     break
             self.epoch += 1
             if self.eval_dataset is not None:
-                items = [self.eval_dataset[i] for i in range(len(self.eval_dataset))]
-                ev = self.interface.evaluate(
-                    self.engine, [SequenceSample.gather(items)]
-                )
+                ev = self.interface.evaluate(self.engine, list(self._eval_batches()))
                 logger.info("epoch %d eval: %s", self.epoch, ev)
                 if self.metrics is not None:
                     self.metrics.log(ev, self.step, prefix="sft_eval")

@@ -1,0 +1,149 @@
+"""Worker lifecycle: experiment death watch + heartbeats.
+
+Counterpart of the reference's worker framework
+(``realhf/system/worker_base.py:474`` poll/control loop) and its
+orphan-protection pattern: every long-running worker checks the trial's
+``experiment_status`` key in name_resolve and exits when the experiment is
+no longer alive (reference: 300 s timeout loops in
+``realhf/system/rollout_worker.py:216-228`` and
+``generation_server.py:209-222``) — so a crashed launcher/trainer never
+leaves generation servers or rollout workers spinning forever.
+
+The launcher is the lifecycle owner: it marks the experiment RUNNING at
+spawn and STOPPED at teardown (``mark_experiment_running/stopped``). Workers
+poll via :class:`ExperimentStatusWatch` and optionally publish heartbeats
+(`worker_status/<name>` timestamps) the launcher can inspect.
+"""
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from areal_tpu.base import name_resolve, names
+
+logger = logging.getLogger("areal_tpu.worker_base")
+
+STATUS_RUNNING = "running"
+STATUS_STOPPED = "stopped"
+
+# A worker exits when the status key has been absent/not-RUNNING for this
+# long (grace for launcher startup races and slow shared filesystems).
+DEFAULT_DEATH_TIMEOUT = 300.0
+
+
+def mark_experiment_running(experiment_name: str, trial_name: str):
+    name_resolve.add(
+        names.experiment_status(experiment_name, trial_name),
+        STATUS_RUNNING,
+        replace=True,
+    )
+
+
+def mark_experiment_stopped(experiment_name: str, trial_name: str):
+    name_resolve.add(
+        names.experiment_status(experiment_name, trial_name),
+        STATUS_STOPPED,
+        replace=True,
+    )
+
+
+class ExperimentStatusWatch:
+    """Polls ``experiment_status``; ``alive()`` goes False once the key has
+    been missing or STOPPED for ``timeout`` seconds continuously.
+
+    STOPPED flips dead immediately (explicit teardown); a *missing* key only
+    after the timeout, so workers that start before the launcher writes the
+    key don't bail out.
+    """
+
+    def __init__(
+        self,
+        experiment_name: str,
+        trial_name: str,
+        timeout: float = DEFAULT_DEATH_TIMEOUT,
+        poll_interval: float = 10.0,
+    ):
+        self.key = names.experiment_status(experiment_name, trial_name)
+        self.timeout = timeout
+        self.poll_interval = poll_interval
+        self._last_seen = time.monotonic()
+        self._last_poll = 0.0
+        self._stopped = False
+
+    def alive(self) -> bool:
+        now = time.monotonic()
+        if self._stopped:
+            return False
+        if now - self._last_poll < self.poll_interval:
+            return True
+        self._last_poll = now
+        try:
+            status = name_resolve.get(self.key)
+        except name_resolve.NameEntryNotFoundError:
+            status = None
+        if status == STATUS_RUNNING:
+            self._last_seen = now
+            return True
+        if status == STATUS_STOPPED:
+            logger.info("experiment marked stopped; shutting down")
+            self._stopped = True
+            return False
+        if now - self._last_seen > self.timeout:
+            logger.warning(
+                "experiment_status missing for %.0fs (> %.0fs); assuming the "
+                "experiment died — shutting down",
+                now - self._last_seen,
+                self.timeout,
+            )
+            self._stopped = True
+            return False
+        return True
+
+
+class Heartbeat:
+    """Background thread publishing ``worker_status/<name>`` timestamps."""
+
+    def __init__(
+        self,
+        experiment_name: str,
+        trial_name: str,
+        worker_name: str,
+        interval: float = 30.0,
+    ):
+        self.key = names.worker_status(experiment_name, trial_name, worker_name)
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _beat(self):
+        while not self._stop.is_set():
+            try:
+                name_resolve.add(self.key, str(time.time()), replace=True)
+            except Exception:
+                logger.exception("heartbeat write failed")
+            self._stop.wait(self.interval)
+
+    def start(self):
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def last_heartbeat(
+    experiment_name: str, trial_name: str, worker_name: str
+) -> Optional[float]:
+    """Unix time of the worker's last beat, or None if never seen."""
+    try:
+        return float(
+            name_resolve.get(
+                names.worker_status(experiment_name, trial_name, worker_name)
+            )
+        )
+    except (name_resolve.NameEntryNotFoundError, ValueError):
+        return None

@@ -1,0 +1,197 @@
+"""Staleness hardening: version-window accounting matrix + trainer intake.
+
+Mirrors the reference's off-policyness control matrix
+(``tests/system/test_gserver_manager.py:173-270``) and adds the trainer-side
+guarantee the reference enforces on arrival: samples older than
+``max_head_offpolicyness`` versions NEVER reach the optimizer.
+"""
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.data import SequenceSample
+from areal_tpu.base import name_resolve, names
+from areal_tpu.system.buffer import SequenceBuffer, sample_version_start
+from areal_tpu.system.gserver_manager import GserverManager, GserverManagerConfig
+
+
+def _traj(qid, version_start, n=2, ln=6, extra_keys=True):
+    lens = [ln] * n
+    lp = np.zeros(n * ln, np.float32)
+    data = {
+        "packed_input_ids": np.arange(n * ln, dtype=np.int64),
+        "prompt_mask": np.zeros(n * ln, bool),
+        "packed_logprobs": lp,
+        "rewards": np.ones(n, np.float32),
+        "seq_no_eos_mask": np.zeros(n, bool),
+    }
+    seqlens = {
+        "packed_input_ids": [lens],
+        "prompt_mask": [lens],
+        "packed_logprobs": [lens],
+        "rewards": [[1] * n],
+        "seq_no_eos_mask": [[1] * n],
+    }
+    if extra_keys:
+        data["version_start"] = np.full(n, version_start, np.int32)
+        seqlens["version_start"] = [[1] * n]
+    return SequenceSample(
+        keys=set(seqlens), ids=[qid], seqlens=seqlens, data=data
+    )
+
+
+class TestOffpolicynessMatrix:
+    """is_staled over (offpolicyness x consumed x version): the gate allows
+    starting rollouts only while
+    (consumed + running) // train_batch_size <= offpolicyness + version."""
+
+    @pytest.mark.parametrize("off", [0, 1, 4])
+    @pytest.mark.parametrize("bs", [4, 16])
+    def test_gate_boundary(self, off, bs):
+        cfg = GserverManagerConfig(
+            experiment_name="stale-mx", trial_name=f"o{off}b{bs}",
+            train_batch_size=bs, max_head_offpolicyness=off,
+            max_concurrent_rollouts=10_000,
+        )
+        m = GserverManager(cfg, server_urls=["http://x"])
+        m.version = 0
+        key = names.training_samples(cfg.experiment_name, cfg.trial_name)
+        # exactly at the window edge: consumed = (off+1)*bs - 1 -> allowed
+        name_resolve.add(key, str((off + 1) * bs - 1), replace=True)
+        assert not m.is_staled()
+        # one more sample crosses the boundary -> staled
+        name_resolve.add(key, str((off + 1) * bs), replace=True)
+        assert m.is_staled()
+        # a version bump widens the window by exactly one batch
+        m.version = 1
+        assert not m.is_staled()
+        name_resolve.add(key, str((off + 2) * bs), replace=True)
+        assert m.is_staled()
+
+    def test_running_counts_toward_window(self):
+        cfg = GserverManagerConfig(
+            experiment_name="stale-mx", trial_name="running",
+            train_batch_size=4, max_head_offpolicyness=1,
+            max_concurrent_rollouts=10_000,
+        )
+        m = GserverManager(cfg, server_urls=["http://x"])
+        m.version = 0
+        name_resolve.add(
+            names.training_samples(cfg.experiment_name, cfg.trial_name),
+            "0", replace=True,
+        )
+        m.rollout_stat.running = 7   # (0+7)//4 = 1 <= 1 -> ok
+        assert not m.is_staled()
+        m.rollout_stat.running = 8   # (0+8)//4 = 2 > 1 -> staled
+        assert m.is_staled()
+
+
+class TestSequenceBuffer:
+    def test_version_priority_pop(self):
+        buf = SequenceBuffer()
+        buf.put(_traj("new", version_start=5), current_version=5)
+        buf.put(_traj("old", version_start=1), current_version=5)
+        buf.put(_traj("mid", version_start=3), current_version=5)
+        out = buf.pop_batch(2, current_version=5)
+        assert [s.ids[0] for s in out] == ["old", "mid"]
+        assert [s.ids[0] for s in buf.pop_batch(5)] == ["new"]
+
+    def test_overstale_dropped_at_put_and_pop(self):
+        buf = SequenceBuffer(max_version_lag=2)
+        buf.put(_traj("ancient", version_start=0), current_version=5)  # drop
+        assert len(buf) == 0 and buf.n_dropped_stale == 1
+        buf.put(_traj("ok", version_start=4), current_version=5)
+        # trainer advances while the sample queues; it expires at pop
+        assert buf.pop_batch(1, current_version=9) == []
+        assert buf.n_dropped_stale == 2
+
+    def test_untagged_samples_never_dropped(self):
+        buf = SequenceBuffer(max_version_lag=0)
+        buf.put(_traj("sync", version_start=0, extra_keys=False),
+                current_version=100)
+        assert len(buf) == 1
+        assert sample_version_start(buf.pop_batch(1)[0]) is None
+
+    def test_capacity_drops_oldest(self):
+        buf = SequenceBuffer(capacity=2)
+        buf.put(_traj("v1", version_start=1), current_version=1)
+        buf.put(_traj("v2", version_start=2), current_version=2)
+        buf.put(_traj("v3", version_start=3), current_version=3)
+        assert len(buf) == 2 and buf.n_dropped_capacity == 1
+        assert [s.ids[0] for s in buf.pop_batch(5)] == ["v2", "v3"]
+
+
+class TestTrainerIntake:
+    """Over-stale and malformed rollouts never reach the optimizer."""
+
+    class _Stream:
+        def __init__(self, items):
+            self.items = list(items)
+
+        def get_batch(self, n, timeout=None):
+            out, self.items = self.items[:n], self.items[n:]
+            return out
+
+    def _worker(self, stream, actor, window=2):
+        from areal_tpu.api.model import PPOHyperparameters
+        from areal_tpu.system.trainer_worker import (
+            AsyncPPOTrainerWorker,
+            TrainerControl,
+        )
+
+        return AsyncPPOTrainerWorker(
+            "stale-int", "t0",
+            actor_engine=actor,
+            stream=stream,
+            hp=PPOHyperparameters(disable_value=True),
+            control=TrainerControl(total_train_steps=1),
+            train_batch_size=4,
+            max_head_offpolicyness=window,
+        )
+
+    @pytest.fixture(scope="class")
+    def actor(self):
+        from areal_tpu.models.config import ModelConfig
+        from areal_tpu.parallel.mesh import ParallelConfig
+        from areal_tpu.train.engine import OptimizerConfig, TrainEngine
+
+        eng = TrainEngine(
+            ModelConfig(
+                n_layers=1, n_q_heads=2, n_kv_heads=1, head_dim=8,
+                hidden_dim=16, intermediate_dim=32, vocab_size=64,
+                dtype="float32",
+            ),
+            ParallelConfig(),
+            OptimizerConfig(),
+        )
+        eng.init_random(0)
+        return eng
+
+    def test_stale_samples_never_reach_optimizer(self, actor):
+        actor.version = 10
+        stream = self._Stream([
+            _traj("fresh1", version_start=9),
+            _traj("ancient", version_start=1),   # 10-1 > 2 -> dropped
+            _traj("fresh2", version_start=10),
+        ])
+        w = self._worker(stream, actor, window=2)
+        batch = w._collect_batch(timeout=0.5)
+        assert sorted(batch.ids) == ["fresh1", "fresh2"]
+        assert w._buffer.n_dropped_stale == 1
+
+    def test_malformed_rollout_dropped_loudly(self, actor, caplog):
+        actor.version = 0
+        bad = _traj("bad", version_start=0)
+        bad.keys.discard("packed_logprobs")
+        del bad.seqlens["packed_logprobs"]
+        del bad.data["packed_logprobs"]
+        stream = self._Stream([_traj("good", version_start=0), bad])
+        w = self._worker(stream, actor)
+        import logging
+
+        with caplog.at_level(logging.ERROR):
+            batch = w._collect_batch(timeout=0.5)
+        assert batch.ids == ["good"]
+        assert any("missing required keys" in r.message for r in caplog.records)
+        # the surviving batch still carries every graph-required key
+        assert w._required_keys <= set(batch.keys)

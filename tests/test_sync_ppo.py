@@ -194,8 +194,9 @@ class TestEvaluator:
         assert ev.step_once() == []
         assert calls == []
 
-    def test_failed_eval_recorded_not_retried(self, tmp_path):
+    def test_failed_eval_retries_after_restart_only(self, tmp_path):
         root = str(tmp_path / "save")
+        out = str(tmp_path / "eval.jsonl")
         self._fake_ckpt(root, 1)
         calls = []
 
@@ -203,8 +204,13 @@ class TestEvaluator:
             calls.append(path)
             raise RuntimeError("boom")
 
-        ev = AutomaticEvaluator(root, eval_fn, str(tmp_path / "eval.jsonl"))
+        ev = AutomaticEvaluator(root, eval_fn, out)
         assert ev.step_once() == [1]
         assert ev.done[1] == {"eval_failed": 1.0}
-        assert ev.step_once() == []
+        assert ev.step_once() == []          # no in-process retry storm
         assert len(calls) == 1
+        # failures are NOT persisted: a restarted evaluator retries the step
+        assert not os.path.exists(out)
+        ev2 = AutomaticEvaluator(root, lambda p: {"score": 1.0}, out)
+        assert ev2.step_once() == [1]
+        assert ev2.done[1] == {"score": 1.0}
