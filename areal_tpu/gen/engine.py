@@ -1,25 +1,33 @@
-"""Slot-based continuous-batching generation engine.
+"""Slot-based continuous-batching generation engine over a paged KV pool.
 
 TPU-native counterpart of the reference's generation stack: continuous
 batching (``real_llm_generate.py:670`` inflight batching), chunked
 interruptible generation (the SGLang ``InterruptAllReq`` patch +
-``partial_rollout.py``), and weight hot-reload
-(``update_weights_from_disk``). Redesigned for XLA:
+``partial_rollout.py``), weight hot-reload (``update_weights_from_disk``),
+and SGLang's radix/paged KV memory. Redesigned for XLA:
 
-- A fixed pool of ``max_slots`` sequence slots shares one static KV cache
-  ``[L, B, S, Hkv, D]`` — slots turn over as sequences finish (continuous
-  batching without dynamic shapes).
-- Admission: prompts are bucketed to power-of-two lengths, prefilled in a
-  small batch, and scattered into free slots (padding rows carry an
-  out-of-range slot index, which XLA scatter drops — no masking plumbing).
+- KV memory is a POOL of fixed-size pages (``models/transformer.PagedKVCache``
+  + ``gen/pages.py``); each slot holds a page table, so HBM scales with the
+  tokens actually resident — not ``max_slots x max_seqlen`` slabs — and
+  identical prompts SHARE their full prompt pages (one prefill serves a
+  whole GRPO group; the reason gserver routing is sticky per qid).
+- Admission = CHUNKED PREFILL: prompts stream through a fixed
+  ``[n_rows, page]`` extend program, so compile count is bounded by the
+  admit-row buckets alone — never by prompt length.
 - Decode: a jitted ``lax.scan`` chunk of N steps; stop-token detection and
-  per-slot max-token caps run on device, so the host syncs once per chunk.
-- Interruption: the host simply stops issuing chunks and harvests partial
-  outputs; clients re-submit with accumulated tokens (the reference's
+  per-slot caps run on device, so the host syncs once per chunk.
+- Interruption: the host stops issuing chunks and harvests partial outputs;
+  clients re-submit with accumulated tokens (the reference's
   chunked-generation protocol, ``partial_rollout.py:106-114``).
-- Weight update: swap the params pytree between chunks — the jitted chunk is
-  parametric in params, so this is free (no engine restart, ≈ interrupt +
-  update_weights_from_disk).
+- Weight update: swap the params pytree between chunks (the jitted programs
+  are parametric in params). The prefix cache is invalidated — KV from old
+  weights must not seed new-policy generations; in-flight slots keep their
+  old-KV context, which is exactly the partial-rollout staleness the
+  version_start/version_end tags account for.
+
+Thread-safety: ``submit`` arrives on the server's asyncio thread while
+``step`` runs in an executor thread — ALL mutable engine state
+(slots, page pool, device state, request metadata) is guarded by one RLock.
 """
 
 import dataclasses
@@ -31,15 +39,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from areal_tpu.gen.pages import OutOfPagesError, PagePool, PrefixRegistry
+from areal_tpu.gen.sampling import SamplingParams, sample_tokens
 from areal_tpu.models import transformer as tfm
 from areal_tpu.models.config import ModelConfig
-from areal_tpu.gen.sampling import SamplingParams, sample_tokens
 
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class GenState:
-    cache: tfm.KVCache
+    cache: tfm.PagedKVCache
+    lens: jnp.ndarray           # [B] i32 resident tokens per slot
     last_tokens: jnp.ndarray    # [B] i32 token to feed next decode
     active: jnp.ndarray         # [B] bool
     n_gen: jnp.ndarray          # [B] i32
@@ -74,11 +84,11 @@ class GenOutput:
     version: int = 0
 
 
-def _next_pow2(n: int, lo: int = 64) -> int:
-    p = lo
-    while p < n:
-        p *= 2
-    return p
+@dataclasses.dataclass
+class _SlotInfo:
+    rid: str
+    pages: List[int]          # owned pages (refcount held by this slot)
+    borrowed: List[int]       # shared prefix pages (one ref held)
 
 
 class GenerationEngine:
@@ -92,18 +102,29 @@ class GenerationEngine:
         stop_token_ids: Sequence[int] = (),
         admit_buckets: Sequence[int] = (1, 2, 4, 8),
         seed: int = 0,
+        page_size: int = 128,
+        n_pages: Optional[int] = None,
+        enable_prefix_cache: bool = True,
     ):
         self.cfg = cfg
         self.params = params
         self.B = max_slots
-        self.S = max_seqlen
+        self.page = page_size
+        self.M = -(-max_seqlen // page_size)      # table width (pages/slot)
+        self.S = self.M * page_size
         self.G = max_new_tokens_cap
         self.version = 0
         self.admit_buckets = sorted(admit_buckets)
         self.global_stop_ids = list(stop_token_ids)
         self.max_stop_ids = 8
+        self.enable_prefix_cache = enable_prefix_cache
+        # dense-equivalent pool by default; size it smaller to cap HBM
+        self.n_pages = n_pages if n_pages is not None else self.B * self.M
+        self.pool = PagePool(self.n_pages, page_size)
+        self.prefix = PrefixRegistry(self.pool)
         self.state = GenState(
-            cache=tfm.KVCache.empty(cfg, self.B, self.S),
+            cache=tfm.PagedKVCache.empty(cfg, self.n_pages, page_size),
+            lens=jnp.zeros((self.B,), jnp.int32),
             last_tokens=jnp.zeros((self.B,), jnp.int32),
             active=jnp.zeros((self.B,), bool),
             n_gen=jnp.zeros((self.B,), jnp.int32),
@@ -116,78 +137,107 @@ class GenerationEngine:
             rng=jax.random.key(seed),
         )
         self.accepting = True  # False = decode only, no new admissions
-        self._slot_rid: List[Optional[str]] = [None] * self.B
-        self._pending: List[GenRequest] = []
-        # submit() runs on the server's asyncio thread while step() runs in a
-        # thread-pool executor — guard the pending queue
-        self._pending_lock = threading.Lock()
-        self._req_meta: Dict[str, GenRequest] = {}
-        self._jit_admit: Dict[Tuple[int, int], Any] = {}
-        self._jit_chunk: Dict[int, Any] = {}
         self.paused = False
+        self._slots: List[Optional[_SlotInfo]] = [None] * self.B
+        self._table_host = np.zeros((self.B, self.M), np.int32)
+        self._pending: List[GenRequest] = []
+        self._req_meta: Dict[str, GenRequest] = {}
+        # Two-tier locking: `_lock` guards device state / slots / pool and is
+        # held by step() for a whole decode chunk; `_pending_lock` guards
+        # ONLY the intake queue so submit() on the server's asyncio thread
+        # never blocks behind a running chunk. free_slots/n_running read the
+        # slot list without a lock (GIL-atomic snapshot; metrics may lag one
+        # chunk, which is fine).
+        self._lock = threading.RLock()
+        self._pending_lock = threading.Lock()
+        self._jit_extend: Dict[int, Any] = {}
+        self._jit_commit: Dict[int, Any] = {}
+        self._jit_chunk: Dict[int, Any] = {}
+        # observability
+        self.stats = {
+            "prefill_tokens": 0,        # prompt tokens actually computed
+            "prefix_hit_tokens": 0,     # prompt tokens served from shared pages
+            "prefix_hits": 0,
+            "admitted": 0,
+        }
 
     # ------------------------------------------------------------------ #
     # Client API
     # ------------------------------------------------------------------ #
 
     def submit(self, req: GenRequest):
-        if len(req.input_ids) >= self.S:
+        need = len(req.input_ids) - 1 + min(req.max_new_tokens, self.G)
+        if need > self.S:
             raise ValueError(
-                f"prompt length {len(req.input_ids)} >= max_seqlen {self.S}"
+                f"prompt {len(req.input_ids)} + max_new "
+                f"{req.max_new_tokens} exceeds per-slot capacity {self.S}"
             )
         with self._pending_lock:
             self._pending.append(req)
-        self._req_meta[req.rid] = req
+            self._req_meta[req.rid] = req
 
     def free_slots(self) -> int:
-        return sum(r is None for r in self._slot_rid)
+        return sum(s is None for s in self._slots)
 
     def n_running(self) -> int:
-        return sum(r is not None for r in self._slot_rid)
+        return sum(s is not None for s in self._slots)
+
+    def n_compiles(self) -> int:
+        """Total jitted specializations (stability tested: bounded by the
+        admit buckets + decode chunk sizes, NOT by prompt lengths)."""
+        return len(self._jit_extend) + len(self._jit_commit) + len(self._jit_chunk)
 
     def update_params(self, params, version: Optional[int] = None):
-        """Hot weight swap between decode chunks (≈ interrupt + reload)."""
-        self.params = params
-        self.version = version if version is not None else self.version + 1
+        """Hot weight swap between decode chunks (≈ interrupt + reload).
+        Invalidates the prefix cache: prompt KV computed under old weights
+        must not seed new generations."""
+        with self._lock:
+            self.params = params
+            self.version = version if version is not None else self.version + 1
+            self.prefix.clear()
 
     def pause(self) -> List[GenOutput]:
         """Stop generating and harvest all running slots as interrupted."""
-        self.paused = True
-        outs = []
-        for b, rid in enumerate(self._slot_rid):
-            if rid is not None:
-                outs.append(self._harvest(b, "interrupted"))
-        return outs
+        with self._lock:
+            self.paused = True
+            outs = []
+            for b, s in enumerate(self._slots):
+                if s is not None:
+                    outs.append(self._harvest(b, "interrupted"))
+            return outs
 
     def resume(self):
-        self.paused = False
+        with self._lock:
+            self.paused = False
 
     # ------------------------------------------------------------------ #
-    # Admission
+    # Admission: chunked prefill through the page pool
     # ------------------------------------------------------------------ #
 
-    def _admit_fn(self, n_adm: int, s_bucket: int):
-        key = (n_adm, s_bucket)
-        if key in self._jit_admit:
-            return self._jit_admit[key]
+    def _extend_fn(self, n_rows: int):
+        if n_rows in self._jit_extend:
+            return self._jit_extend[n_rows]
         cfg = self.cfg
 
-        # prefill on prompt[:-1]; the last prompt token is fed to the first
-        # decode step (which writes its KV and samples generation token 1)
-        def admit(params, state: GenState, prompts, last_toks, plens, slots,
-                  temp, top_p, top_k, min_gen, max_gen, stop_ids):
-            small = tfm.KVCache.empty(cfg, n_adm, s_bucket)
-            _, small = tfm.prefill(params, cfg, small, prompts, plens - 1)
-            cache = state.cache
-            k = cache.k.at[:, slots, :s_bucket].set(
-                small.k, mode="drop"
+        def extend(params, state: GenState, tokens, table_rows, start, n_new):
+            cache = tfm.extend_paged(
+                params, cfg, state.cache, tokens, table_rows, start, n_new
             )
-            v = cache.v.at[:, slots, :s_bucket].set(
-                small.v, mode="drop"
-            )
-            lens = cache.lens.at[slots].set(plens - 1, mode="drop")
-            return GenState(
-                cache=tfm.KVCache(k=k, v=v, lens=lens),
+            return dataclasses.replace(state, cache=cache)
+
+        jitted = jax.jit(extend, donate_argnums=(1,))
+        self._jit_extend[n_rows] = jitted
+        return jitted
+
+    def _commit_fn(self, n_rows: int):
+        if n_rows in self._jit_commit:
+            return self._jit_commit[n_rows]
+
+        def commit(state: GenState, slots, last_toks, lens, temp, top_p,
+                   top_k, min_gen, max_gen, stop_ids):
+            return dataclasses.replace(
+                state,
+                lens=state.lens.at[slots].set(lens, mode="drop"),
                 last_tokens=state.last_tokens.at[slots].set(last_toks, mode="drop"),
                 active=state.active.at[slots].set(True, mode="drop"),
                 n_gen=state.n_gen.at[slots].set(0, mode="drop"),
@@ -201,73 +251,160 @@ class GenerationEngine:
                     top_p=state.sp.top_p.at[slots].set(top_p, mode="drop"),
                     top_k=state.sp.top_k.at[slots].set(top_k, mode="drop"),
                 ),
-                rng=state.rng,
             )
 
-        jitted = jax.jit(admit, donate_argnums=(1,))
-        self._jit_admit[key] = jitted
+        jitted = jax.jit(commit, donate_argnums=(0,))
+        self._jit_commit[n_rows] = jitted
         return jitted
+
+    def _row_bucket(self, n: int) -> int:
+        return next(
+            b for b in self.admit_buckets
+            if b >= min(n, self.admit_buckets[-1])
+        )
+
+    def _run_extends(self, rows: List[dict]):
+        """Stream each row's tokens through fixed [n_rows, page] extend
+        programs (rows: dicts with tokens/start/table_row)."""
+        if not rows:
+            return
+        C = self.page
+        i = 0
+        while i < len(rows):
+            n = self._row_bucket(len(rows) - i)
+            chunk_rows = rows[i : i + n]
+            i += len(chunk_rows)
+            max_t = max(len(r["tokens"]) for r in chunk_rows)
+            n_chunks = max(1, -(-max_t // C))
+            tables = np.zeros((n, self.M), np.int32)
+            starts0 = np.zeros((n,), np.int32)
+            all_tokens = np.zeros((n, n_chunks * C), np.int32)
+            counts = np.zeros((n,), np.int32)
+            for j, r in enumerate(chunk_rows):
+                tables[j] = r["table_row"]
+                starts0[j] = r["start"]
+                all_tokens[j, : len(r["tokens"])] = r["tokens"]
+                counts[j] = len(r["tokens"])
+            extend = self._extend_fn(n)
+            for c in range(n_chunks):
+                n_new = np.clip(counts - c * C, 0, C)
+                if not n_new.any():
+                    break
+                self.state = extend(
+                    self.params, self.state,
+                    jnp.asarray(all_tokens[:, c * C : (c + 1) * C]),
+                    jnp.asarray(tables),
+                    jnp.asarray(starts0 + c * C),
+                    jnp.asarray(n_new),
+                )
 
     def _admit_pending(self):
         if not self.accepting:
             return
-        free = [b for b, r in enumerate(self._slot_rid) if r is None]
+        free = [b for b, s in enumerate(self._slots) if s is None]
         if not free:
             return
+        admitted: List[Tuple[GenRequest, int, dict]] = []
+        misses: List[dict] = []
+        hits: List[dict] = []
+        still_pending: List[GenRequest] = []
         with self._pending_lock:
-            take = self._pending[: len(free)]
+            take = self._pending[: len(free) + 8]  # small lookahead
             del self._pending[: len(take)]
-        if not take:
+        while take and free:
+            r = take.pop(0)
+            ids = list(r.input_ids)
+            plen_eff = len(ids) - 1               # prefilled positions
+            max_gen = min(r.max_new_tokens, self.G)
+            n_total = -(-(plen_eff + max_gen) // self.page)
+            n_shared_full = plen_eff // self.page
+            shared: List[int] = []
+            if self.enable_prefix_cache and n_shared_full > 0:
+                shared = self.prefix.lookup(ids, n_shared_full) or []
+            n_owned = n_total - len(shared)
+            if self.pool.n_free < n_owned:
+                self.prefix.evict_lru(n_owned)
+            try:
+                owned = self.pool.alloc(n_owned)
+            except OutOfPagesError:
+                # pool pressure: resident slots / registry hold everything;
+                # retry on a later step
+                if shared:
+                    self.pool.release(shared)
+                still_pending.append(r)
+                break
+            slot = free.pop(0)
+            table_row = np.zeros((self.M,), np.int32)
+            table_row[: len(shared) + len(owned)] = shared + owned
+            self._table_host[slot] = table_row
+            self._slots[slot] = _SlotInfo(rid=r.rid, pages=owned, borrowed=shared)
+            covered = len(shared) * self.page
+            row = {
+                "tokens": ids[covered:plen_eff],
+                "start": covered,
+                "table_row": table_row,
+                "slot": slot,
+            }
+            if shared:
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_hit_tokens"] += covered
+                hits.append(row)
+            else:
+                misses.append(row)
+                if self.enable_prefix_cache and n_shared_full > 0:
+                    # register the full prompt pages for future group members
+                    self.prefix.insert(ids, list(owned[:n_shared_full]))
+            self.stats["prefill_tokens"] += len(row["tokens"])
+            self.stats["admitted"] += 1
+            admitted.append((r, slot, row))
+        still_pending.extend(take)  # slots/pool ran out: back in line
+        if still_pending:
+            with self._pending_lock:
+                self._pending[:0] = still_pending
+        if not admitted:
             return
-        # group by prompt-length bucket (clamped to the cache capacity)
-        groups: Dict[int, List[GenRequest]] = {}
-        for r in take:
-            groups.setdefault(
-                min(_next_pow2(len(r.input_ids)), self.S), []
-            ).append(r)
-        for s_bucket, reqs in groups.items():
-            i = 0
-            while i < len(reqs):
-                n_adm = next(
-                    b for b in self.admit_buckets if b >= min(len(reqs) - i, self.admit_buckets[-1])
-                )
-                chunk = reqs[i : i + n_adm]
-                i += len(chunk)
-                K = self.max_stop_ids
-                prompts = np.zeros((n_adm, s_bucket), np.int32)
-                last_toks = np.zeros((n_adm,), np.int32)
-                plens = np.ones((n_adm,), np.int32)  # dummy rows: plen 1
-                slots = np.full((n_adm,), self.B, np.int32)  # dropped
-                temp = np.ones((n_adm,), np.float32)
-                top_p = np.ones((n_adm,), np.float32)
-                top_k = np.full((n_adm,), 1 << 30, np.int32)
-                min_gen = np.zeros((n_adm,), np.int32)
-                max_gen = np.zeros((n_adm,), np.int32)
-                stop_ids = np.full((n_adm, K), -1, np.int32)
-                for j, r in enumerate(chunk):
-                    ids = np.asarray(r.input_ids, np.int32)
-                    prompts[j, : len(ids)] = ids
-                    last_toks[j] = ids[-1]
-                    plens[j] = len(ids)
-                    slots[j] = free.pop(0)
-                    self._slot_rid[slots[j]] = r.rid
-                    temp[j] = 0.0 if r.greedy else r.temperature
-                    top_p[j] = r.top_p
-                    top_k[j] = min(r.top_k, 1 << 30)
-                    min_gen[j] = r.min_new_tokens
-                    max_gen[j] = min(r.max_new_tokens, self.G, self.S - len(ids))
-                    merged_stop = (
-                        list(dict.fromkeys(self.global_stop_ids + list(r.stop_token_ids)))
-                    )[:K]
-                    stop_ids[j, : len(merged_stop)] = merged_stop
-                admit = self._admit_fn(n_adm, s_bucket)
-                self.state = admit(
-                    self.params, self.state, jnp.asarray(prompts),
-                    jnp.asarray(last_toks), jnp.asarray(plens),
-                    jnp.asarray(slots), jnp.asarray(temp), jnp.asarray(top_p),
-                    jnp.asarray(top_k), jnp.asarray(min_gen),
-                    jnp.asarray(max_gen), jnp.asarray(stop_ids),
-                )
+        # wave 1: unique prompts compute their KV; wave 2: prefix borrowers
+        # extend only their tails (their shared pages were written by wave 1
+        # or by earlier admissions)
+        self._run_extends(misses)
+        self._run_extends(hits)
+        # commit slot state in row buckets
+        i = 0
+        while i < len(admitted):
+            n = self._row_bucket(len(admitted) - i)
+            group = admitted[i : i + n]
+            i += len(group)
+            K = self.max_stop_ids
+            slots = np.full((n,), self.B, np.int32)   # pad rows dropped
+            last_toks = np.zeros((n,), np.int32)
+            lens = np.zeros((n,), np.int32)
+            temp = np.ones((n,), np.float32)
+            top_p = np.ones((n,), np.float32)
+            top_k = np.full((n,), 1 << 30, np.int32)
+            min_gen = np.zeros((n,), np.int32)
+            max_gen = np.zeros((n,), np.int32)
+            stop_ids = np.full((n, K), -1, np.int32)
+            for j, (r, slot, _) in enumerate(group):
+                ids = r.input_ids
+                slots[j] = slot
+                last_toks[j] = ids[-1]
+                lens[j] = len(ids) - 1
+                temp[j] = 0.0 if r.greedy else r.temperature
+                top_p[j] = r.top_p
+                top_k[j] = min(r.top_k, 1 << 30)
+                min_gen[j] = r.min_new_tokens
+                max_gen[j] = min(r.max_new_tokens, self.G)
+                merged = list(
+                    dict.fromkeys(self.global_stop_ids + list(r.stop_token_ids))
+                )[:K]
+                stop_ids[j, : len(merged)] = merged
+            commit = self._commit_fn(n)
+            self.state = commit(
+                self.state, jnp.asarray(slots), jnp.asarray(last_toks),
+                jnp.asarray(lens), jnp.asarray(temp), jnp.asarray(top_p),
+                jnp.asarray(top_k), jnp.asarray(min_gen), jnp.asarray(max_gen),
+                jnp.asarray(stop_ids),
+            )
 
     # ------------------------------------------------------------------ #
     # Decode
@@ -277,16 +414,15 @@ class GenerationEngine:
         if n_steps in self._jit_chunk:
             return self._jit_chunk[n_steps]
         cfg = self.cfg
-        S = self.S
 
-        def one_step(state: GenState, params):
-            logits, cache = tfm.decode_step(
-                params, cfg, state.cache, state.last_tokens, active=state.active
+        def one_step(state: GenState, params, table):
+            logits, cache, new_lens = tfm.decode_step_paged(
+                params, cfg, state.cache, state.last_tokens, table,
+                state.lens, state.active,
             )
             rng, sub = jax.random.split(state.rng)
             tokens, lp = sample_tokens(sub, logits, state.sp)
             tokens = jnp.where(state.active, tokens, state.last_tokens)
-            # record outputs at position n_gen for active slots
             rows = jnp.arange(tokens.shape[0])
             idx = jnp.clip(state.n_gen, 0, state.out_tokens.shape[1] - 1)
             out_tokens = state.out_tokens.at[rows, idx].set(
@@ -299,15 +435,11 @@ class GenerationEngine:
             hit_stop = jnp.any(
                 tokens[:, None] == state.stop_ids, axis=1
             ) & (n_gen >= state.min_gen)
-            active = (
-                state.active
-                & ~hit_stop
-                & (n_gen < state.max_gen)
-                & (cache.lens < S)
-            )
+            active = state.active & ~hit_stop & (n_gen < state.max_gen)
             return dataclasses.replace(
                 state,
                 cache=cache,
+                lens=new_lens,
                 last_tokens=tokens,
                 active=active,
                 n_gen=n_gen,
@@ -316,9 +448,9 @@ class GenerationEngine:
                 rng=rng,
             )
 
-        def chunk(params, state):
+        def chunk(params, state, table):
             def body(s, _):
-                return one_step(s, params), None
+                return one_step(s, params, table), None
 
             state, _ = jax.lax.scan(body, state, None, length=n_steps)
             return state
@@ -345,18 +477,21 @@ class GenerationEngine:
             n = int(n)
             toks = toks[:n].tolist()
             lps = lps[:n].tolist()
-        rid = self._slot_rid[b]
-        self._slot_rid[b] = None
+        info = self._slots[b]
+        self._slots[b] = None
+        self.pool.release(info.pages)
+        if info.borrowed:
+            self.pool.release(info.borrowed)
+        self._table_host[b] = 0
         self.state = dataclasses.replace(
             self.state,
             active=self.state.active.at[b].set(False),
-            cache=dataclasses.replace(
-                self.state.cache, lens=self.state.cache.lens.at[b].set(0)
-            ),
+            lens=self.state.lens.at[b].set(0),
         )
-        self._req_meta.pop(rid, None)
+        with self._pending_lock:
+            self._req_meta.pop(info.rid, None)
         return GenOutput(
-            rid=rid,
+            rid=info.rid,
             output_ids=toks,
             output_logprobs=lps,
             finish_reason=reason,
@@ -365,30 +500,37 @@ class GenerationEngine:
 
     def step(self, decode_steps: int = 16) -> List[GenOutput]:
         """Admit pending requests, run one decode chunk, harvest finished."""
-        if self.paused:
-            return []
-        self._admit_pending()
-        if self.n_running() == 0:
-            return []
-        chunk = self._chunk_fn(decode_steps)
-        self.state = chunk(self.params, self.state)
-        # one host sync per chunk
-        active = np.asarray(self.state.active)
-        n_gen = np.asarray(self.state.n_gen)
-        max_gen = np.asarray(self.state.max_gen)
-        outs = []
-        for b, rid in enumerate(self._slot_rid):
-            if rid is None or active[b]:
-                continue
-            reason = "length" if n_gen[b] >= max_gen[b] else "stop"
-            outs.append(self._harvest(b, reason))
-        return outs
+        with self._lock:
+            if self.paused:
+                return []
+            self._admit_pending()
+            if self.n_running() == 0:
+                return []
+            chunk = self._chunk_fn(decode_steps)
+            self.state = chunk(
+                self.params, self.state, jnp.asarray(self._table_host)
+            )
+            # one host sync per chunk
+            active = np.asarray(self.state.active)
+            n_gen = np.asarray(self.state.n_gen)
+            max_gen = np.asarray(self.state.max_gen)
+            outs = []
+            for b, info in enumerate(self._slots):
+                if info is None or active[b]:
+                    continue
+                reason = "length" if n_gen[b] >= max_gen[b] else "stop"
+                outs.append(self._harvest(b, reason))
+            return outs
 
     def run_until_done(self, decode_steps: int = 16, timeout: float = 600.0):
         """Convenience loop: run until every submitted request finished."""
         outs = []
         t0 = time.time()
-        while (self._pending or self.n_running()) and not self.paused:
+        while True:
+            with self._lock:
+                busy = (self._pending or self.n_running()) and not self.paused
+            if not busy:
+                break
             outs.extend(self.step(decode_steps))
             if time.time() - t0 > timeout:
                 raise TimeoutError("generation did not finish in time")

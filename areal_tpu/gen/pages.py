@@ -1,0 +1,138 @@
+"""Host-side page accounting for the paged KV cache.
+
+Counterpart of SGLang's radix-tree + block allocator that the reference gets
+for free (``patch/sglang/v0.4.6.post4.patch``, SURVEY §2.1): the generation
+engine's KV memory is a pool of fixed-size pages; slots hold page tables
+instead of dense ``[S_max]`` slabs, so HBM scales with tokens actually
+resident, and identical prompts SHARE their full prompt pages via refcounts
+(one prefill serves a whole GRPO group — the reason gserver routing is
+sticky per qid).
+
+Device arrays live in the engine; this module is pure host bookkeeping
+(free list, refcounts, prefix registry) — no jax imports.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class OutOfPagesError(RuntimeError):
+    pass
+
+
+class PagePool:
+    """Fixed pool of KV pages with refcounting (shared prompt pages)."""
+
+    def __init__(self, n_pages: int, page_size: int):
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self._free: List[int] = list(range(n_pages - 1, -1, -1))
+        self._ref = np.zeros(n_pages, np.int32)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """n fresh pages (refcount 1 each); raises OutOfPagesError."""
+        if n > len(self._free):
+            raise OutOfPagesError(
+                f"need {n} pages, {len(self._free)} free of {self.n_pages}"
+            )
+        pages = [self._free.pop() for _ in range(n)]
+        self._ref[pages] = 1
+        return pages
+
+    def ref(self, pages: Sequence[int]):
+        """Share existing pages (+1 each)."""
+        for p in pages:
+            if self._ref[p] <= 0:
+                raise ValueError(f"page {p} is free; cannot share")
+            self._ref[p] += 1
+
+    def release(self, pages: Sequence[int]):
+        """Drop one reference per page; refcount 0 returns it to the pool."""
+        for p in pages:
+            if self._ref[p] <= 0:
+                raise ValueError(f"double free of page {p}")
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                self._free.append(p)
+
+
+@dataclasses.dataclass
+class PrefixEntry:
+    pages: List[int]        # full prompt pages (page_size tokens each)
+    n_tokens: int           # tokens covered = len(pages) * page_size
+    last_used: int          # LRU tick
+
+
+class PrefixRegistry:
+    """prompt prefix -> resident full pages (flat-key radix cache).
+
+    The reference's SGLang radix tree shares arbitrary prefixes; here sharing
+    is keyed on the FULL-PAGE prefix of the prompt (the dominant case —
+    group members of one qid have identical prompts). Entries hold one
+    refcount on their pages; hits add another for the borrowing slot.
+    Weight updates invalidate everything (KV from old params must not serve
+    new-policy generations).
+    """
+
+    def __init__(self, pool: PagePool):
+        self.pool = pool
+        self._entries: Dict[Tuple[int, ...], PrefixEntry] = {}
+        self._tick = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def _key(self, prompt_ids: Sequence[int], n_pages: int) -> Tuple[int, ...]:
+        return tuple(prompt_ids[: n_pages * self.pool.page_size])
+
+    def lookup(self, prompt_ids: Sequence[int], n_full_pages: int) -> Optional[List[int]]:
+        """Pages covering the first ``n_full_pages`` of the prompt, with a
+        reference taken for the caller — or None."""
+        if n_full_pages == 0:
+            return None
+        e = self._entries.get(self._key(prompt_ids, n_full_pages))
+        if e is None:
+            return None
+        self._tick += 1
+        e.last_used = self._tick
+        self.pool.ref(e.pages)
+        return list(e.pages)
+
+    def insert(self, prompt_ids: Sequence[int], pages: List[int]):
+        """Register freshly prefilled full-prompt pages. Takes its own
+        reference (caller keeps theirs)."""
+        if not pages:
+            return
+        key = self._key(prompt_ids, len(pages))
+        if key in self._entries:
+            return  # racing identical prompt; keep the existing entry
+        self.pool.ref(pages)
+        self._tick += 1
+        self._entries[key] = PrefixEntry(
+            pages=list(pages), n_tokens=len(pages) * self.pool.page_size,
+            last_used=self._tick,
+        )
+
+    def evict_lru(self, n_pages_needed: int) -> int:
+        """Release least-recently-used entries until ``n_pages_needed`` could
+        be freed (entries whose pages are still borrowed by running slots
+        free nothing until those slots finish). Returns entries evicted."""
+        evicted = 0
+        for key in sorted(self._entries, key=lambda k: self._entries[k].last_used):
+            if self.pool.n_free >= n_pages_needed:
+                break
+            self.pool.release(self._entries.pop(key).pages)
+            evicted += 1
+        return evicted
+
+    def clear(self):
+        """Invalidate everything (weight update)."""
+        for e in self._entries.values():
+            self.pool.release(e.pages)
+        self._entries.clear()

@@ -500,3 +500,145 @@ def decode_step(
     cache = KVCache(k=ks, v=vs, lens=new_lens)
     x = _norm(cfg, _cast(cfg, params["final_ln"]), x)
     return _head(cfg, params, x), cache
+
+
+# --------------------------------------------------------------------------- #
+# Paged KV generation (page-pool cache; see areal_tpu/gen/pages.py)
+# --------------------------------------------------------------------------- #
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVCache:
+    """KV page pool: ``k/v_pages [L, P, page, Hkv, D]``. Slot state (page
+    tables, lengths) lives with the generation engine — the pool itself has
+    no per-sequence structure, which is exactly what lets prompts share
+    pages (counterpart of SGLang's radix-cache memory, SURVEY §2.1)."""
+
+    k_pages: jnp.ndarray
+    v_pages: jnp.ndarray
+
+    @classmethod
+    def empty(cls, cfg: ModelConfig, n_pages: int, page_size: int) -> "PagedKVCache":
+        shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+        dt = jnp.dtype(cfg.dtype)
+        return cls(k_pages=jnp.zeros(shape, dt), v_pages=jnp.zeros(shape, dt))
+
+
+def _write_pages(pages, new, table, positions, valid):
+    """Scatter new K/V into the pool.
+
+    pages ``[P, page, Hkv, D]``; new ``[B, C, Hkv, D]``; positions ``[B, C]``
+    global per-slot positions; valid ``[B, C]`` (invalid lanes dropped)."""
+    P, page = pages.shape[:2]
+    M = table.shape[1]
+    page_idx = jnp.take_along_axis(
+        table, jnp.clip(positions // page, 0, M - 1), axis=1
+    )
+    flat = page_idx * page + positions % page
+    flat = jnp.where(valid, flat, P * page)  # out of range => dropped
+    flat_pages = pages.reshape(P * page, *pages.shape[2:])
+    flat_pages = flat_pages.at[flat.reshape(-1)].set(
+        new.astype(pages.dtype).reshape(-1, *new.shape[2:]), mode="drop"
+    )
+    return flat_pages.reshape(pages.shape)
+
+
+def extend_paged(
+    params: Params,
+    cfg: ModelConfig,
+    cache: PagedKVCache,
+    tokens: jnp.ndarray,     # [B, C] chunk of prompt tokens
+    table: jnp.ndarray,      # [B, M] page table
+    start: jnp.ndarray,      # [B] tokens already resident per slot
+    n_new: jnp.ndarray,      # [B] valid tokens in this chunk (<= C)
+) -> PagedKVCache:
+    """Chunked prefill: write the chunk's KV into the pages and attend
+    causally over everything resident. Logits are not computed — admission
+    feeds the last prompt token to the first decode step instead."""
+    from areal_tpu.ops import paged_attention as paged_ops
+
+    B, C = tokens.shape
+    positions = start[:, None] + jnp.arange(C)[None, :]
+    valid = jnp.arange(C)[None, :] < n_new[:, None]
+    x = _embed(cfg, params, tokens, positions)
+    if cfg.apply_rotary:
+        cos, sin = rotary_cos_sin(_rotary_cfg(cfg), positions, jnp.float32)
+    else:
+        cos = sin = None
+
+    def layer(x, inputs):
+        lp, kp, vp = inputs
+        lp = _cast(cfg, lp)
+        h = _norm(cfg, lp["ln1"], x)
+        q, k, v = _qkv(cfg, lp["attn"], h)            # [B, C, H(kv), D]
+        if cfg.apply_rotary:
+            q = apply_rotary(q, cos, sin)
+            k = apply_rotary(k, cos, sin)
+        kp = _write_pages(kp, k, table, positions, valid)
+        vp = _write_pages(vp, v, table, positions, valid)
+        ctx = paged_ops.paged_extend_attention(
+            q, kp, vp, table, start, n_new,
+            softmax_scale=cfg.softmax_scale,
+            soft_cap=cfg.attn_logits_soft_cap,
+            sliding_window=cfg.sliding_window,
+        )
+        x = x + _attn_out(lp["attn"], ctx.astype(x.dtype))
+        h = _norm(cfg, lp["ln2"], x)
+        x = x + _mlp(cfg, lp["mlp"], h)[0]
+        return x, (kp, vp)
+
+    _, (ks, vs) = jax.lax.scan(
+        layer, x, (params["layers"], cache.k_pages, cache.v_pages)
+    )
+    return PagedKVCache(k_pages=ks, v_pages=vs)
+
+
+def decode_step_paged(
+    params: Params,
+    cfg: ModelConfig,
+    cache: PagedKVCache,
+    tokens: jnp.ndarray,       # [B] current tokens
+    table: jnp.ndarray,        # [B, M]
+    lens: jnp.ndarray,         # [B] resident tokens (write position)
+    active: jnp.ndarray,       # [B] bool
+) -> Tuple[jnp.ndarray, PagedKVCache, jnp.ndarray]:
+    """One decode step over the page pool. Returns (fp32 logits ``[B, V]``,
+    cache, new lens — incremented where active)."""
+    from areal_tpu.ops import paged_attention as paged_ops
+
+    positions = lens
+    x = _embed(cfg, params, tokens, positions)        # [B, E]
+    if cfg.apply_rotary:
+        cos, sin = rotary_cos_sin(_rotary_cfg(cfg), positions, jnp.float32)
+    else:
+        cos = sin = None
+    new_lens = jnp.where(active, lens + 1, lens)
+
+    def layer(x, inputs):
+        lp, kp, vp = inputs
+        lp = _cast(cfg, lp)
+        h = _norm(cfg, lp["ln1"], x)
+        q, k, v = _qkv(cfg, lp["attn"], h)            # q [B, H, D]
+        if cfg.apply_rotary:
+            q = apply_rotary(q, cos, sin)
+            k = apply_rotary(k, cos, sin)
+        kp = _write_pages(kp, k[:, None], table, positions[:, None], active[:, None])
+        vp = _write_pages(vp, v[:, None], table, positions[:, None], active[:, None])
+        ctx = paged_ops.paged_decode_attention(
+            q, kp, vp, table, new_lens,
+            softmax_scale=cfg.softmax_scale,
+            soft_cap=cfg.attn_logits_soft_cap,
+            sliding_window=cfg.sliding_window,
+        )
+        x = x + _attn_out(lp["attn"], ctx.astype(x.dtype))
+        h = _norm(cfg, lp["ln2"], x)
+        x = x + _mlp(cfg, lp["mlp"], h)[0]
+        return x, (kp, vp)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer, x, (params["layers"], cache.k_pages, cache.v_pages)
+    )
+    cache = PagedKVCache(k_pages=ks, v_pages=vs)
+    x = _norm(cfg, _cast(cfg, params["final_ln"]), x)
+    return _head(cfg, params, x), cache, new_lens

@@ -1,0 +1,115 @@
+"""Paged KV attention: decode + chunked-extend over a page pool.
+
+TPU-native counterpart of the paged attention the reference inherits from
+SGLang/vLLM CUDA kernels. KV lives in a pool ``[n_pages, page, Hkv, D]``
+(per layer); each slot owns a page TABLE ``[M]`` instead of a dense slab, so
+HBM scales with resident tokens and identical prompts share pages.
+
+Two implementations:
+- XLA gather path (here): gather the slot's pages into a contiguous view and
+  reuse the dense attention math — correct everywhere (CPU tests), with a
+  per-step gather the compiler fuses reasonably;
+- Pallas kernel (``ops/pallas/paged_attention.py``): reads pages in place
+  via scalar-prefetch table indices on TPU — no materialized gather.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.ops import attention as attn_ops
+
+_NEG_INF = -2.3819763e38
+
+
+def gather_pages(pages: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
+    """``[P, page, Hkv, D]`` + table ``[B, M]`` -> ``[B, M*page, Hkv, D]``
+    (a contiguous per-slot view; garbage beyond the slot's length, masked by
+    the caller's ``lens``)."""
+    B, M = table.shape
+    g = pages[table]                       # [B, M, page, Hkv, D]
+    return g.reshape(B, M * pages.shape[1], *pages.shape[2:])
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,          # [B, H, D] one new token per slot
+    k_pages: jnp.ndarray,    # [P, page, Hkv, D]
+    v_pages: jnp.ndarray,
+    table: jnp.ndarray,      # [B, M] i32
+    lens: jnp.ndarray,       # [B] valid tokens INCLUDING the current one
+    *,
+    softmax_scale: Optional[float] = None,
+    soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    use_pallas: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Single-token attention against paged KV. The new token's K/V must
+    already be written at position ``lens - 1``. Returns ``[B, H, D]``."""
+    if use_pallas is None:
+        # the kernel's in-VMEM reshapes need a full-lane head_dim; smaller
+        # heads (and sub-tile pages) take the XLA gather path
+        use_pallas = (
+            jax.devices()[0].platform == "tpu"
+            and q.shape[-1] % 128 == 0
+            and k_pages.shape[1] % 8 == 0
+        )
+    if use_pallas:
+        from areal_tpu.ops.pallas import paged_attention as pl_paged
+
+        return pl_paged.decode(
+            q, k_pages, v_pages, table, lens,
+            softmax_scale=softmax_scale, soft_cap=soft_cap,
+            sliding_window=sliding_window,
+        )
+    k = gather_pages(k_pages, table)
+    v = gather_pages(v_pages, table)
+    return attn_ops.decode_attention(
+        q, k, v, lens,
+        softmax_scale=softmax_scale, soft_cap=soft_cap,
+        sliding_window=sliding_window,
+    )
+
+
+def paged_extend_attention(
+    q: jnp.ndarray,          # [B, C, H, D] chunk of new tokens
+    k_pages: jnp.ndarray,    # [P, page, Hkv, D]
+    v_pages: jnp.ndarray,
+    table: jnp.ndarray,      # [B, M]
+    start: jnp.ndarray,      # [B] chunk start position (tokens already resident)
+    n_new: jnp.ndarray,      # [B] valid new tokens in the chunk (<= C)
+    *,
+    softmax_scale: Optional[float] = None,
+    soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Chunked-prefill attention: chunk token i (global position start+i)
+    attends to every resident position <= its own. The chunk's K/V must
+    already be written into the pages. Returns ``[B, C, H, D]``."""
+    B, C, H, D = q.shape
+    if softmax_scale is None:
+        softmax_scale = D ** -0.5
+    k = gather_pages(k_pages, table)      # [B, S, Hkv, D]
+    v = gather_pages(v_pages, table)
+    S = k.shape[1]
+    n_rep = H // k.shape[2]
+    if n_rep > 1:
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
+    scores = jnp.einsum(
+        "bchd,bshd->bhcs", q, k, preferred_element_type=jnp.float32
+    ) * softmax_scale
+    if soft_cap is not None:
+        scores = soft_cap * jnp.tanh(scores / soft_cap)
+    qpos = start[:, None] + jnp.arange(C)[None, :]          # [B, C]
+    kpos = jnp.arange(S)[None, :]                           # [1, S]
+    mask = kpos[:, None, :] <= qpos[:, :, None]             # [B, C, S] causal
+    if sliding_window is not None:
+        mask &= kpos[:, None, :] > qpos[:, :, None] - sliding_window
+    valid_q = jnp.arange(C)[None, :] < n_new[:, None]       # [B, C]
+    mask &= valid_q[:, :, None]
+    scores = jnp.where(mask[:, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    # fully-masked (invalid) rows produce uniform probs; zero them
+    probs = jnp.where(valid_q[:, None, :, None], probs, 0.0)
+    return jnp.einsum("bhcs,bshd->bchd", probs, v)

@@ -1,0 +1,172 @@
+"""Paged decode attention (Pallas TPU kernel).
+
+One new token per slot attends to its KV pages IN PLACE — the page table
+rides in as a scalar-prefetch operand and feeds the BlockSpec index map, so
+pages stream straight from the pool with no materialized per-slot gather
+(the XLA fallback in ``ops/paged_attention.py`` gathers ``[B, M*page]``
+every step). TPU counterpart of vLLM/SGLang's paged-attention CUDA kernels,
+which the reference inherits (SURVEY §2.1).
+
+Grid ``(B, M)``: slot-major, pages innermost. Online-softmax state (m, l,
+acc) lives in VMEM scratch across the page axis. Out-of-range pages
+(``j*page >= lens[b]``) clamp their index-map output to the previous page —
+Pallas skips the DMA when the block index repeats — and ``pl.when`` skips
+the compute, so a slot pays only for its resident pages. GQA runs without
+materializing the K/V head repeat: scores are batched ``dot_general`` over
+the kv-head axis.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -2.3819763e38
+LANES = 128
+
+
+def _interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+def _n_used(lens_b, page):
+    """Pages resident for a slot (at least 1 so index maps stay in range)."""
+    return jnp.maximum(pl.cdiv(lens_b, page), 1)
+
+
+def _decode_kernel(
+    table_ref,   # [B, M] int32 scalar-prefetch
+    lens_ref,    # [B] int32 scalar-prefetch
+    q_ref,       # [1, Hq, D]
+    k_ref,       # [1, page, Hkv*D]
+    v_ref,       # [1, page, Hkv*D]
+    o_ref,       # [1, Hq, D]
+    m_scr,       # [HqP, LANES] f32
+    l_scr,       # [HqP, LANES] f32
+    acc_scr,     # [HqP, D] f32
+    *,
+    scale: float,
+    page: int,
+    n_kv: int,
+    n_rep: int,
+    soft_cap: Optional[float],
+    sliding_window: Optional[int],
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    M = pl.num_programs(1)
+    Hq = q_ref.shape[1]
+    lens_b = lens_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when((j * page < lens_b) & (lens_b > 0))
+    def _body():
+        D = q_ref.shape[2]
+        q = q_ref[0].reshape(n_kv, n_rep, D)                  # [Hkv, r, D]
+        k = k_ref[0].reshape(page, n_kv, D).transpose(1, 0, 2)  # [Hkv, p, D]
+        v = v_ref[0].reshape(page, n_kv, D).transpose(1, 0, 2)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                                             # [Hkv, r, p]
+        if soft_cap is not None:
+            s = soft_cap * jnp.tanh(s / soft_cap)
+        s = s.reshape(Hq, page)
+        kpos = j * page + jax.lax.broadcasted_iota(jnp.int32, (Hq, page), 1)
+        mask = kpos < lens_b
+        if sliding_window is not None:
+            # the query sits at position lens_b - 1
+            mask &= kpos > lens_b - 1 - sliding_window
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:Hq, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)          # [Hq, p]
+        corr = jnp.exp(m_prev - m_new)
+        l_new = corr * l_scr[:Hq, 0:1] + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.reshape(n_kv, n_rep, page).astype(v.dtype), v,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(Hq, D)
+        acc_scr[:Hq, :D] = acc_scr[:Hq, :D] * corr + pv
+        m_scr[:Hq] = jnp.broadcast_to(m_new, (Hq, LANES))
+        l_scr[:Hq] = jnp.broadcast_to(l_new, (Hq, LANES))
+
+    @pl.when(j == M - 1)
+    def _done():
+        D = q_ref.shape[2]
+        l = l_scr[:Hq, 0:1]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0] = (acc_scr[:Hq, :D] / safe_l).astype(o_ref.dtype)
+
+
+def decode(
+    q: jnp.ndarray,          # [B, Hq, D]
+    k_pages: jnp.ndarray,    # [P, page, Hkv, D]
+    v_pages: jnp.ndarray,
+    table: jnp.ndarray,      # [B, M] i32
+    lens: jnp.ndarray,       # [B] valid tokens incl. the current one
+    *,
+    softmax_scale: Optional[float] = None,
+    soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    B, Hq, D = q.shape
+    P, page, Hkv, _ = k_pages.shape
+    M = table.shape[1]
+    n_rep = Hq // Hkv
+    if not _interpret() and (D % 128 != 0 or page % 8 != 0):
+        raise ValueError(
+            f"paged kernel needs head_dim%128==0 and page%8==0 on TPU; got "
+            f"D={D}, page={page} — use the XLA gather path"
+        )
+    if softmax_scale is None:
+        softmax_scale = D ** -0.5
+    hq_pad = max(8, Hq)
+    kv_flat = k_pages.reshape(P, page, Hkv * D)
+    vv_flat = v_pages.reshape(P, page, Hkv * D)
+
+    def page_map(b, j, table, lens):
+        # clamp to the last resident page: repeats skip the DMA
+        jj = jnp.minimum(j, _n_used(lens[b], page) - 1)
+        return (table[b, jj], 0, 0)
+
+    kernel = functools.partial(
+        _decode_kernel,
+        scale=softmax_scale,
+        page=page,
+        n_kv=Hkv,
+        n_rep=n_rep,
+        soft_cap=soft_cap,
+        sliding_window=sliding_window,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, M),
+            in_specs=[
+                pl.BlockSpec((1, Hq, D), lambda b, j, t, l: (b, 0, 0)),
+                pl.BlockSpec((1, page, Hkv * D), page_map),
+                pl.BlockSpec((1, page, Hkv * D), page_map),
+            ],
+            out_specs=pl.BlockSpec((1, Hq, D), lambda b, j, t, l: (b, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((hq_pad, LANES), jnp.float32),
+                pltpu.VMEM((hq_pad, LANES), jnp.float32),
+                # lanes padded to a full tile; the kernel uses [:, :D]
+                pltpu.VMEM((hq_pad, max(D, LANES)), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        interpret=_interpret(),
+    )(table, lens, q, kv_flat, vv_flat)

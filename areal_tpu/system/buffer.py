@@ -68,8 +68,10 @@ class SequenceBuffer:
                             self._arrival, sample))
         self._arrival += 1
         if len(self._items) > self.capacity:
-            self._items.sort(key=lambda t: (t[0], t[1]))
-            dropped = self._items.pop(0)
+            # O(n) single-victim scan — a full sort per arrival would be
+            # O(n log n) under sustained overflow
+            i = min(range(len(self._items)), key=lambda j: self._items[j][:2])
+            dropped = self._items.pop(i)
             self.n_dropped_capacity += 1
             logger.warning(
                 "buffer over capacity %d: dropped oldest sample %s",

@@ -62,7 +62,9 @@ class ExperimentStatusWatch:
         experiment_name: str,
         trial_name: str,
         timeout: float = DEFAULT_DEATH_TIMEOUT,
-        poll_interval: float = 10.0,
+        # a status read is one small file; poll often enough that workers see
+        # STOPPED inside the launcher's graceful-join window (5 s)
+        poll_interval: float = 2.0,
     ):
         self.key = names.experiment_status(experiment_name, trial_name)
         self.timeout = timeout

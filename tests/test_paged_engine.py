@@ -1,0 +1,275 @@
+"""Paged-KV generation engine: prefix sharing, pool accounting, bounded
+compiles, and thread-safety under pause/submit racing step.
+
+Counterpart of the capacity behaviors the reference inherits from SGLang
+(radix cache sharing one prefill across a GRPO group, paged KV memory,
+``patch/sglang/v0.4.6.post4.patch``).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from areal_tpu.gen.engine import GenerationEngine, GenRequest
+from areal_tpu.gen.pages import OutOfPagesError, PagePool, PrefixRegistry
+from areal_tpu.models import transformer as tfm
+from areal_tpu.models.config import ModelConfig
+
+CFG = ModelConfig(
+    n_layers=2, n_q_heads=4, n_kv_heads=2, head_dim=8, hidden_dim=32,
+    intermediate_dim=64, vocab_size=128, dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.key(5))
+
+
+class TestPagePool:
+    def test_alloc_release_refcount(self):
+        pool = PagePool(4, page_size=8)
+        a = pool.alloc(2)
+        assert pool.n_free == 2
+        pool.ref(a)                 # shared
+        pool.release(a)             # one ref left
+        assert pool.n_free == 2
+        pool.release(a)
+        assert pool.n_free == 4
+        with pytest.raises(OutOfPagesError):
+            pool.alloc(5)
+        with pytest.raises(ValueError):
+            pool.release(a)         # double free
+
+    def test_prefix_registry_share_evict(self):
+        pool = PagePool(8, page_size=4)
+        reg = PrefixRegistry(pool)
+        prompt = list(range(10))
+        pages = pool.alloc(2)       # 2 full pages = first 8 tokens
+        reg.insert(prompt, pages)
+        assert pool.n_free == 6
+        got = reg.lookup(prompt, 2)
+        assert got == pages
+        # different prompt or length: miss
+        assert reg.lookup([9] + prompt[1:], 2) is None
+        assert reg.lookup(prompt, 1) is None
+        pool.release(got)           # borrower done
+        pool.release(pages)         # original owner done; registry ref remains
+        assert pool.n_free == 6
+        reg.evict_lru(8)            # need pages -> registry lets go
+        assert pool.n_free == 8
+
+
+class TestPrefixSharing:
+    def test_one_prefill_serves_group_of_8(self, params):
+        """8 identical prompts (a GRPO group): the prompt's full pages are
+        computed ONCE; members 2-8 extend only the sub-page tail."""
+        page = 8
+        prompt = [int(x) for x in np.random.default_rng(0).integers(1, 128, 21)]
+        # plen_eff = 20 = 2 full pages (16 tokens) + tail 4
+        eng = GenerationEngine(
+            CFG, params, max_slots=8, max_seqlen=64, page_size=page, seed=0,
+        )
+        for i in range(8):
+            eng.submit(GenRequest(
+                rid=f"g{i}", input_ids=prompt, max_new_tokens=4, greedy=True,
+            ))
+        outs = eng.run_until_done(decode_steps=4)
+        assert len(outs) == 8
+        # all members produced identical greedy outputs from the shared KV
+        assert len({tuple(o.output_ids) for o in outs}) == 1
+        # one slot computed the full 20; seven extended only the 4-token tail
+        assert eng.stats["prefix_hits"] == 7
+        assert eng.stats["prefix_hit_tokens"] == 7 * 16
+        assert eng.stats["prefill_tokens"] == 20 + 7 * 4
+        # registry entry survives for the NEXT group on the same prompt
+        eng.submit(GenRequest(rid="late", input_ids=prompt, max_new_tokens=4,
+                              greedy=True))
+        late = eng.run_until_done(decode_steps=4)
+        assert eng.stats["prefix_hits"] == 8
+        assert late[0].output_ids == outs[0].output_ids
+
+    def test_shared_pages_memory_accounting(self, params):
+        """Group members don't pay for the shared prompt pages."""
+        page = 8
+        prompt = list(range(1, 18))   # plen_eff 16 = 2 full pages, no tail
+        eng = GenerationEngine(
+            CFG, params, max_slots=4, max_seqlen=64, page_size=page,
+        )
+        for i in range(4):
+            eng.submit(GenRequest(
+                rid=f"g{i}", input_ids=prompt, max_new_tokens=8, greedy=True,
+            ))
+        eng.step(decode_steps=1)
+        # per slot: ceil((16+8)/8)=3 pages total; the 2 prompt pages are
+        # shared, so members own only 1 — pool usage = 3 + 3*1 = 6 pages
+        used = eng.n_pages - eng.pool.n_free
+        assert used == 6
+        eng.run_until_done(decode_steps=4)
+        # slots released; only the registry's hold on the 2 prompt pages stays
+        assert eng.n_pages - eng.pool.n_free == 2
+
+    def test_weight_update_invalidates_prefix(self, params):
+        prompt = list(range(1, 18))
+        eng = GenerationEngine(
+            CFG, params, max_slots=2, max_seqlen=64, page_size=8,
+        )
+        eng.submit(GenRequest(rid="a", input_ids=prompt, max_new_tokens=2,
+                              greedy=True))
+        eng.run_until_done(decode_steps=2)
+        assert len(eng.prefix) == 1
+        eng.update_params(params, version=1)
+        assert len(eng.prefix) == 0   # old-weight KV never seeds new rollouts
+        eng.submit(GenRequest(rid="b", input_ids=prompt, max_new_tokens=2,
+                              greedy=True))
+        eng.run_until_done(decode_steps=2)
+        assert eng.stats["prefix_hits"] == 0
+
+
+class TestCapacity:
+    def test_small_pool_defers_admission(self, params):
+        """A pool smaller than slots x capacity admits what fits and keeps
+        the rest pending instead of crashing — HBM is bounded by n_pages."""
+        eng = GenerationEngine(
+            CFG, params, max_slots=4, max_seqlen=64, page_size=8,
+            n_pages=6, enable_prefix_cache=False,
+        )
+        # each request needs ceil((7+16)/8) = 3 pages -> only 2 fit
+        for i in range(4):
+            eng.submit(GenRequest(
+                rid=f"r{i}", input_ids=list(range(1, 9)), max_new_tokens=16,
+                greedy=True,
+            ))
+        eng.step(decode_steps=1)
+        assert eng.n_running() == 2 and len(eng._pending) == 2
+        outs = eng.run_until_done(decode_steps=8)   # turnover drains the rest
+        assert len(outs) == 4
+        assert eng.pool.n_free == 6
+
+    def test_compile_count_stable_across_mixed_workload(self, params, rng):
+        """Compile count is bounded by admit-row buckets + decode chunk —
+        NOT by prompt lengths (chunked prefill kills the length dimension)."""
+        eng = GenerationEngine(
+            CFG, params, max_slots=4, max_seqlen=256, page_size=16,
+        )
+        for i, plen in enumerate([3, 9, 17, 33, 65, 100, 130, 7, 55, 23]):
+            eng.submit(GenRequest(
+                rid=f"m{i}",
+                input_ids=[int(x) for x in rng.integers(1, 128, plen)],
+                max_new_tokens=4, greedy=True,
+            ))
+        eng.run_until_done(decode_steps=4)
+        # warm every admit-row bucket with varying arrival counts
+        for n_batch in (1, 2, 3, 4):
+            for i in range(n_batch):
+                eng.submit(GenRequest(
+                    rid=f"w{n_batch}-{i}",
+                    input_ids=[int(x) for x in rng.integers(1, 128, 40)],
+                    max_new_tokens=4, greedy=True,
+                ))
+            eng.run_until_done(decode_steps=4)
+        warmed = eng.n_compiles()
+        # hard bound: one extend + one commit per bucket + one decode chunk
+        assert warmed <= 2 * len(eng.admit_buckets) + 1
+        # fresh prompt lengths never trigger new specializations
+        for i, plen in enumerate([11, 29, 77, 128, 201]):
+            eng.submit(GenRequest(
+                rid=f"n{i}",
+                input_ids=[int(x) for x in rng.integers(1, 128, plen)],
+                max_new_tokens=4, greedy=True,
+            ))
+        eng.run_until_done(decode_steps=4)
+        assert eng.n_compiles() == warmed
+
+
+class TestThreadSafety:
+    @pytest.mark.slow
+    def test_pause_and_submit_racing_step(self, params, rng):
+        """A server thread pausing/submitting while the step thread runs:
+        no slot leaks, no double frees, every request resolves exactly once."""
+        eng = GenerationEngine(
+            CFG, params, max_slots=4, max_seqlen=64, page_size=8, seed=0,
+        )
+        results = {}
+        errors = []
+        stop = threading.Event()
+
+        def stepper():
+            try:
+                while not stop.is_set():
+                    for o in eng.step(decode_steps=2):
+                        results[o.rid] = results.get(o.rid, 0) + 1
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def chaos():
+            try:
+                for i in range(30):
+                    eng.submit(GenRequest(
+                        rid=f"c{i}",
+                        input_ids=[int(x) for x in rng.integers(1, 128, 5)],
+                        max_new_tokens=6, greedy=True,
+                    ))
+                    if i % 5 == 4:
+                        for o in eng.pause():
+                            results[o.rid] = results.get(o.rid, 0) + 1
+                        eng.resume()
+                    time.sleep(0.01)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        t1 = threading.Thread(target=stepper)
+        t2 = threading.Thread(target=chaos)
+        t1.start(); t2.start()
+        t2.join(timeout=120)
+        # drain the rest
+        deadline = time.time() + 120
+        while (eng._pending or eng.n_running()) and time.time() < deadline:
+            time.sleep(0.05)
+        stop.set()
+        t1.join(timeout=30)
+        assert not errors, errors
+        assert sum(results.values()) == 30           # each exactly once
+        assert all(v == 1 for v in results.values())
+        assert eng.n_running() == 0
+        # every page accounted for (registry may hold prompt pages)
+        eng.prefix.clear()
+        assert eng.pool.n_free == eng.n_pages
+
+
+class TestPallasPagedDecode:
+    """Pallas paged-decode kernel parity vs the XLA gather path (interpret
+    mode on CPU; the same kernel runs compiled on TPU)."""
+
+    @pytest.mark.parametrize(
+        "soft_cap,window", [(None, None), (5.0, None), (None, 6)]
+    )
+    def test_parity_vs_xla(self, soft_cap, window):
+        from areal_tpu.ops import paged_attention as xla_paged
+        from areal_tpu.ops.pallas import paged_attention as pl_paged
+
+        rng = np.random.default_rng(0)
+        B, Hq, Hkv, D, page, M, P = 4, 4, 2, 16, 8, 4, 20
+        q = rng.normal(size=(B, Hq, D)).astype(np.float32)
+        k_pages = rng.normal(size=(P, page, Hkv, D)).astype(np.float32)
+        v_pages = rng.normal(size=(P, page, Hkv, D)).astype(np.float32)
+        table = rng.permutation(P)[: B * M].reshape(B, M).astype(np.int32)
+        lens = np.asarray([1, 9, 32, 0], np.int32)  # partial/full/empty
+
+        got = pl_paged.decode(
+            q, k_pages, v_pages, table, lens,
+            soft_cap=soft_cap, sliding_window=window,
+        )
+        want = xla_paged.paged_decode_attention(
+            q, k_pages, v_pages, table, lens,
+            soft_cap=soft_cap, sliding_window=window, use_pallas=False,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5
+        )
+        # empty slot (lens 0) outputs exact zeros on both paths
+        assert np.all(np.asarray(got)[3] == 0)
