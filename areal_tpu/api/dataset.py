@@ -51,3 +51,13 @@ def make_dataset(name: str, util: DatasetUtility, **kwargs):
     import areal_tpu.datasets  # noqa: F401  (triggers registration)
 
     return ALL_DATASETS[name](util=util, **kwargs)
+
+
+def dataset_metadata(dataset) -> dict:
+    """qid -> task metadata for reward grading. Prompt datasets expose
+    ``load_metadata()`` (jsonl-backed); test doubles may carry a plain
+    ``metadata`` attribute — support both so graders never silently see {}
+    (an empty dict scores every answer wrong)."""
+    if hasattr(dataset, "load_metadata"):
+        return dataset.load_metadata()
+    return getattr(dataset, "metadata", {})

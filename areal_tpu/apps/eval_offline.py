@@ -1,0 +1,141 @@
+"""Offline evaluation harness: generate → grade → aggregate, standalone.
+
+Counterpart of the reference's ``evaluation/eval_and_aggregate.py`` (math
+answer grading + pass@k aggregation over sampled generations; the CF-ELO
+half is dataset-specific and out of scope). Runs against any HF checkpoint
+this framework exports:
+
+    python -m areal_tpu.apps.eval_offline \
+        --model-path /ckpts/step100 --dataset math_test.jsonl \
+        --output-dir /tmp/eval --n-sampling 8 --max-gen-tokens 1024
+
+Writes per-sample generations to ``samples.jsonl`` and the aggregate
+(pass@1, pass@k, mean reward) to ``aggregate.json``.
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+logger = logging.getLogger("areal_tpu.eval_offline")
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-path", required=True, help="HF checkpoint dir")
+    ap.add_argument("--dataset", required=True, help="prompt jsonl (math_code_prompt format)")
+    ap.add_argument("--output-dir", required=True)
+    ap.add_argument("--tokenizer", default=None, help="tokenizer path (defaults to model)")
+    ap.add_argument("--parallel", default="d1m1")
+    ap.add_argument("--n-sampling", type=int, default=8)
+    ap.add_argument("--max-gen-tokens", type=int, default=1024)
+    ap.add_argument("--temperature", type=float, default=0.6)
+    ap.add_argument("--top-p", type=float, default=0.95)
+    ap.add_argument("--greedy", action="store_true")
+    ap.add_argument("--max-prompts", type=int, default=None)
+    ap.add_argument("--batch-prompts", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--overwrite", action="store_true")
+    args = ap.parse_args(argv)
+
+    out_samples = os.path.join(args.output_dir, "samples.jsonl")
+    out_agg = os.path.join(args.output_dir, "aggregate.json")
+    if os.path.exists(out_agg) and not args.overwrite:
+        logger.info("aggregate exists (%s); pass --overwrite to redo", out_agg)
+        return 0
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    import numpy as np
+
+    from areal_tpu.api.dataset import DatasetUtility, make_dataset
+    from areal_tpu.api.model import GenerationHyperparameters
+    from areal_tpu.parallel.mesh import ParallelConfig
+    from areal_tpu.system.sync_trainer import math_reward_fn
+    from areal_tpu.train.engine import TrainEngine
+    from areal_tpu.train.generation import SyncGenerator
+
+    tokenizer = None
+    tok_path = args.tokenizer or args.model_path
+    try:
+        import transformers
+
+        tokenizer = transformers.AutoTokenizer.from_pretrained(tok_path)
+    except Exception:
+        logger.warning("no tokenizer at %s; decoding as token-id strings", tok_path)
+    util = DatasetUtility(seed=args.seed, dp_rank=0, world_size=1, tokenizer=tokenizer)
+    dataset = make_dataset("math_code_prompt", util, path=args.dataset)
+    from areal_tpu.api.dataset import dataset_metadata
+
+    metadata = dataset_metadata(dataset)
+    n = len(dataset) if args.max_prompts is None else min(args.max_prompts, len(dataset))
+
+    from areal_tpu.experiments.config import ModelSpec
+
+    spec = ModelSpec(path=args.model_path, parallel=args.parallel)
+    eng = TrainEngine(spec.model_config(), spec.parallel_config())
+    eng.load_hf(args.model_path)
+    gen = SyncGenerator(eng)
+    ghp = GenerationHyperparameters(
+        n=args.n_sampling,
+        max_new_tokens=args.max_gen_tokens,
+        greedy=args.greedy,
+        temperature=args.temperature,
+        top_p=args.top_p,
+        stop_token_ids=(
+            [tokenizer.eos_token_id]
+            if tokenizer is not None and tokenizer.eos_token_id is not None
+            else []
+        ),
+    )
+    decode = (
+        (lambda ids: tokenizer.decode(ids, skip_special_tokens=True))
+        if tokenizer is not None
+        else (lambda ids: " ".join(map(str, ids)))
+    )
+
+    pass1, passk, rewards_all = [], [], []
+    t0 = time.time()
+    with open(out_samples, "w") as f:
+        for lo in range(0, n, args.batch_prompts):
+            samples = [dataset[i] for i in range(lo, min(lo + args.batch_prompts, n))]
+            qids = [str(s.ids[0]) for s in samples]
+            prompts = [np.asarray(s.data["packed_prompts"]).tolist() for s in samples]
+            groups = gen.generate(prompts, ghp, seed=args.seed + lo)
+            for qid, prompt, group in zip(qids, prompts, groups):
+                answers = [decode(o.tokens[len(prompt):].tolist()) for o in group]
+                rws = math_reward_fn(qid, answers, metadata.get(qid, {}))
+                oks = [r > 0 for r in rws]
+                pass1.append(float(np.mean(oks)))
+                passk.append(float(any(oks)))
+                rewards_all.extend(rws)
+                f.write(json.dumps({
+                    "qid": qid,
+                    "answers": answers,
+                    "rewards": rws,
+                    "gen_lens": [len(o.gen_logprobs) for o in group],
+                    "no_eos": [bool(o.no_eos) for o in group],
+                }) + "\n")
+            logger.info("evaluated %d/%d prompts", min(lo + args.batch_prompts, n), n)
+
+    agg = {
+        "model": args.model_path,
+        "dataset": args.dataset,
+        "n_prompts": n,
+        "n_sampling": args.n_sampling,
+        "pass@1": float(np.mean(pass1)) if pass1 else 0.0,
+        f"pass@{args.n_sampling}": float(np.mean(passk)) if passk else 0.0,
+        "reward_mean": float(np.mean(rewards_all)) if rewards_all else 0.0,
+        "wall_s": time.time() - t0,
+    }
+    with open(out_agg, "w") as f:
+        json.dump(agg, f, indent=2)
+    logger.info("aggregate: %s", agg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

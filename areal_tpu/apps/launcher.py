@@ -245,7 +245,10 @@ def _load_ppo_engines(cfg, total_steps):
     critic = None
     if cfg.critic is not None and not cfg.ppo.disable_value:
         critic = _load_engine(cfg.critic, is_critic=True, total_steps=total_steps)
-    return actor, ref, critic
+    reward = None
+    if getattr(cfg, "reward", None) is not None:
+        reward = _load_engine(cfg.reward, is_critic=True, with_optimizer=False)
+    return actor, ref, critic, reward
 
 
 def trainer_main(cfg):
@@ -270,7 +273,7 @@ def trainer_main(cfg):
     stream = PullerStreamDataset(
         cfg.experiment_name, cfg.trial_name, 0, offline_dataset_size=10_000
     )
-    actor, ref, critic = _load_ppo_engines(cfg, total)
+    actor, ref, critic, reward = _load_ppo_engines(cfg, total)
     worker = AsyncPPOTrainerWorker(
         experiment_name=cfg.experiment_name,
         trial_name=cfg.trial_name,
@@ -288,6 +291,7 @@ def trainer_main(cfg):
         mb_spec=cfg.mb_spec,
         ref_engine=ref,
         critic_engine=critic,
+        reward_engine=reward,
         hf_family=cfg.hf_family,
         metric_logger=MetricLogger(constants.get_log_root()),
         ema_ref_eta=cfg.ema_ref_eta,
@@ -549,7 +553,7 @@ def run_sync_ppo(cfg) -> int:
         max_length=cfg.dataset.max_length,
     )
     total = cfg.control.total_train_steps
-    actor, ref, critic = _load_ppo_engines(cfg, total)
+    actor, ref, critic, _ = _load_ppo_engines(cfg, total)
     decode_fn = None
     if tokenizer is not None:
         decode_fn = lambda ids: tokenizer.decode(ids, skip_special_tokens=True)
@@ -590,8 +594,161 @@ def run_sync_ppo(cfg) -> int:
     return 0
 
 
-def run_sft(cfg) -> int:
-    """SFT runs in-process: one trainer program, no fleet."""
+def _run_supervised(cfg, *, is_critic: bool, interface_name: str,
+                    dataset_kwargs=None, interface_kwargs=None) -> int:
+    """Shared body of the in-process supervised recipes (SFT / paired-RW):
+    one trainer program, no fleet — only the objective differs."""
+    _setup_worker_env(cfg, "")
+    from areal_tpu.api.data import MicroBatchSpec
+    from areal_tpu.api.dataset import DatasetUtility, make_dataset
+    from areal_tpu.base import constants
+    from areal_tpu.base.metrics import MetricLogger
+    from areal_tpu.system.trainer_worker import SFTTrainerWorker, TrainerControl
+
+    dataset_kwargs = dataset_kwargs or {}
+    tokenizer = None
+    if cfg.tokenizer_path:
+        import transformers
+
+        tokenizer = transformers.AutoTokenizer.from_pretrained(cfg.tokenizer_path)
+    util = DatasetUtility(
+        seed=cfg.dataset.seed, dp_rank=0, world_size=1, tokenizer=tokenizer
+    )
+    dataset = make_dataset(
+        cfg.dataset.name, util, path=cfg.dataset.path,
+        max_length=cfg.dataset.max_length, **dataset_kwargs,
+    )
+    eval_ds = None
+    if cfg.eval_dataset is not None:
+        eval_ds = make_dataset(
+            cfg.eval_dataset.name, util, path=cfg.eval_dataset.path,
+            max_length=cfg.eval_dataset.max_length, **dataset_kwargs,
+        )
+    engine = _load_engine(
+        cfg.model, is_critic=is_critic, total_steps=cfg.control.total_train_steps
+    )
+    worker = SFTTrainerWorker(
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        engine=engine,
+        dataset=dataset,
+        eval_dataset=eval_ds,
+        control=TrainerControl(
+            total_train_steps=cfg.control.total_train_steps,
+            save_freq_steps=cfg.control.save_freq_steps,
+        ),
+        batch_size=cfg.batch_size,
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=cfg.max_tokens_per_mb),
+        hf_family=cfg.hf_family,
+        metric_logger=MetricLogger(constants.get_log_root()),
+        interface_name=interface_name,
+        interface_kwargs=interface_kwargs,
+    )
+    worker.run()
+    return 0
+
+
+def run_rw(cfg) -> int:
+    """Paired reward-model training (≈ the reference's rw experiment):
+    critic-architecture model + Bradley-Terry pairwise loss over
+    ``rw_paired`` data; exports HF checkpoints usable as the "reward"
+    engine in RM-scored PPO."""
+    return _run_supervised(
+        cfg,
+        is_critic=True,
+        interface_name="reward",
+        dataset_kwargs={"max_pairs_per_prompt": cfg.max_pairs_per_prompt},
+        interface_kwargs={"max_pairs_per_prompt": cfg.max_pairs_per_prompt},
+    )
+
+
+def run_sync_ppo(cfg) -> int:
+    """Sync PPO runs in-process: generation happens on the trainer's own
+    mesh/params (no fleet, no weight publish); the evaluator (if enabled)
+    runs as a side process on host 0."""
+    _setup_worker_env(cfg, cfg.trainer_device)
+    from areal_tpu.parallel import multihost
+
+    multihost.maybe_initialize_from_env()
+    from areal_tpu.api.dataset import DatasetUtility, make_dataset
+    from areal_tpu.base import constants
+    from areal_tpu.base.metrics import MetricLogger
+    from areal_tpu.system.sync_trainer import SyncPPOTrainerWorker
+    from areal_tpu.system.trainer_worker import TrainerControl
+
+    from areal_tpu.system import worker_base
+
+    if multihost.is_main():
+        worker_base.mark_experiment_running(cfg.experiment_name, cfg.trial_name)
+    ev_proc = ev_stop = None
+    if cfg.evaluator.enabled and multihost.is_main():
+        ctx = mp.get_context("spawn")
+        ev_stop = ctx.Event()
+        with _cpu_child_env(cfg.evaluator.device == "cpu"):
+            ev_proc = ctx.Process(
+                target=evaluator_main, args=(cfg, ev_stop), daemon=True
+            )
+            ev_proc.start()
+
+    tokenizer = None
+    if cfg.tokenizer_path:
+        import transformers
+
+        tokenizer = transformers.AutoTokenizer.from_pretrained(cfg.tokenizer_path)
+    util = DatasetUtility(
+        seed=cfg.dataset.seed, dp_rank=0, world_size=1, tokenizer=tokenizer
+    )
+    dataset = make_dataset(
+        cfg.dataset.name, util, path=cfg.dataset.path,
+        max_length=cfg.dataset.max_length,
+    )
+    total = cfg.control.total_train_steps
+    actor, ref, critic, _ = _load_ppo_engines(cfg, total)
+    decode_fn = None
+    if tokenizer is not None:
+        decode_fn = lambda ids: tokenizer.decode(ids, skip_special_tokens=True)
+    worker = SyncPPOTrainerWorker(
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        actor_engine=actor,
+        dataset=dataset,
+        hp=cfg.ppo,
+        ghp=cfg.gconfig,
+        control=TrainerControl(
+            total_train_steps=total,
+            save_freq_steps=cfg.control.save_freq_steps,
+        ),
+        batch_size=cfg.batch_size,
+        mb_spec=cfg.mb_spec,
+        ref_engine=ref,
+        critic_engine=critic,
+        ema_ref_eta=cfg.ema_ref_eta,
+        decode_fn=decode_fn,
+        hf_family=cfg.hf_family,
+        metric_logger=MetricLogger(constants.get_log_root()),
+        seed=cfg.seed,
+    )
+    try:
+        worker.run()
+    finally:
+        if multihost.is_main():
+            worker_base.mark_experiment_stopped(cfg.experiment_name, cfg.trial_name)
+        if ev_proc is not None:
+            # graceful stop: the evaluator runs one final sweep so the last
+            # checkpoint export is always scored
+            ev_stop.set()
+            ev_proc.join(timeout=300)
+            if ev_proc.is_alive():
+                ev_proc.terminate()
+                ev_proc.join(timeout=10)
+    return 0
+
+
+def run_rw(cfg) -> int:
+    """Paired reward-model training in-process (≈ the reference's rw
+    experiment): critic-architecture model + Bradley-Terry pairwise loss
+    over ``rw_paired`` data; exports HF checkpoints usable as the "reward"
+    engine in RM-scored PPO."""
     _setup_worker_env(cfg, "")
     from areal_tpu.api.data import MicroBatchSpec
     from areal_tpu.api.dataset import DatasetUtility, make_dataset
@@ -610,15 +767,17 @@ def run_sft(cfg) -> int:
     dataset = make_dataset(
         cfg.dataset.name, util, path=cfg.dataset.path,
         max_length=cfg.dataset.max_length,
+        max_pairs_per_prompt=cfg.max_pairs_per_prompt,
     )
     eval_ds = None
     if cfg.eval_dataset is not None:
         eval_ds = make_dataset(
             cfg.eval_dataset.name, util, path=cfg.eval_dataset.path,
             max_length=cfg.eval_dataset.max_length,
+            max_pairs_per_prompt=cfg.max_pairs_per_prompt,
         )
     engine = _load_engine(
-        cfg.model, total_steps=cfg.control.total_train_steps
+        cfg.model, is_critic=True, total_steps=cfg.control.total_train_steps
     )
     worker = SFTTrainerWorker(
         experiment_name=cfg.experiment_name,
@@ -634,6 +793,12 @@ def run_sft(cfg) -> int:
         mb_spec=MicroBatchSpec(max_tokens_per_mb=cfg.max_tokens_per_mb),
         hf_family=cfg.hf_family,
         metric_logger=MetricLogger(constants.get_log_root()),
+        interface_name="reward",
     )
     worker.run()
     return 0
+
+
+def run_sft(cfg) -> int:
+    """SFT runs in-process: one trainer program, no fleet."""
+    return _run_supervised(cfg, is_critic=False, interface_name="sft")

@@ -18,7 +18,7 @@ def main(argv=None):
     )
     parser = argparse.ArgumentParser(prog="areal_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
-    for cmd in ("sft", "async-ppo", "sync-ppo"):
+    for cmd in ("sft", "async-ppo", "sync-ppo", "rw"):
         p = sub.add_parser(cmd)
         p.add_argument("--config", default=None, help="YAML config path")
         p.add_argument(
@@ -29,6 +29,7 @@ def main(argv=None):
     from areal_tpu.apps import launcher
     from areal_tpu.experiments import (
         AsyncPPOExperiment,
+        RWExperiment,
         SFTExperiment,
         SyncPPOExperiment,
         load_config,
@@ -37,6 +38,9 @@ def main(argv=None):
     if args.cmd == "sft":
         cfg = load_config(SFTExperiment, args.config, args.overrides)
         return launcher.run_sft(cfg)
+    if args.cmd == "rw":
+        cfg = load_config(RWExperiment, args.config, args.overrides)
+        return launcher.run_rw(cfg)
     if args.cmd == "sync-ppo":
         cfg = load_config(SyncPPOExperiment, args.config, args.overrides)
         return launcher.run_sync_ppo(cfg)

@@ -3,7 +3,9 @@
 from areal_tpu.api.dataset import register_dataset
 from areal_tpu.datasets.prompt import MathCodePromptDataset, PromptOnlyDataset
 from areal_tpu.datasets.prompt_answer import PromptAnswerDataset
+from areal_tpu.datasets.rw_paired import RewardPairedDataset
 
 register_dataset("math_code_prompt", MathCodePromptDataset)
 register_dataset("prompt", PromptOnlyDataset)
 register_dataset("prompt_answer", PromptAnswerDataset)
+register_dataset("rw_paired", RewardPairedDataset)

@@ -12,6 +12,7 @@ from areal_tpu.experiments.config import (  # noqa: F401
     GenFleetSpec,
     ModelSpec,
     RolloutSpec,
+    RWExperiment,
     SFTExperiment,
     SyncPPOExperiment,
     load_config,

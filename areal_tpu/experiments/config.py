@@ -121,6 +121,7 @@ class AsyncPPOExperiment:
     seed: int = 1
     actor: ModelSpec = dataclasses.field(default_factory=ModelSpec)
     critic: Optional[ModelSpec] = None
+    reward: Optional[ModelSpec] = None   # trained RM scores rollouts when set
     use_ref_model: bool = True
     hf_family: str = "qwen2"
     dataset: DatasetSpec = dataclasses.field(default_factory=DatasetSpec)
@@ -184,6 +185,31 @@ class SyncPPOExperiment:
     @property
     def mb_spec(self) -> MicroBatchSpec:
         return MicroBatchSpec(max_tokens_per_mb=self.max_tokens_per_mb)
+
+
+@dataclasses.dataclass
+class RWExperiment:
+    """Paired reward-model training (≈ the reference's rw experiment over
+    ``rw_paired_dataset``): a critic-architecture model + Bradley-Terry
+    loss, exported as the "reward" engine for RM-scored PPO."""
+
+    experiment_name: str = "rw"
+    trial_name: str = "trial0"
+    fileroot: str = ""
+    seed: int = 1
+    model: ModelSpec = dataclasses.field(default_factory=ModelSpec)
+    hf_family: str = "qwen2"
+    dataset: DatasetSpec = dataclasses.field(
+        default_factory=lambda: DatasetSpec(name="rw_paired")
+    )
+    eval_dataset: Optional[DatasetSpec] = None
+    control: TrainerControlSpec = dataclasses.field(
+        default_factory=TrainerControlSpec
+    )
+    batch_size: int = 32
+    max_tokens_per_mb: int = 16384
+    max_pairs_per_prompt: int = 2
+    tokenizer_path: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -257,8 +283,8 @@ def _register_nested(cls):
 
 
 for _cls in (
-    AsyncPPOExperiment, SyncPPOExperiment, SFTExperiment, ModelSpec,
-    RolloutSpec, GenFleetSpec, PPOHyperparameters, EvaluatorSpec,
+    AsyncPPOExperiment, SyncPPOExperiment, SFTExperiment, RWExperiment,
+    ModelSpec, RolloutSpec, GenFleetSpec, PPOHyperparameters, EvaluatorSpec,
 ):
     _register_nested(_cls)
 

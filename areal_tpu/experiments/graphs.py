@@ -32,15 +32,23 @@ def build_ppo_graph(
     hf_family: Optional[str] = None,
     batch_keys: Sequence[str] = ROLLOUT_BATCH_KEYS,
     ref_logprobs_in_batch: bool = False,
+    use_reward_model: bool = False,
 ) -> Tuple[DataFlowGraph, Dict[str, ModelInterface]]:
     """The async/sync PPO training graph.
 
     Nodes (conditional on config):
+      reward_inf  trained-RM sequence scores        (use_reward_model)
       ref_inf     frozen reference logprobs         (use_ref)
       critic_inf  value estimates                   (use_critic)
       actor_inf   proximal logprob recompute        (decoupled loss)
       actor_train PPO policy update [+ EMA-ref hook when ema_ref_eta]
       critic_train value update
+
+    With ``use_reward_model`` the graph's ``reward_inf`` node (engine name
+    "reward", a critic-architecture model trained by the paired-RW recipe)
+    PRODUCES the ``rewards`` key, overriding the rollout's rule-based
+    rewards — the reference's trained-RM scoring path
+    (``realhf/impl/model/interface/math_rw_interface.py``'s RM half).
 
     Returns the validated graph plus the shared interface instances (one
     actor interface drives ref_inf/actor_inf/actor_train so the KL
@@ -66,6 +74,21 @@ def build_ppo_graph(
 
     have_ref_lp = use_ref_inf or "packed_ref_logprobs" in batch_keys
     ref_lp_key = ("packed_ref_logprobs",) if have_ref_lp else ()
+
+    if use_reward_model:
+        mfcs.append(
+            MFCDef(
+                name="reward_inf",
+                model_name="reward",
+                interface_type="inference",
+                interface_impl="reward",
+                input_keys=("packed_input_ids",),
+                output_keys=("rewards",),
+                mb_spec=mb_spec,
+            )
+        )
+        # rollout rule-based rewards (if any) are superseded by the RM's
+        batch_keys = tuple(k for k in batch_keys if k != "rewards")
 
     if use_ref_inf:
         mfcs.append(

@@ -7,6 +7,7 @@ reference's ``realhf/impl/model/__init__.py:114`` registration pattern.
 from areal_tpu.api.model import register_interface
 from areal_tpu.interfaces.sft import SFTInterface
 from areal_tpu.interfaces.ppo import PPOActorInterface, PPOCriticInterface
+from areal_tpu.interfaces.reward import PairedRewardInterface
 
 register_interface("sft", SFTInterface)
 register_interface("ppo_actor", PPOActorInterface)

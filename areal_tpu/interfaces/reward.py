@@ -1,0 +1,133 @@
+"""Reward-model interface: Bradley-Terry pairwise training + sequence scoring.
+
+Counterpart of the reference's paired reward modeling
+(``realhf/impl/dataset/rw_paired_dataset.py`` consumer) and the RM-scoring
+side of its reward interfaces (``math_rw_interface.py`` — there rule-based;
+here the TRAINED-RM path VERDICT row §2.5 asks for). The model is a
+critic-architecture transformer (``is_critic=True``: scalar head); a
+sequence's score is the head output at its LAST token.
+
+Training: ``-log sigmoid(s_pos - s_neg)`` over one-to-one pairs. Pairs are
+matched inside jit with a scatter: every sequence carries ``pair_id`` (pair
+index within its item) and ``pair_sign`` (+1 pos / -1 neg); signed scores
+scatter-add into per-(item, pair) buckets, so a bucket holds exactly
+``s_pos - s_neg`` for a complete pair — no host-side pair bookkeeping in
+the hot path.
+"""
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model import ModelInterface, register_interface
+from areal_tpu.ops import ppo as ppo_ops
+from areal_tpu.train.engine import vmapped_forward
+
+
+def score_output_fn(params, cfg, arrays):
+    """Per-sequence scores written at segment-end positions, 0 elsewhere
+    — unpacks into one trailing scalar per sequence."""
+    values = vmapped_forward(params, cfg, arrays)[..., 0]
+    is_end = jax.vmap(ppo_ops.is_segment_end)(arrays["segment_ids"])
+    return jnp.where(is_end, values, 0.0)
+
+
+@dataclasses.dataclass
+class PairedRewardInterface(ModelInterface):
+    hf_family: Optional[str] = None
+    max_pairs_per_prompt: int = 8   # static bucket factor for pair matching
+
+    def __post_init__(self):
+        K = self.max_pairs_per_prompt
+
+        def rw_loss(params, cfg, arrays):
+            values = vmapped_forward(params, cfg, arrays)[..., 0]  # [D, T]
+            seg = arrays["segment_ids"]
+            is_end = jax.vmap(ppo_ops.is_segment_end)(seg)
+            D, T = seg.shape
+            bucket = (arrays["item_ids"] * K + arrays["pair_id"]).reshape(-1)
+            signed = (
+                values * arrays["pair_sign"].astype(jnp.float32)
+            ).reshape(-1)
+            endf = is_end.reshape(-1)
+            n_buckets = D * T * K
+            bucket = jnp.where(endf, bucket, n_buckets)       # dropped
+            diffs = jnp.zeros((n_buckets,), jnp.float32).at[bucket].add(
+                jnp.where(endf, signed, 0.0), mode="drop"
+            )
+            counts = jnp.zeros((n_buckets,), jnp.int32).at[bucket].add(
+                jnp.where(endf, 1, 0), mode="drop"
+            )
+            complete = counts == 2                            # full pos/neg pair
+            n = jnp.maximum(complete.sum(), 1)
+            loss = jnp.sum(
+                jnp.where(complete, -jax.nn.log_sigmoid(diffs), 0.0)
+            ) / n
+            acc = jnp.sum(jnp.where(complete, (diffs > 0).astype(jnp.float32), 0.0)) / n
+            return loss, {
+                "rw_loss": loss,
+                "rw_acc": acc,
+                "score_diff": jnp.sum(jnp.where(complete, diffs, 0.0)) / n,
+            }
+
+        self._rw_loss_fn = rw_loss
+
+    # ------------------------------------------------------------------ #
+
+    def train_step(
+        self, engine, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Dict[str, float]:
+        max_pid = int(np.max(sample.data["pair_id"])) if sample.data["pair_id"].size else 0
+        if max_pid >= self.max_pairs_per_prompt:
+            raise ValueError(
+                f"pair_id {max_pid} >= max_pairs_per_prompt "
+                f"{self.max_pairs_per_prompt}: bucket indices would collide "
+                "across items, silently corrupting the pairwise loss — raise "
+                "the interface's max_pairs_per_prompt"
+            )
+
+        def pair_weight(pb):
+            # weight micro-batches by their COMPLETE pair count so grad
+            # accumulation equals a global pair mean
+            ends = {}
+            for p in pb.placements:
+                key = (p.item_idx, int(pb.arrays["pair_id"][p.row, p.start]))
+                ends[key] = ends.get(key, 0) + 1
+            return float(sum(1 for v in ends.values() if v == 2))
+
+        stats = engine.train_batch(
+            sample, mb_spec, self._rw_loss_fn, loss_weight_fn=pair_weight
+        )
+        engine.version += 1
+        return stats
+
+    def inference(
+        self, engine, sample: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        """Score sequences: one scalar reward per sequence (the RM-scored
+        rollout path — plugs into the PPO graph as a ``reward_inf`` node)."""
+        outs = engine.forward(sample, mb_spec, score_output_fn)
+        scores = np.asarray([float(o.sum()) for o in outs], np.float32)
+        main = sample.main_key()
+        n_per_item = [len(l) for l in sample.seqlens[main]]
+        return SequenceSample(
+            keys={"rewards"},
+            ids=list(sample.ids),
+            seqlens={"rewards": [[1] * n for n in n_per_item]},
+            data={"rewards": scores},
+        )
+
+    def evaluate(self, engine, eval_samples) -> Dict[str, float]:
+        tot, n = 0.0, 0
+        for s in eval_samples:
+            r = engine.eval_batch(s, MicroBatchSpec(), self._rw_loss_fn)
+            tot += r["loss"]
+            n += 1
+        return {"loss": tot / max(n, 1)} if n else {}
+
+
+register_interface("reward", PairedRewardInterface)

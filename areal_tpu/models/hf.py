@@ -498,7 +498,18 @@ def load_hf_checkpoint(path: str):
         raise FileNotFoundError(f"No .safetensors shards under {path}")
     for shard in shards:
         sd.update(load_file(os.path.join(path, shard)))
-    return cfg, fam.params_from_hf(sd, cfg)
+    # critic/reward checkpoints: the scalar value head rides as
+    # ``score.weight [1, E]`` (the HF SequenceClassification convention)
+    # plus an ``is_critic`` marker in config.json — family converters only
+    # handle the CausalLM surface
+    if hf_cfg.get("is_critic"):
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, is_critic=True)
+    params = fam.params_from_hf(sd, cfg)
+    if cfg.is_critic and "score.weight" in sd:
+        params["head"] = {"weight": np.asarray(sd["score.weight"]).T}
+    return cfg, params
 
 
 def save_hf_checkpoint(params, cfg: ModelConfig, family: str, path: str):
@@ -509,12 +520,17 @@ def save_hf_checkpoint(params, cfg: ModelConfig, family: str, path: str):
     os.makedirs(path, exist_ok=True)
     host_params = jax_to_numpy(params)
     sd = fam.params_to_hf(host_params, cfg)
+    hf_cfg = fam.config_to_hf(cfg)
+    if cfg.is_critic:
+        # value head [E, 1] -> score.weight [1, E]; marker for the loader
+        sd["score.weight"] = np.asarray(host_params["head"]["weight"]).T
+        hf_cfg["is_critic"] = True
     # safetensors writes the *raw buffer*, silently corrupting non-contiguous
     # views (our converters emit transposed views of the stacked params).
     sd = {k: np.ascontiguousarray(v) for k, v in sd.items()}
     save_file(sd, os.path.join(path, "model.safetensors"))
     with open(os.path.join(path, "config.json"), "w") as f:
-        json.dump(fam.config_to_hf(cfg), f, indent=2)
+        json.dump(hf_cfg, f, indent=2)
 
 
 def jax_to_numpy(params):

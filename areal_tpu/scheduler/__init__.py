@@ -1,0 +1,11 @@
+"""Scheduler backends (≈ ``realhf/scheduler/``)."""
+
+from areal_tpu.scheduler.client import (  # noqa: F401
+    JobException,
+    JobInfo,
+    JobState,
+    LocalSchedulerClient,
+    SchedulerClient,
+    SlurmSchedulerClient,
+    make_scheduler,
+)

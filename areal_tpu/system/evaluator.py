@@ -151,7 +151,9 @@ def make_generation_eval_fn(
         eng, gen = state["eng"], state["gen"]
         eng.load_hf(ckpt_path)
         n = len(dataset) if max_prompts is None else min(max_prompts, len(dataset))
-        metadata = getattr(dataset, "metadata", {})
+        from areal_tpu.api.dataset import dataset_metadata
+
+        metadata = dataset_metadata(dataset)
         samples = [dataset[i] for i in range(n)]
         qids = [str(s.ids[0]) for s in samples]
         prompts = [np.asarray(s.data["packed_prompts"]).tolist() for s in samples]

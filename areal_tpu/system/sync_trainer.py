@@ -20,6 +20,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.dataset import dataset_metadata
 from areal_tpu.api.model import GenerationHyperparameters, PPOHyperparameters
 from areal_tpu.base import constants
 from areal_tpu.base.metrics import MetricLogger
@@ -175,7 +176,7 @@ class SyncPPOTrainerWorker:
         )
         t_gen = time.perf_counter() - t0
 
-        metadata = getattr(self.dataset, "metadata", {})
+        metadata = dataset_metadata(self.dataset)
         items, rewards_flat = [], []
         for qid, plist, group in zip(qids, prompts, groups):
             answers = [

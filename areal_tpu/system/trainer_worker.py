@@ -68,6 +68,7 @@ class AsyncPPOTrainerWorker:
         mb_spec: Optional[MicroBatchSpec] = None,
         ref_engine: Optional[TrainEngine] = None,
         critic_engine: Optional[TrainEngine] = None,
+        reward_engine: Optional[TrainEngine] = None,
         hf_family: str = "qwen2",
         metric_logger: Optional[MetricLogger] = None,
         ema_ref_eta: Optional[float] = None,
@@ -100,12 +101,15 @@ class AsyncPPOTrainerWorker:
                 ema_ref_eta=ema_ref_eta,
                 mb_spec=self.mb_spec,
                 hf_family=hf_family,
+                use_reward_model=reward_engine is not None,
             )
         engines = {"actor": actor_engine}
         if ref_engine is not None:
             engines["ref"] = ref_engine
         if critic_engine is not None:
             engines["critic"] = critic_engine
+        if reward_engine is not None:
+            engines["reward"] = reward_engine
         self.executor = FunctionExecutor(
             graph, engines, interfaces, default_mb_spec=self.mb_spec
         )
@@ -306,7 +310,9 @@ class AsyncPPOTrainerWorker:
 
 
 class SFTTrainerWorker:
-    """Sync SFT loop (≈ ``main_sft.py`` path; BASELINE config #1)."""
+    """Sync supervised loop (≈ ``main_sft.py`` path; BASELINE config #1).
+    ``interface_name`` selects the training objective — "sft" (next-token)
+    or "reward" (Bradley-Terry paired RM, ≈ the reference's rw experiment)."""
 
     def __init__(
         self,
@@ -321,6 +327,8 @@ class SFTTrainerWorker:
         hf_family: str = "qwen2",
         metric_logger: Optional[MetricLogger] = None,
         shuffle_seed: int = 1,
+        interface_name: str = "sft",
+        interface_kwargs: Optional[Dict] = None,
     ):
         self.experiment_name = experiment_name
         self.trial_name = trial_name
@@ -332,7 +340,8 @@ class SFTTrainerWorker:
         self.mb_spec = mb_spec or MicroBatchSpec(max_tokens_per_mb=16384)
         self.hf_family = hf_family
         self.metrics = metric_logger
-        self.interface = make_interface("sft")
+        self.interface = make_interface(interface_name, **(interface_kwargs or {}))
+        self._log_prefix = interface_name
         self.step = 0
         self.epoch = 0
         self._shuffle_seed = shuffle_seed
@@ -364,7 +373,7 @@ class SFTTrainerWorker:
                 stats = self.interface.train_step(self.engine, batch, self.mb_spec)
                 self.step += 1
                 if self.metrics is not None:
-                    self.metrics.log(stats, self.step, prefix="sft")
+                    self.metrics.log(stats, self.step, prefix=self._log_prefix)
                 if (
                     self.control.save_freq_steps
                     and self.step % self.control.save_freq_steps == 0
@@ -380,5 +389,5 @@ class SFTTrainerWorker:
                 ev = self.interface.evaluate(self.engine, list(self._eval_batches()))
                 logger.info("epoch %d eval: %s", self.epoch, ev)
                 if self.metrics is not None:
-                    self.metrics.log(ev, self.step, prefix="sft_eval")
+                    self.metrics.log(ev, self.step, prefix=f"{self._log_prefix}_eval")
         return self.step

@@ -149,11 +149,14 @@ class TrainEngine:
         return self
 
     def load_hf(self, path: str, init_critic_head: bool = False):
-        """Load a HF CausalLM checkpoint. With ``init_critic_head``, any
+        """Load a HF checkpoint. With ``init_critic_head``, a CausalLM's
         [E, V] lm head is dropped and a random [E, 1] value head inserted
         HOST-side (the critic's sharding tree always includes "head", so
         patching after device_put would trip a pytree mismatch on
         tied-embedding families — ≈ the reference's init_critic_from_actor).
+        A checkpoint that already carries a TRAINED value head (critic/RM
+        exports: ``score.weight`` + ``is_critic``) keeps it — re-randomizing
+        would silently score rollouts with noise.
         """
         import json
         import os
@@ -165,13 +168,17 @@ class TrainEngine:
             model_type = json.load(f)["model_type"]
         self.hf_family = hf_conv.family_for_model_type(model_type).name
         if init_critic_head:
-            host_params.pop("head", None)
-            rng = np.random.default_rng(0)
-            host_params["head"] = {
-                "weight": (
-                    rng.standard_normal((self.cfg.hidden_dim, 1)) * 0.02
-                ).astype(np.float32)
-            }
+            head = host_params.get("head", {}).get("weight")
+            if head is not None and head.shape == (self.cfg.hidden_dim, 1):
+                pass  # trained critic/RM checkpoint: keep its head
+            else:
+                host_params.pop("head", None)
+                rng = np.random.default_rng(0)
+                host_params["head"] = {
+                    "weight": (
+                        rng.standard_normal((self.cfg.hidden_dim, 1)) * 0.02
+                    ).astype(np.float32)
+                }
         return self.load_params(host_params)
 
     def load_params(self, host_params):

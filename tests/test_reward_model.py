@@ -1,0 +1,266 @@
+"""Reward-model path: paired dataset, Bradley-Terry training, RM-scored PPO.
+
+Counterpart of the reference's paired reward modeling
+(``realhf/impl/dataset/rw_paired_dataset.py`` + the RM half of its reward
+interfaces). The e2e check is VERDICT's bar: train a tiny RM on synthetic
+pairs where "good" answers share a token signature, then use it to score
+rollouts inside the PPO graph.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.dataset import DatasetUtility
+from areal_tpu.api.model import PPOHyperparameters, make_interface
+from areal_tpu.datasets.rw_paired import RewardPairedDataset
+from areal_tpu.models.config import ModelConfig
+from areal_tpu.parallel.mesh import ParallelConfig
+from areal_tpu.train.engine import OptimizerConfig, TrainEngine
+
+TINY_RM = ModelConfig(
+    n_layers=2, n_q_heads=4, n_kv_heads=2, head_dim=8, hidden_dim=32,
+    intermediate_dim=64, vocab_size=128, dtype="float32", is_critic=True,
+    use_attention_bias=True,  # qwen2-family surface (HF round-trip test)
+)
+
+GOOD_TOKEN, BAD_TOKEN = 7, 13
+
+
+def _write_pairs(path, n=24, seed=0):
+    """Synthetic preference data: positives end with GOOD_TOKEN runs,
+    negatives with BAD_TOKEN runs — a signature a tiny RM can learn."""
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for i in range(n):
+            prompt = [int(x) for x in rng.integers(20, 120, 4)]
+            pos = [prompt + [GOOD_TOKEN] * int(rng.integers(3, 6)) for _ in range(2)]
+            neg = [prompt + [BAD_TOKEN] * int(rng.integers(3, 6)) for _ in range(2)]
+            f.write(json.dumps({
+                "qid": f"p{i}", "prompt_ids": prompt,
+                "pos_answer_ids": pos, "neg_answer_ids": neg,
+            }) + "\n")
+
+
+@pytest.fixture(scope="module")
+def rw_dataset(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("rw") / "pairs.jsonl")
+    _write_pairs(path)
+    util = DatasetUtility(seed=1, dp_rank=0, world_size=1, tokenizer=None)
+    return RewardPairedDataset(util, path)
+
+
+class TestDataset:
+    def test_pair_layout(self, rw_dataset):
+        s = rw_dataset[0]
+        assert s.keys == {"packed_input_ids", "pair_id", "pair_sign"}
+        n = len(s.seqlens["packed_input_ids"][0])
+        assert n == 4  # 2 pairs -> [pos0, neg0, pos1, neg1]
+        np.testing.assert_array_equal(s.data["pair_sign"], [1, -1, 1, -1])
+        np.testing.assert_array_equal(s.data["pair_id"], [0, 0, 1, 1])
+
+    def test_pair_mismatch_raises(self, tmp_path):
+        path = str(tmp_path / "bad.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps({
+                "qid": "x", "prompt_ids": [1],
+                "pos_answer_ids": [[1, 2]], "neg_answer_ids": [],
+            }) + "\n")
+        util = DatasetUtility(seed=1, dp_rank=0, world_size=1, tokenizer=None)
+        with pytest.raises(ValueError, match="one-to-one"):
+            RewardPairedDataset(util, path)
+
+
+@pytest.fixture(scope="module")
+def trained_rm(rw_dataset):
+    eng = TrainEngine(
+        TINY_RM, ParallelConfig(data=2, fsdp=2, model=2),
+        OptimizerConfig(lr=3e-3),
+    )
+    eng.init_random(0)
+    eng.setup_optimizer(total_train_steps=40)
+    iface = make_interface("reward")
+    stats = None
+    for epoch in range(6):
+        for lo in range(0, len(rw_dataset), 8):
+            batch = SequenceSample.gather(
+                [rw_dataset[i] for i in range(lo, min(lo + 8, len(rw_dataset)))]
+            )
+            stats = iface.train_step(eng, batch, MicroBatchSpec())
+    return eng, iface, stats
+
+
+class TestRMTraining:
+    def test_bt_loss_learns_preference(self, trained_rm):
+        _, _, stats = trained_rm
+        assert stats["rw_acc"] > 0.9          # separates pos from neg
+        assert stats["score_diff"] > 0        # s_pos > s_neg on average
+        assert np.isfinite(stats["rw_loss"])
+
+    def test_scoring_ranks_held_out(self, trained_rm):
+        eng, iface, _ = trained_rm
+        # held-out prompt, one good and one bad answer (grouped sample)
+        seqs = [[50, 60, GOOD_TOKEN] * 2, [50, 60, BAD_TOKEN] * 2]
+        lens = [len(s) for s in seqs]
+        sample = SequenceSample(
+            keys={"packed_input_ids"},
+            ids=["h"],
+            seqlens={"packed_input_ids": [lens]},
+            data={"packed_input_ids": np.concatenate(
+                [np.asarray(s, np.int64) for s in seqs]
+            )},
+        )
+        out = iface.inference(eng, sample, MicroBatchSpec())
+        scores = out.data["rewards"]
+        assert out.seqlens["rewards"] == [[1, 1]]
+        assert scores[0] > scores[1]          # good beats bad
+
+
+class TestRMScoredPPO:
+    def test_reward_inf_node_feeds_ppo(self, trained_rm, rng):
+        """The PPO graph's reward_inf node scores rollouts with the trained
+        RM — RM rewards supersede the rollout's rule-based ones."""
+        from areal_tpu.experiments.graphs import build_ppo_graph
+        from areal_tpu.system.function_executor import FunctionExecutor
+
+        rm_engine, _, _ = trained_rm
+        actor_cfg = ModelConfig(
+            n_layers=2, n_q_heads=4, n_kv_heads=2, head_dim=8, hidden_dim=32,
+            intermediate_dim=64, vocab_size=128, dtype="float32",
+        )
+        actor = TrainEngine(
+            actor_cfg, ParallelConfig(data=2, fsdp=2, model=2),
+            OptimizerConfig(lr=1e-4),
+        )
+        actor.init_random(1)
+        actor.setup_optimizer(total_train_steps=10)
+
+        hp = PPOHyperparameters(disable_value=True, kl_ctl=0.0)
+        g, ifaces = build_ppo_graph(
+            hp, use_ref=False, use_critic=False, use_reward_model=True,
+        )
+        assert g.names[0] == "reward_inf"
+        assert g.producers["rewards"] == "reward_inf"
+        ex = FunctionExecutor(
+            g, {"actor": actor, "reward": rm_engine}, ifaces,
+            default_mb_spec=MicroBatchSpec(),
+        )
+        # grouped rollout sample: one good + one bad continuation
+        seqs = [
+            [30, 40, GOOD_TOKEN, GOOD_TOKEN, GOOD_TOKEN],
+            [30, 40, BAD_TOKEN, BAD_TOKEN, BAD_TOKEN],
+        ]
+        lens = [len(s) for s in seqs]
+        lp = np.zeros(sum(lens), np.float32)
+        sample = SequenceSample(
+            keys={"packed_input_ids", "prompt_mask", "packed_logprobs",
+                  "seq_no_eos_mask"},
+            ids=["q"],
+            seqlens={
+                "packed_input_ids": [lens], "prompt_mask": [lens],
+                "packed_logprobs": [lens], "seq_no_eos_mask": [[1, 1]],
+            },
+            data={
+                "packed_input_ids": np.concatenate(
+                    [np.asarray(s, np.int64) for s in seqs]
+                ),
+                "prompt_mask": np.concatenate(
+                    [np.r_[np.ones(2, bool), np.zeros(ln - 2, bool)]
+                     for ln in lens]
+                ),
+                "packed_logprobs": lp,
+                "seq_no_eos_mask": np.zeros(2, bool),
+            },
+        )
+        stats = ex.run(sample)
+        assert np.isfinite(stats["actor_loss"])
+        # the RM's scores were attached and favor the good continuation
+        rewards = sample.data["rewards"]
+        assert rewards[0] > rewards[1]
+
+
+def test_rw_experiment_e2e(tmp_path):
+    """Launcher-level RM training run: loss drops, HF export lands."""
+    from areal_tpu.apps import launcher
+    from areal_tpu.experiments import RWExperiment, load_config
+
+    data = str(tmp_path / "pairs.jsonl")
+    _write_pairs(data, n=16)
+    arch = dict(
+        n_layers=2, n_q_heads=4, n_kv_heads=2, head_dim=8, hidden_dim=32,
+        intermediate_dim=64, vocab_size=128, dtype="float32",
+    )
+    cfg = load_config(RWExperiment, None, [
+        "experiment_name=rw-test",
+        "trial_name=t0",
+        f"fileroot={tmp_path}/root",
+        f"dataset.path={data}",
+        "dataset.name=rw_paired",
+        "batch_size=8",
+        "max_tokens_per_mb=512",
+        "control.total_train_steps=6",
+        "control.save_freq_steps=6",
+        "model.parallel=d2m1",
+        f"model.arch={json.dumps(arch)}",
+        "model.optimizer.lr=0.003",
+    ])
+    assert launcher.run_rw(cfg) == 0
+    import os
+
+    metrics = os.path.join(f"{tmp_path}/root", "logs", "rw-test", "t0",
+                           "metrics.jsonl")
+    lines = [json.loads(l) for l in open(metrics)]
+    assert len(lines) == 6
+    assert lines[-1]["reward/rw_loss"] < lines[0]["reward/rw_loss"]
+    save_dir = os.path.join(f"{tmp_path}/root", "checkpoints", "rw-test",
+                            "t0", "step6")
+    assert os.path.exists(os.path.join(save_dir, "model.safetensors"))
+
+def test_critic_checkpoint_roundtrips_value_head(tmp_path):
+    """Critic/RM HF exports keep their trained scalar head (score.weight +
+    is_critic marker); reloading from DISK preserves scores exactly — the
+    RM-scored-PPO workflow depends on this round trip."""
+    import jax
+
+    from areal_tpu.models import hf as hf_conv, transformer as tfm
+
+    params = tfm.init_params(TINY_RM, jax.random.key(3))
+    host = jax.tree.map(lambda x: np.asarray(x), params)
+    path = str(tmp_path / "rm")
+    hf_conv.save_hf_checkpoint(host, TINY_RM, "qwen2", path)
+    cfg2, loaded = hf_conv.load_hf_checkpoint(path)
+    assert cfg2.is_critic
+    np.testing.assert_allclose(
+        loaded["head"]["weight"], host["head"]["weight"], atol=1e-7
+    )
+    ids = np.arange(1, 9, dtype=np.int32)
+    v1 = tfm.forward_packed(
+        params, TINY_RM, ids, np.ones(8, np.int32), np.arange(8, dtype=np.int32)
+    )
+    v2 = tfm.forward_packed(
+        jax.tree.map(np.asarray, loaded), TINY_RM, ids,
+        np.ones(8, np.int32), np.arange(8, dtype=np.int32)
+    )
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), atol=1e-6)
+
+
+def test_load_hf_init_critic_head_keeps_trained_head(tmp_path):
+    """Review regression: _load_engine(is_critic=True) -> load_hf(
+    init_critic_head=True) must NOT re-randomize a checkpoint that already
+    carries a trained value head (RM-scored PPO would score with noise)."""
+    import jax
+
+    from areal_tpu.models import hf as hf_conv, transformer as tfm
+
+    params = tfm.init_params(TINY_RM, jax.random.key(3))
+    host = jax.tree.map(lambda x: np.asarray(x), params)
+    path = str(tmp_path / "rm")
+    hf_conv.save_hf_checkpoint(host, TINY_RM, "qwen2", path)
+    eng = TrainEngine(TINY_RM, ParallelConfig())
+    eng.load_hf(path, init_critic_head=True)
+    np.testing.assert_allclose(
+        np.asarray(eng.params["head"]["weight"]), host["head"]["weight"],
+        atol=1e-7,
+    )
