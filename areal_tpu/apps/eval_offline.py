@@ -40,6 +40,11 @@ def main(argv=None):
     ap.add_argument("--batch-prompts", type=int, default=32)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--overwrite", action="store_true")
+    ap.add_argument(
+        "--allow-token-id-answers", action="store_true",
+        help="debug only: grade space-joined token-id strings when no "
+             "tokenizer is available (real math grading needs one)",
+    )
     args = ap.parse_args(argv)
 
     out_samples = os.path.join(args.output_dir, "samples.jsonl")
@@ -64,7 +69,14 @@ def main(argv=None):
         import transformers
 
         tokenizer = transformers.AutoTokenizer.from_pretrained(tok_path)
-    except Exception:
+    except Exception as e:
+        if not args.allow_token_id_answers:
+            # silently grading token-id strings would burn the whole
+            # generation sweep to report a meaningless pass@1 = 0
+            raise SystemExit(
+                f"no tokenizer at {tok_path} ({e}); pass --tokenizer or "
+                "--allow-token-id-answers (debug)"
+            )
         logger.warning("no tokenizer at %s; decoding as token-id strings", tok_path)
     util = DatasetUtility(seed=args.seed, dp_rank=0, world_size=1, tokenizer=tokenizer)
     dataset = make_dataset("math_code_prompt", util, path=args.dataset)

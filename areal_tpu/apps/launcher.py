@@ -662,141 +662,6 @@ def run_rw(cfg) -> int:
     )
 
 
-def run_sync_ppo(cfg) -> int:
-    """Sync PPO runs in-process: generation happens on the trainer's own
-    mesh/params (no fleet, no weight publish); the evaluator (if enabled)
-    runs as a side process on host 0."""
-    _setup_worker_env(cfg, cfg.trainer_device)
-    from areal_tpu.parallel import multihost
-
-    multihost.maybe_initialize_from_env()
-    from areal_tpu.api.dataset import DatasetUtility, make_dataset
-    from areal_tpu.base import constants
-    from areal_tpu.base.metrics import MetricLogger
-    from areal_tpu.system.sync_trainer import SyncPPOTrainerWorker
-    from areal_tpu.system.trainer_worker import TrainerControl
-
-    from areal_tpu.system import worker_base
-
-    if multihost.is_main():
-        worker_base.mark_experiment_running(cfg.experiment_name, cfg.trial_name)
-    ev_proc = ev_stop = None
-    if cfg.evaluator.enabled and multihost.is_main():
-        ctx = mp.get_context("spawn")
-        ev_stop = ctx.Event()
-        with _cpu_child_env(cfg.evaluator.device == "cpu"):
-            ev_proc = ctx.Process(
-                target=evaluator_main, args=(cfg, ev_stop), daemon=True
-            )
-            ev_proc.start()
-
-    tokenizer = None
-    if cfg.tokenizer_path:
-        import transformers
-
-        tokenizer = transformers.AutoTokenizer.from_pretrained(cfg.tokenizer_path)
-    util = DatasetUtility(
-        seed=cfg.dataset.seed, dp_rank=0, world_size=1, tokenizer=tokenizer
-    )
-    dataset = make_dataset(
-        cfg.dataset.name, util, path=cfg.dataset.path,
-        max_length=cfg.dataset.max_length,
-    )
-    total = cfg.control.total_train_steps
-    actor, ref, critic, _ = _load_ppo_engines(cfg, total)
-    decode_fn = None
-    if tokenizer is not None:
-        decode_fn = lambda ids: tokenizer.decode(ids, skip_special_tokens=True)
-    worker = SyncPPOTrainerWorker(
-        experiment_name=cfg.experiment_name,
-        trial_name=cfg.trial_name,
-        actor_engine=actor,
-        dataset=dataset,
-        hp=cfg.ppo,
-        ghp=cfg.gconfig,
-        control=TrainerControl(
-            total_train_steps=total,
-            save_freq_steps=cfg.control.save_freq_steps,
-        ),
-        batch_size=cfg.batch_size,
-        mb_spec=cfg.mb_spec,
-        ref_engine=ref,
-        critic_engine=critic,
-        ema_ref_eta=cfg.ema_ref_eta,
-        decode_fn=decode_fn,
-        hf_family=cfg.hf_family,
-        metric_logger=MetricLogger(constants.get_log_root()),
-        seed=cfg.seed,
-    )
-    try:
-        worker.run()
-    finally:
-        if multihost.is_main():
-            worker_base.mark_experiment_stopped(cfg.experiment_name, cfg.trial_name)
-        if ev_proc is not None:
-            # graceful stop: the evaluator runs one final sweep so the last
-            # checkpoint export is always scored
-            ev_stop.set()
-            ev_proc.join(timeout=300)
-            if ev_proc.is_alive():
-                ev_proc.terminate()
-                ev_proc.join(timeout=10)
-    return 0
-
-
-def run_rw(cfg) -> int:
-    """Paired reward-model training in-process (≈ the reference's rw
-    experiment): critic-architecture model + Bradley-Terry pairwise loss
-    over ``rw_paired`` data; exports HF checkpoints usable as the "reward"
-    engine in RM-scored PPO."""
-    _setup_worker_env(cfg, "")
-    from areal_tpu.api.data import MicroBatchSpec
-    from areal_tpu.api.dataset import DatasetUtility, make_dataset
-    from areal_tpu.base import constants
-    from areal_tpu.base.metrics import MetricLogger
-    from areal_tpu.system.trainer_worker import SFTTrainerWorker, TrainerControl
-
-    tokenizer = None
-    if cfg.tokenizer_path:
-        import transformers
-
-        tokenizer = transformers.AutoTokenizer.from_pretrained(cfg.tokenizer_path)
-    util = DatasetUtility(
-        seed=cfg.dataset.seed, dp_rank=0, world_size=1, tokenizer=tokenizer
-    )
-    dataset = make_dataset(
-        cfg.dataset.name, util, path=cfg.dataset.path,
-        max_length=cfg.dataset.max_length,
-        max_pairs_per_prompt=cfg.max_pairs_per_prompt,
-    )
-    eval_ds = None
-    if cfg.eval_dataset is not None:
-        eval_ds = make_dataset(
-            cfg.eval_dataset.name, util, path=cfg.eval_dataset.path,
-            max_length=cfg.eval_dataset.max_length,
-            max_pairs_per_prompt=cfg.max_pairs_per_prompt,
-        )
-    engine = _load_engine(
-        cfg.model, is_critic=True, total_steps=cfg.control.total_train_steps
-    )
-    worker = SFTTrainerWorker(
-        experiment_name=cfg.experiment_name,
-        trial_name=cfg.trial_name,
-        engine=engine,
-        dataset=dataset,
-        eval_dataset=eval_ds,
-        control=TrainerControl(
-            total_train_steps=cfg.control.total_train_steps,
-            save_freq_steps=cfg.control.save_freq_steps,
-        ),
-        batch_size=cfg.batch_size,
-        mb_spec=MicroBatchSpec(max_tokens_per_mb=cfg.max_tokens_per_mb),
-        hf_family=cfg.hf_family,
-        metric_logger=MetricLogger(constants.get_log_root()),
-        interface_name="reward",
-    )
-    worker.run()
-    return 0
 
 
 def run_sft(cfg) -> int:

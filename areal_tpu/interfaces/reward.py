@@ -122,11 +122,14 @@ class PairedRewardInterface(ModelInterface):
         )
 
     def evaluate(self, engine, eval_samples) -> Dict[str, float]:
+        # weight each eval batch by its PAIR count (the loss is a pair mean;
+        # token-weighted averaging would skew toward long sequences)
         tot, n = 0.0, 0
         for s in eval_samples:
             r = engine.eval_batch(s, MicroBatchSpec(), self._rw_loss_fn)
-            tot += r["loss"]
-            n += 1
+            pairs = sum(len(inner) for inner in s.seqlens[s.main_key()]) // 2
+            tot += r["loss"] * pairs
+            n += pairs
         return {"loss": tot / max(n, 1)} if n else {}
 
 

@@ -91,6 +91,16 @@ def packed_attention(
     """
     if softmax_scale is None:
         softmax_scale = q.shape[-1] ** -0.5
+    if _RING_CTX is not None:
+        from areal_tpu.ops.ring_attention import ring_attention
+
+        mesh, axis = _RING_CTX
+        return ring_attention(
+            q, k, v, segment_ids, mesh, axis,
+            softmax_scale=softmax_scale,
+            soft_cap=soft_cap,
+            sliding_window=sliding_window,
+        )
     if use_flash:
         from areal_tpu.ops.pallas import flash_attention as _fa
 
@@ -107,6 +117,34 @@ def packed_attention(
     return _attention_xla(
         q, k, v, segment_ids, softmax_scale, soft_cap, sliding_window
     )
+
+
+# Context-parallel override: when set, packed training attention rings the
+# token axis over the given mesh axis (engines with ParallelConfig.ctx > 1
+# set this at init; the trace picks it up wherever the forward runs).
+_RING_CTX = None
+
+
+def set_context_parallel(mesh, axis_name: str = "ctx"):
+    global _RING_CTX
+    if _RING_CTX is not None:
+        old_mesh, old_axis = _RING_CTX
+        if old_axis != axis_name or dict(old_mesh.shape) != dict(mesh.shape):
+            raise ValueError(
+                "conflicting context-parallel topologies in one process: "
+                f"{dict(old_mesh.shape)} vs {dict(mesh.shape)} — every train "
+                "engine in a CP experiment must share the same mesh shape"
+            )
+    _RING_CTX = (mesh, axis_name)
+
+
+def get_context_parallel():
+    return _RING_CTX
+
+
+def clear_context_parallel():
+    global _RING_CTX
+    _RING_CTX = None
 
 
 def decode_attention(

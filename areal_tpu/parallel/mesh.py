@@ -10,12 +10,14 @@ TPU equivalent is declarative: one ``jax.sharding.Mesh`` with named axes
 - ``model``: tensor parallelism (heads/mlp/vocab logical axes; XLA inserts
   the psums exactly where Megatron's Column/RowParallelLinear pairs do),
 
+- ``ctx``: context/sequence parallelism — the packed token axis shards over
+  it and attention runs as a ring over ICI (``ops/ring_attention.py``),
+
 plus logical→mesh rules mapping each parameter's logical axes (declared in
 ``areal_tpu.models.transformer.param_logical_axes``) to mesh axes. Pipeline
 parallelism is deliberately absent: stages-as-shardings via GSPMD replace the
-reference's instruction-based PP engine (SURVEY.md §2.2 row "PP"). Sequence
-parallelism is an activation-sharding annotation (see ``seq_pspec``), and
-expert parallelism maps the "expert" logical axis onto ``model``.
+reference's instruction-based PP engine (SURVEY.md §2.2 row "PP"); expert
+parallelism maps the "expert" logical axis onto ``model``.
 """
 
 import dataclasses
@@ -29,33 +31,36 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 @dataclasses.dataclass(frozen=True)
 class ParallelConfig:
     """≈ the reference's ``ParallelismConfig`` (``realhf/api/cli_args.py:127``)
-    re-expressed as mesh axis sizes."""
+    re-expressed as mesh axis sizes.
+
+    ``ctx`` is context/sequence parallelism: the packed TOKEN axis shards
+    over it and attention runs as a ring (``ops/ring_attention.py``) — the
+    long-context axis the reference reaches through Megatron sequence
+    parallelism + varlen flash (SURVEY §2.2 "SP")."""
 
     data: int = 1
     fsdp: int = 1
     model: int = 1
-
-    # Megatron SP equivalent: shard activation token axes over `model` in
-    # norm/elementwise regions. Annotation-level; no effect on correctness.
-    use_sequence_parallel: bool = False
+    ctx: int = 1
 
     @property
     def world_size(self) -> int:
-        return self.data * self.fsdp * self.model
+        return self.data * self.fsdp * self.ctx * self.model
 
     @classmethod
     def from_str(cls, s: str) -> "ParallelConfig":
-        """Parse ``"d2f2m2"``-style strings (≈ the reference's ``d4m1p1``
-        allocation-mode tokens, with fsdp replacing pp)."""
+        """Parse ``"d2f2c2m2"``-style strings (≈ the reference's ``d4m1p1``
+        allocation-mode tokens, with fsdp/ctx replacing pp)."""
         import re
 
-        m = re.fullmatch(r"d(\d+)(?:f(\d+))?m(\d+)", s)
+        m = re.fullmatch(r"d(\d+)(?:f(\d+))?(?:c(\d+))?m(\d+)", s)
         if not m:
             raise ValueError(f"Bad parallelism spec: {s!r}")
         return cls(
             data=int(m.group(1)),
             fsdp=int(m.group(2) or 1),
-            model=int(m.group(3)),
+            ctx=int(m.group(3) or 1),
+            model=int(m.group(4)),
         )
 
 
@@ -96,19 +101,20 @@ def make_mesh(
                 f"parallel config gives {cfg.world_size}"
             )
         per_proc = len(devices) // nproc
-        if per_proc % cfg.model != 0:
+        if per_proc % (cfg.ctx * cfg.model) != 0:
             raise ValueError(
-                f"model={cfg.model} groups straddle process boundaries "
-                f"({per_proc} devices/process); keep TP within a host"
+                f"ctx*model={cfg.ctx * cfg.model} groups straddle process "
+                f"boundaries ({per_proc} devices/process); keep TP and the "
+                "attention ring within a host so they ride ICI"
             )
     if cfg.world_size > len(devices):
         raise ValueError(
             f"Parallel config needs {cfg.world_size} devices, have {len(devices)}"
         )
     devs = np.asarray(devices[: cfg.world_size]).reshape(
-        cfg.data, cfg.fsdp, cfg.model
+        cfg.data, cfg.fsdp, cfg.ctx, cfg.model
     )
-    return Mesh(devs, ("data", "fsdp", "model"))
+    return Mesh(devs, ("data", "fsdp", "ctx", "model"))
 
 
 def logical_to_pspec(
@@ -137,12 +143,7 @@ def shard_params(mesh: Mesh, params, logical_tree, rules=None):
 
 def batch_pspec() -> P:
     """Packed data buffers are [D, T]: rows spread over both data-parallel
-    mesh axes, the token axis unsharded (attention stays shard-local — the
-    exact analogue of the reference's per-DP-rank packed batches)."""
-    return P(("data", "fsdp"), None)
-
-
-def seq_pspec(use_sp: bool) -> P:
-    """Activation sharding for sequence-parallel regions: [D, T, E] with the
-    token axis over `model` (≈ Megatron SP, ``mappings.py:200-260``)."""
-    return P(("data", "fsdp"), "model" if use_sp else None, None)
+    mesh axes; the token axis shards over ``ctx`` (size 1 = unsharded, the
+    per-DP-rank packed batches of the reference; >1 = ring-attention
+    context parallelism for long sequences)."""
+    return P(("data", "fsdp"), "ctx")

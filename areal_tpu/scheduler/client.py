@@ -193,6 +193,10 @@ class SlurmSchedulerClient(SchedulerClient):
         self.log_dir = log_dir
         self.extra = list(extra_sbatch_args or [])
         self._job_ids: Dict[str, str] = {}
+        # last state seen per job: transient controller errors fall back to
+        # this instead of crashing the wait() loop (or worse, reporting
+        # NOT_FOUND, which wait() treats as terminal)
+        self._last_state: Dict[str, JobState] = {}
 
     # -- command construction (pure; unit-testable without slurm) -------- #
 
@@ -252,28 +256,78 @@ class SlurmSchedulerClient(SchedulerClient):
     def _jobs(self):
         return list(self._job_ids)
 
+    def _fallback(self, job_name: str, job_id: str) -> JobInfo:
+        """Controller hiccup (squeue/sacct non-zero exit): report the last
+        known state — never crash the poll loop, never fake a terminal
+        NOT_FOUND."""
+        return JobInfo(
+            name=job_name,
+            state=self._last_state.get(job_name, JobState.PENDING),
+            slurm_id=job_id,
+        )
+
     def find(self, job_name: str) -> JobInfo:
         self._require_slurm()
         job_id = self._job_ids.get(job_name)
         if job_id is None:
             return JobInfo(name=job_name, state=JobState.NOT_FOUND)
-        out = subprocess.check_output(
-            ["squeue", "-j", job_id, "-h", "-o", "%T|%N"], text=True
-        ).strip()
-        if not out:  # left the queue: ask the accountant
+        try:
             out = subprocess.check_output(
-                ["sacct", "-j", job_id, "-n", "-X", "-o", "State"], text=True
+                ["squeue", "-j", job_id, "-h", "-o", "%T|%N"], text=True,
+                stderr=subprocess.DEVNULL,
             ).strip()
+        except subprocess.CalledProcessError:
+            # jobs purged from the controller exit non-zero: ask sacct
+            out = ""
+        if not out:  # left the queue: ask the accountant
+            try:
+                out = subprocess.check_output(
+                    ["sacct", "-j", job_id, "-n", "-X", "-o", "State"],
+                    text=True, stderr=subprocess.DEVNULL,
+                ).strip()
+            except subprocess.CalledProcessError:
+                return self._fallback(job_name, job_id)
             state = _SLURM_STATES.get(out.split()[0].rstrip("+") if out else "",
                                       JobState.NOT_FOUND)
+            self._last_state[job_name] = state
             return JobInfo(name=job_name, state=state, slurm_id=job_id)
         st, node = (out.split("|") + [None])[:2]
-        return JobInfo(
-            name=job_name,
-            state=_SLURM_STATES.get(st, JobState.PENDING),
-            host=node,
-            slurm_id=job_id,
-        )
+        state = _SLURM_STATES.get(st, JobState.PENDING)
+        self._last_state[job_name] = state
+        return JobInfo(name=job_name, state=state, host=node, slurm_id=job_id)
+
+    def find_all(self, regex: str = ".*") -> List[JobInfo]:
+        """ONE squeue call for every tracked job (per-job polling hammers
+        the controller; squeue takes a comma-separated id list), with sacct
+        / last-known fallbacks per job that left the queue."""
+        self._require_slurm()
+        pat = re.compile(regex)
+        names = [n for n in self._job_ids if pat.match(n)]
+        if not names:
+            return []
+        ids = ",".join(self._job_ids[n] for n in names)
+        by_id: Dict[str, tuple] = {}
+        try:
+            out = subprocess.check_output(
+                ["squeue", "-j", ids, "-h", "-o", "%i|%T|%N"], text=True,
+                stderr=subprocess.DEVNULL,
+            )
+            for line in out.splitlines():
+                jid, st, node = (line.strip().split("|") + [None])[:3]
+                by_id[jid] = (st, node)
+        except subprocess.CalledProcessError:
+            pass  # fall through to per-job sacct below
+        infos = []
+        for n in names:
+            jid = self._job_ids[n]
+            if jid in by_id:
+                st, node = by_id[jid]
+                state = _SLURM_STATES.get(st, JobState.PENDING)
+                self._last_state[n] = state
+                infos.append(JobInfo(name=n, state=state, host=node, slurm_id=jid))
+            else:
+                infos.append(self.find(n))
+        return infos
 
     def stop(self, job_name: str):
         self._require_slurm()

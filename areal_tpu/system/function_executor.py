@@ -86,8 +86,17 @@ class FunctionExecutor:
 
     def run(self, sample: SequenceSample) -> Dict[str, float]:
         """Execute every MFC in level order against ``sample`` (mutated
-        in-place with produced keys). Returns merged train stats."""
+        in-place with produced keys). Returns merged train stats plus the
+        step's analytic FLOP total (``flops``) — callers divide by wall time
+        for the per-step TFLOP/s line (≈ ``realhf/system/flops_counter.py:15``
+        accumulated per MFC at ``master_worker.py:497-533``)."""
+        from areal_tpu.base import flops as flops_mod
+
         stats: Dict[str, float] = {}
+        main = sample.main_key()
+        seqlens = [int(n) for inner in sample.seqlens[main] for n in inner]
+        n_tokens = sum(seqlens)
+        total_flops = 0.0
         for level in self.graph.levels:
             for mfc in level:
                 engine = self.engines[mfc.model_name]
@@ -99,6 +108,9 @@ class FunctionExecutor:
                 if mfc.interface_type == "train_step":
                     out = iface.train_step(engine, sub, mb_spec)
                     stats.update(out)
+                    total_flops += flops_mod.train_flops(
+                        engine.cfg, n_tokens, seqlens
+                    )
                 else:  # inference | generate
                     fn = getattr(iface, mfc.interface_type)
                     out = fn(engine, sub, mb_spec)
@@ -111,6 +123,10 @@ class FunctionExecutor:
                                 f"it did not produce (got {sorted(out.keys)})"
                             )
                         sample.update_(out.select(mfc.output_keys) if mfc.output_keys else out)
+                    total_flops += flops_mod.forward_flops(
+                        engine.cfg, n_tokens, seqlens
+                    )
                 for h in mfc.post_hooks:
                     self._apply_hook(h, mfc)
+        stats["flops"] = total_flops
         return stats

@@ -190,6 +190,10 @@ class SyncPPOTrainerWorker:
         stats = self.executor.run(batch)
         stats["timeperf/gen"] = t_gen
         stats["timeperf/e2e"] = time.perf_counter() - t0
+        if "flops" in stats:  # train-side FLOPs only (gen not counted)
+            stats["tflops_per_sec"] = (
+                stats.pop("flops") / max(stats["timeperf/e2e"] - t_gen, 1e-9) / 1e12
+            )
         stats["reward_mean"] = float(np.mean(rewards_flat))
         stats["n_seqs_consumed"] = sum(len(g) for g in groups)
         self.step += 1

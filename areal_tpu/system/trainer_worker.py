@@ -235,6 +235,10 @@ class AsyncPPOTrainerWorker:
         t0 = time.perf_counter()
         stats = self.train_step(sample)
         stats["timeperf/e2e"] = time.perf_counter() - t0
+        if "flops" in stats:  # per-step throughput line (≈ flops_counter)
+            stats["tflops_per_sec"] = (
+                stats.pop("flops") / max(stats["timeperf/e2e"], 1e-9) / 1e12
+            )
         n_tokens = sum(
             sum(inner) for inner in sample.seqlens[sample.main_key()]
         )
@@ -368,9 +372,22 @@ class SFTTrainerWorker:
         if len(self.dataset) == 0:
             logger.warning("empty SFT dataset; nothing to train")
             return 0
+        from areal_tpu.base import flops as flops_mod
+
         while self.step < self.control.total_train_steps:
             for batch in self._epoch_batches():
+                t0 = time.perf_counter()
                 stats = self.interface.train_step(self.engine, batch, self.mb_spec)
+                dt = time.perf_counter() - t0
+                lens = [
+                    int(n)
+                    for inner in batch.seqlens[batch.main_key()]
+                    for n in inner
+                ]
+                stats["tflops_per_sec"] = (
+                    flops_mod.train_flops(self.engine.cfg, sum(lens), lens)
+                    / max(dt, 1e-9) / 1e12
+                )
                 self.step += 1
                 if self.metrics is not None:
                     self.metrics.log(stats, self.step, prefix=self._log_prefix)

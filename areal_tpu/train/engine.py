@@ -114,10 +114,26 @@ class TrainEngine:
         )
         self._batch_sharding = NamedSharding(self.mesh, batch_pspec())
         # stacked micro-batches [n_mbs, D, T, ...]: rows still spread over
-        # the data axes, the micro-batch axis unsharded (scanned over)
+        # the data axes, tokens over ctx, the micro-batch axis unsharded
         self._stacked_sharding = NamedSharding(
-            self.mesh, P(None, ("data", "fsdp"), None)
+            self.mesh, P(None, ("data", "fsdp"), "ctx")
         )
+        from areal_tpu.ops import attention as attn_ops
+
+        if parallel.ctx > 1:
+            # context parallelism: packed attention rings the token axis
+            # over this mesh (process-global — every engine in a CP
+            # experiment must share the same mesh topology; conflicting
+            # shapes raise in set_context_parallel)
+            if parallel.ctx & (parallel.ctx - 1):
+                raise ValueError(f"ctx must be a power of two, got {parallel.ctx}")
+            attn_ops.set_context_parallel(self.mesh, "ctx")
+        elif attn_ops.get_context_parallel() is not None:
+            raise ValueError(
+                "a context-parallel engine is active in this process: every "
+                "train engine must use the same ctx topology (got ctx=1); "
+                "match the parallel specs or clear_context_parallel() first"
+            )
 
     # ------------------------------------------------------------------ #
     # Initialization

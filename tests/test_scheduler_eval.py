@@ -115,7 +115,7 @@ def test_eval_offline_harness(tmp_path):
     rc = eval_offline.main([
         "--model-path", ckpt, "--dataset", data, "--output-dir", out,
         "--n-sampling", "2", "--max-gen-tokens", "8", "--greedy",
-        "--batch-prompts", "2",
+        "--batch-prompts", "2", "--allow-token-id-answers",
     ])
     assert rc == 0
     agg = json.load(open(os.path.join(out, "aggregate.json")))
@@ -126,5 +126,5 @@ def test_eval_offline_harness(tmp_path):
     # idempotence: a second run without --overwrite is a no-op
     assert eval_offline.main([
         "--model-path", ckpt, "--dataset", data, "--output-dir", out,
-        "--n-sampling", "2",
+        "--n-sampling", "2", "--allow-token-id-answers",
     ]) == 0
