@@ -170,16 +170,17 @@ def ring_attention(
         block_k=bk,
         cp=cp,
     )
+    # keep TP: heads stay split over `model` inside the ring when both q and
+    # kv head counts divide it (they must split together or the GQA group
+    # ratio breaks); otherwise heads replicate across model for this op
+    m = mesh.shape.get("model", 1)
+    head_ax = "model" if (m > 1 and H % m == 0 and k.shape[1] % m == 0) else None
     spec_t = P(axis_name)
+    spec_qkv = P(axis_name, head_ax, None)
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(
-            P(axis_name, None, None),
-            P(axis_name, None, None),
-            P(axis_name, None, None),
-            spec_t,
-        ),
-        out_specs=P(axis_name, None, None),
+        in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_t),
+        out_specs=spec_qkv,
         check_rep=False,
     )(q, k, v, segment_ids)

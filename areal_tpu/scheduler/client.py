@@ -305,22 +305,32 @@ class SlurmSchedulerClient(SchedulerClient):
         names = [n for n in self._job_ids if pat.match(n)]
         if not names:
             return []
-        ids = ",".join(self._job_ids[n] for n in names)
+        terminal = (JobState.COMPLETED, JobState.FAILED, JobState.CANCELLED)
+        # jobs already seen terminal are purged from the controller — ONE
+        # stale id in the comma list fails the whole squeue call and would
+        # degrade every poll to per-job fallbacks, so keep them out
+        live = [
+            n for n in names if self._last_state.get(n) not in terminal
+        ]
         by_id: Dict[str, tuple] = {}
-        try:
-            out = subprocess.check_output(
-                ["squeue", "-j", ids, "-h", "-o", "%i|%T|%N"], text=True,
-                stderr=subprocess.DEVNULL,
-            )
-            for line in out.splitlines():
-                jid, st, node = (line.strip().split("|") + [None])[:3]
-                by_id[jid] = (st, node)
-        except subprocess.CalledProcessError:
-            pass  # fall through to per-job sacct below
+        if live:
+            ids = ",".join(self._job_ids[n] for n in live)
+            try:
+                out = subprocess.check_output(
+                    ["squeue", "-j", ids, "-h", "-o", "%i|%T|%N"], text=True,
+                    stderr=subprocess.DEVNULL,
+                )
+                for line in out.splitlines():
+                    jid, st, node = (line.strip().split("|") + [None])[:3]
+                    by_id[jid] = (st, node)
+            except subprocess.CalledProcessError:
+                pass  # fall through to per-job sacct below
         infos = []
         for n in names:
             jid = self._job_ids[n]
-            if jid in by_id:
+            if self._last_state.get(n) in terminal:
+                infos.append(JobInfo(name=n, state=self._last_state[n], slurm_id=jid))
+            elif jid in by_id:
                 st, node = by_id[jid]
                 state = _SLURM_STATES.get(st, JobState.PENDING)
                 self._last_state[n] = state

@@ -54,7 +54,15 @@ def _round_up(x: int, m: int) -> int:
 
 def plan_rows(lengths: Sequence[int], n_rows: int) -> List[int]:
     """LPT greedy: assign each length (desc order) to the least-loaded row.
-    Returns a row index per input. Deterministic."""
+    Returns a row index per input. Deterministic, and bit-identical between
+    the native and Python implementations (same stable order + row-index
+    tie-break)."""
+    from areal_tpu import native
+
+    if native.available() and len(lengths) > 0:
+        return native.plan_rows_lpt(
+            np.asarray(lengths, np.int64), n_rows
+        ).tolist()
     order = sorted(range(len(lengths)), key=lambda i: -lengths[i])
     loads = [0] * n_rows
     rows = [0] * len(lengths)
@@ -97,16 +105,31 @@ def pack_sequences(
             f"Packed row load {max_load} exceeds capacity {capacity}"
         )
 
+    from areal_tpu import native
+
+    use_native = native.available() and placements
+    p_rows = np.asarray([p.row for p in placements], np.int64)
+    p_starts = np.asarray([p.start for p in placements], np.int64)
+    p_lengths = np.asarray([p.length for p in placements], np.int64)
+
     arrays: Dict[str, np.ndarray] = {
         "segment_ids": np.zeros((n_rows, capacity), np.int32),
         "positions": np.zeros((n_rows, capacity), np.int32),
         "item_ids": np.zeros((n_rows, capacity), np.int32),
     }
-    for p in placements:
-        sl = (p.row, slice(p.start, p.start + p.length))
-        arrays["segment_ids"][sl] = p.segment
-        arrays["positions"][sl] = np.arange(p.length)
-        arrays["item_ids"][sl] = p.item_idx
+    if use_native:
+        native.pack_meta(
+            arrays["segment_ids"], arrays["positions"], arrays["item_ids"],
+            p_rows, p_starts, p_lengths,
+            np.asarray([p.segment for p in placements], np.int64),
+            np.asarray([p.item_idx for p in placements], np.int64),
+        )
+    else:
+        for p in placements:
+            sl = (p.row, slice(p.start, p.start + p.length))
+            arrays["segment_ids"][sl] = p.segment
+            arrays["positions"][sl] = np.arange(p.length)
+            arrays["item_ids"][sl] = p.item_idx
 
     main_offsets = sample._offsets(main_key)
     main_inner = sample.seqlens[main_key]
@@ -119,29 +142,52 @@ def pack_sequences(
         offsets = sample._offsets(key)
         trailing = data.shape[1:]
         buf = np.zeros((n_rows, capacity) + trailing, data.dtype)
-        for p in placements:
+        # classify the key's alignment (per placement; raises on mismatch)
+        src_pos = np.empty(len(placements), np.int64)
+        kind = None  # "aligned" | "seq_scalar" | "item_scalar" | mixed=None
+        for j, p in enumerate(placements):
             item_lens = inner[p.item_idx]
             item_off = offsets[p.item_idx]
-            sl = (p.row, slice(p.start, p.start + p.length))
             if len(item_lens) == len(main_inner[p.item_idx]) and item_lens[
                 p.seq_idx
             ] == p.length:
-                # token-aligned: same layout as the main key
-                off = item_off + sum(item_lens[: p.seq_idx])
-                buf[sl] = data[off : off + p.length]
+                k = "aligned"
+                src_pos[j] = item_off + sum(item_lens[: p.seq_idx])
             elif all(l == 1 for l in item_lens) and len(item_lens) == len(
                 main_inner[p.item_idx]
             ):
-                # one scalar per sequence: broadcast over the segment
-                buf[sl] = data[item_off + p.seq_idx]
+                k = "seq_scalar"
+                src_pos[j] = item_off + p.seq_idx
             elif item_lens == [1]:
-                # one scalar per item: broadcast over every seq of the item
-                buf[sl] = data[item_off]
+                k = "item_scalar"
+                src_pos[j] = item_off
             else:
                 raise ValueError(
                     f"Key {key!r}: cannot align seqlens {item_lens} with main "
                     f"key {main_inner[p.item_idx]}"
                 )
+            kind = k if (kind in (None, k)) else "mixed"
+        if use_native and kind == "aligned":
+            native.pack_copy(
+                buf, np.ascontiguousarray(data), p_rows, p_starts, p_lengths,
+                src_pos,
+            )
+        elif use_native and kind in ("seq_scalar", "item_scalar"):
+            native.pack_broadcast(
+                buf, np.ascontiguousarray(data), p_rows, p_starts, p_lengths,
+                src_pos,
+            )
+        else:  # numpy fallback (also the rare mixed-alignment case)
+            for j, p in enumerate(placements):
+                sl = (p.row, slice(p.start, p.start + p.length))
+                item_lens = inner[p.item_idx]
+                if (
+                    len(item_lens) == len(main_inner[p.item_idx])
+                    and item_lens[p.seq_idx] == p.length
+                ):
+                    buf[sl] = data[src_pos[j] : src_pos[j] + p.length]
+                else:
+                    buf[sl] = data[src_pos[j]]
         name = "input_ids" if key == main_key else key
         arrays[name] = buf
     return PackedBatch(

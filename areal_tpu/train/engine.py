@@ -71,11 +71,16 @@ def vmapped_forward(
 ):
     """Model forward over ``[D, T]`` packed buffers -> ``[D, T, vocab|1]``.
     With ``with_aux``, returns ``(out, aux)`` where aux is the mean MoE
-    router loss across rows (0 for dense models)."""
+    router loss across rows (0 for dense models).
+
+    ``spmd_axis_name`` tells any shard_map inside (the context-parallel
+    attention ring) that the vmapped row axis lives on the data axes —
+    without it the ring would silently all-gather rows/heads every layer."""
     out = jax.vmap(
         lambda ids, seg, pos: tfm.forward_packed(
             params, cfg, ids, seg, pos, with_aux=with_aux
-        )
+        ),
+        spmd_axis_name=("data", "fsdp"),
     )(arrays["input_ids"], arrays["segment_ids"], arrays["positions"])
     if with_aux:
         logits, aux = out

@@ -171,3 +171,36 @@ class TestContextParallelTraining:
         assert (p.data, p.fsdp, p.ctx, p.model) == (2, 2, 2, 1)
         assert p.world_size == 8
         assert ParallelConfig.from_str("d2m2").ctx == 1
+
+
+def test_ring_preserves_data_and_model_sharding(rng):
+    """Review regression: under vmap with spmd_axis_name, the ring must not
+    all-gather rows/heads — the output keeps the data-axis sharding and the
+    compiled program contains zero all-gathers."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("data", "ctx", "model"))
+    R, T, H, Hkv, D = 2, 64, 4, 2, 8
+    q = jax.device_put(
+        jnp.asarray(rng.normal(size=(R, T, H, D)).astype(np.float32)),
+        NamedSharding(mesh, P("data", "ctx", None, None)),
+    )
+    k = jax.device_put(
+        jnp.asarray(rng.normal(size=(R, T, Hkv, D)).astype(np.float32)),
+        NamedSharding(mesh, P("data", "ctx", None, None)),
+    )
+    seg = jax.device_put(
+        jnp.asarray(np.ones((R, T), np.int32)),
+        NamedSharding(mesh, P("data", "ctx")),
+    )
+
+    f = jax.jit(jax.vmap(
+        lambda q, k, v, s: ring_attention(q, k, v, s, mesh, block_k=32),
+        spmd_axis_name="data",
+    ))
+    out = f(q, k, k, seg)
+    assert out.sharding.spec[0] == "data", out.sharding.spec
+    hlo = f.lower(q, k, k, seg).compile().as_text()
+    assert "all-gather" not in hlo
