@@ -83,6 +83,23 @@ class ModelConfig:
     # where pallas only runs interpreted); True/False force it.
     use_flash_attention: Optional[bool] = None
 
+    # Layer-stack execution: 1 = lax.scan over stacked layers (one trace,
+    # fast compiles — the default); an int N or True unrolls the scan (full
+    # unroll removes the per-layer dynamic-update-slice bookkeeping XLA
+    # emits for scan carries/residuals — measured ~20% step-time win on a
+    # 12-layer model at 4k tokens — at the cost of layer-count-proportional
+    # compile time; prefer it for models up to a few dozen layers).
+    layer_scan_unroll: int = 1
+
+    # Rematerialization policy for the training backward pass:
+    #   "full" — checkpoint whole layers (max memory savings, ~1/3 extra
+    #            FLOPs; the 32k-context default),
+    #   "dots" — save matmul outputs, recompute elementwise (small memory
+    #            cost, near-zero recompute on MXU),
+    #   "none" — save everything (fastest when activations fit HBM; right
+    #            for small models / short contexts).
+    remat_policy: str = "full"
+
     def flash_enabled(self) -> bool:
         if self.use_flash_attention is None:
             import jax

@@ -347,9 +347,20 @@ def forward_packed(
         x = x + m
         return x, aux
 
-    if remat:
+    policy = cfg.remat_policy if remat else "none"
+    if policy == "full":
         layer = jax.checkpoint(layer, prevent_cse=False)
-    x, auxes = jax.lax.scan(layer, x, params["layers"])
+    elif policy == "dots":
+        layer = jax.checkpoint(
+            layer,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            prevent_cse=False,
+        )
+    elif policy != "none":
+        raise ValueError(f"unknown remat_policy {policy!r}")
+    x, auxes = jax.lax.scan(
+        layer, x, params["layers"], unroll=cfg.layer_scan_unroll or 1
+    )
     x = _norm(cfg, _cast(cfg, params["final_ln"]), x)
     out = _head(cfg, params, x)
     if with_aux:

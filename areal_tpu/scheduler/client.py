@@ -250,6 +250,9 @@ class SlurmSchedulerClient(SchedulerClient):
         sbatch = self.build_sbatch_cmd(worker_type, cmd, **kwargs)
         job_id = subprocess.check_output(sbatch, text=True).strip().split(";")[0]
         self._job_ids[worker_type] = job_id
+        # resubmission under the same name: the old terminal state must not
+        # mask the fresh job in find_all's cache branch
+        self._last_state.pop(worker_type, None)
         logger.info("slurm job %s: id %s", worker_type, job_id)
         return job_id
 

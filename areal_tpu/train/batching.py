@@ -59,6 +59,8 @@ def plan_rows(lengths: Sequence[int], n_rows: int) -> List[int]:
     tie-break)."""
     from areal_tpu import native
 
+    if n_rows <= 0:
+        raise ValueError(f"n_rows must be positive, got {n_rows}")
     if native.available() and len(lengths) > 0:
         return native.plan_rows_lpt(
             np.asarray(lengths, np.int64), n_rows
@@ -144,6 +146,7 @@ def pack_sequences(
         buf = np.zeros((n_rows, capacity) + trailing, data.dtype)
         # classify the key's alignment (per placement; raises on mismatch)
         src_pos = np.empty(len(placements), np.int64)
+        kinds: List[str] = []
         kind = None  # "aligned" | "seq_scalar" | "item_scalar" | mixed=None
         for j, p in enumerate(placements):
             item_lens = inner[p.item_idx]
@@ -166,6 +169,7 @@ def pack_sequences(
                     f"Key {key!r}: cannot align seqlens {item_lens} with main "
                     f"key {main_inner[p.item_idx]}"
                 )
+            kinds.append(k)
             kind = k if (kind in (None, k)) else "mixed"
         if use_native and kind == "aligned":
             native.pack_copy(
@@ -180,11 +184,7 @@ def pack_sequences(
         else:  # numpy fallback (also the rare mixed-alignment case)
             for j, p in enumerate(placements):
                 sl = (p.row, slice(p.start, p.start + p.length))
-                item_lens = inner[p.item_idx]
-                if (
-                    len(item_lens) == len(main_inner[p.item_idx])
-                    and item_lens[p.seq_idx] == p.length
-                ):
+                if kinds[j] == "aligned":
                     buf[sl] = data[src_pos[j] : src_pos[j] + p.length]
                 else:
                     buf[sl] = data[src_pos[j]]
