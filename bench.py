@@ -120,6 +120,17 @@ def main():
     except Exception as e:  # keep the primary metric even if a shape OOMs
         detail["ctx8k"] = {"error": repr(e)[:200]}
     try:
+        # the 32k-context protocol shape (benchmark README): one long
+        # sequence through the flash kernels, matmul-saving remat
+        import dataclasses as _dc
+
+        cfg_32k = _dc.replace(
+            cfg_small, remat_policy="dots", layer_scan_unroll=1
+        )
+        detail["ctx32k"] = _bench_shape(cfg_32k, [32768], n_steps=4, peak=peak)
+    except Exception as e:
+        detail["ctx32k"] = {"error": repr(e)[:200]}
+    try:
         detail["b1"] = _bench_shape(
             cfg_1b, [512] * 8, n_steps=8, peak=peak, param_dtype="bfloat16"
         )
