@@ -34,3 +34,22 @@ def maybe_trace(tag: str):
     os.makedirs(d, exist_ok=True)
     with jax.profiler.trace(d):
         yield
+
+
+def trace_step() -> int:
+    """Which training step the trainers dump (tracing every step would grow
+    unboundedly; the reference profiles a fixed early step the same way)."""
+    return int(os.environ.get("AREAL_TRACE_STEP", "3"))
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region inside an active trace (per-MFC attribution in the
+    executor; free when no trace is being collected)."""
+    if not trace_enabled():
+        yield
+        return
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

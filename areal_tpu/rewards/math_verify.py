@@ -2,12 +2,14 @@
 
 Counterpart of the reference's ``realhf/impl/dataset/math_parser.py`` (875
 LoC, latex2sympy-based): extract the final answer from a generated solution
-(``\\boxed{...}`` or the last number) and test equivalence against the ground
-truth via, in order: normalized string match, numeric comparison, sympy
-symbolic difference. Deliberately dependency-light — the heavy latex parsing
-of the reference's vendored latex2sympy is out of scope for parity
-(SURVEY.md §2.6); the remote sandbox (``areal_tpu.rewards.remote``) covers
-the hard cases in production.
+(``\\boxed{...}`` or the last number) and test equivalence against the
+ground truth via, in order: normalized string match, numeric comparison
+(with a LaTeX→expression translation layer covering fractions, roots, pi,
+mixed numbers, percentages, scientific notation), element-wise tuple/set
+comparison for multi-part answers, and sympy symbolic/numeric difference.
+Dependency-light by design — the reference's vendored latex2sympy is
+replaced by the targeted rewrite rules below; the remote sandbox
+(``areal_tpu.rewards.remote``) covers anything beyond them in production.
 """
 
 import re
@@ -50,31 +52,114 @@ def extract_answer(text: str) -> Optional[str]:
 
 def _normalize(s: str) -> str:
     s = s.strip()
-    for tok in ("\\left", "\\right", "\\,", "\\;", "\\!", "$", " ", "\\%", "%"):
+    # \text{...} / \mathrm{...} wrappers (units, labels) vanish
+    s = re.sub(r"\\(?:text|mathrm|mbox|textbf)\{[^{}]*\}", "", s)
+    for tok in ("\\left", "\\right", "\\,", "\\;", "\\!", "\\ ", "$", " ",
+                "^{\\circ}", "^\\circ", "\\circ"):
         s = s.replace(tok, "")
     s = s.replace("\\dfrac", "\\frac").replace("\\tfrac", "\\frac")
+    s = s.replace("\\{", "{").replace("\\}", "}")  # literal set braces
     s = s.rstrip(".").strip("{}") if s.count("{") != s.count("}") else s.rstrip(".")
     return s
 
 
-def _to_number(s: str) -> Optional[float]:
+# percentage handled separately so 50% == 0.5 can be tested both ways
+def _strip_percent(s: str):
+    s2 = s.replace("\\%", "").replace("%", "")
+    return s2, s2 != s
+
+
+def _latex_to_expr(s: str) -> str:
+    """Targeted LaTeX -> python-expression rewrites (the working set of
+    ``math_parser.py``'s latex2sympy usage, without the vendored parser)."""
     s = _normalize(s)
-    frac = re.fullmatch(r"\\frac\{(-?[\d\.]+)\}\{(-?[\d\.]+)\}", s)
-    if frac:
-        try:
-            return float(frac.group(1)) / float(frac.group(2))
-        except (ValueError, ZeroDivisionError):
-            return None
-    simple = re.fullmatch(r"(-?[\d\.]+)/(-?[\d\.]+)", s)
-    if simple:
-        try:
-            return float(simple.group(1)) / float(simple.group(2))
-        except (ValueError, ZeroDivisionError):
-            return None
-    try:
-        return float(s)
-    except ValueError:
+    s, _ = _strip_percent(s)
+    # mixed numbers: 1\frac{1}{2} -> (1+(1)/(2))
+    s = re.sub(
+        r"(?<![\w}])(\d+)\\frac\{([^{}]+)\}\{([^{}]+)\}",
+        r"(\1+(\2)/(\3))", s,
+    )
+    # roots FIRST: \frac's brace-free-argument loop below must see
+    # sqrt(...) not \sqrt{...}, or \frac{\sqrt{3}}{2} never translates
+    s = re.sub(r"\\sqrt\[([^\]]+)\]\{([^{}]*)\}", r"((\2)**(1/(\1)))", s)
+    prev = None
+    while prev != s:
+        prev = s
+        s = re.sub(r"\\sqrt\{([^{}]*)\}", r"sqrt(\1)", s)
+    s = re.sub(r"\\sqrt(\d+)", r"sqrt(\1)", s)
+    # \frac{a}{b} -> ((a)/(b)), innermost-first for nesting
+    prev = None
+    while prev != s:
+        prev = s
+        s = re.sub(r"\\frac\{([^{}]*)\}\{([^{}]*)\}", r"((\1)/(\2))", s)
+    s = (
+        s.replace("\\pi", "pi")
+        .replace("\\cdot", "*")
+        .replace("\\times", "*")
+        .replace("\\div", "/")
+        .replace("\\infty", "oo")
+    )
+    # exponents: ^{...} -> **(...); ^x -> **x
+    s = re.sub(r"\^\{([^{}]*)\}", r"**(\1)", s)
+    s = s.replace("^", "**")
+    # thousands separators only in properly-grouped numbers ('1,234' yes;
+    # '1,2' is a two-part answer, not twelve)
+    if re.fullmatch(r"-?\d{1,3}(?:,\d{3})+(?:\.\d+)?", s):
+        s = s.replace(",", "")
+    return s
+
+
+def _to_number(s: str) -> Optional[float]:
+    """Numeric value of an answer via the LaTeX translation + sympy evalf
+    (covers fractions, roots, pi, mixed numbers, scientific notation)."""
+    expr = _latex_to_expr(s)
+    if expr == "":
         return None
+    try:
+        return float(expr)
+    except ValueError:
+        pass
+    if not re.fullmatch(r"[\d\s\.\+\-\*/\(\)eE]*|.*(?:sqrt|pi|oo).*", expr):
+        return None
+    if _degenerate(expr):
+        return None
+    try:
+        import sympy
+
+        val = sympy.sympify(expr, rational=False).evalf()
+        if val.is_real is False or val.has(sympy.zoo, sympy.nan):
+            return None
+        return float(val)
+    except Exception:  # noqa: BLE001 — unparseable => no numeric value
+        return None
+
+
+def _degenerate(expr: str) -> bool:
+    """Model-controlled input: refuse expressions sympy would eagerly blow
+    up on (2**999999999 stalls/OOMs the reward worker)."""
+    return len(expr) > 128 or bool(re.search(r"\*\*\s*\(?\s*-?\d{5,}", expr))
+
+
+def _split_parts(s: str) -> Optional[List[str]]:
+    """Top-level comma split for tuples/sets '(a, b)' / '{a, b}' / 'a, b'."""
+    s = _normalize(s)
+    wrapped = s[:1] in "({[" and s[-1:] in ")}]"
+    inner = s[1:-1] if wrapped else s
+    parts, depth, cur = [], 0, []
+    for ch in inner:
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    if len(parts) < 2:
+        return None
+    return [p.strip() for p in parts]
 
 
 def _sympy_equal(a: str, b: str) -> bool:
@@ -86,21 +171,53 @@ def _sympy_equal(a: str, b: str) -> bool:
             standard_transformations,
         )
 
+        xa, xb = _latex_to_expr(a), _latex_to_expr(b)
+        if _degenerate(xa) or _degenerate(xb):
+            return False
         tf = standard_transformations + (implicit_multiplication_application,)
-        ea = parse_expr(_normalize(a).replace("^", "**"), transformations=tf)
-        eb = parse_expr(_normalize(b).replace("^", "**"), transformations=tf)
-        return bool(sympy.simplify(ea - eb) == 0)
+        ea = parse_expr(xa, transformations=tf)
+        eb = parse_expr(xb, transformations=tf)
+        if bool(sympy.simplify(ea - eb) == 0):
+            return True
+        # numeric fallback: symbolic simplify can miss radical identities
+        diff = (ea - eb).evalf()
+        return diff.is_number and abs(float(diff)) < 1e-9
     except Exception:  # noqa: BLE001 — unparseable => not equal
         return False
 
 
-def answers_equal(given: str, truth: str) -> bool:
+def answers_equal(given: str, truth: str, _depth: int = 0) -> bool:
     ng, nt = _normalize(given), _normalize(truth)
     if ng == nt and ng != "":
         return True
     fg, ft = _to_number(given), _to_number(truth)
     if fg is not None and ft is not None:
-        return abs(fg - ft) < 1e-6 * max(1.0, abs(ft))
+        if abs(fg - ft) < 1e-6 * max(1.0, abs(ft)):
+            return True
+        # percentage tolerance: "50%" == 0.5 (either side carries the %)
+        _, gp = _strip_percent(ng)
+        _, tp = _strip_percent(nt)
+        if gp != tp:
+            scaled = fg / 100.0 if gp else fg * 100.0
+            if abs(scaled - ft) < 1e-6 * max(1.0, abs(ft)):
+                return True
+    # multi-part answers: tuples compare in order, {...} sets any order
+    if _depth == 0:
+        pg, pt = _split_parts(given), _split_parts(truth)
+        if pg is not None and pt is not None and len(pg) == len(pt):
+            if ng[:1] == "{" and nt[:1] == "{":
+                used = set()
+                for g in pg:
+                    hit = next(
+                        (i for i, t in enumerate(pt)
+                         if i not in used and answers_equal(g, t, 1)),
+                        None,
+                    )
+                    if hit is None:
+                        return False
+                    used.add(hit)
+                return True
+            return all(answers_equal(g, t, 1) for g, t in zip(pg, pt))
     return _sympy_equal(given, truth)
 
 

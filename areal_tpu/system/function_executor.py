@@ -23,6 +23,7 @@ import jax
 from areal_tpu.api.data import MicroBatchSpec, SequenceSample
 from areal_tpu.api.dfg import DataFlowGraph, MFCDef, ParamReallocHook
 from areal_tpu.api.model import ModelInterface, make_interface
+from areal_tpu.base import tracing
 
 logger = logging.getLogger("areal_tpu.function_executor")
 
@@ -105,27 +106,28 @@ class FunctionExecutor:
                 for h in mfc.pre_hooks:
                     self._apply_hook(h, mfc)
                 sub = sample.select(mfc.input_keys) if mfc.input_keys else sample
-                if mfc.interface_type == "train_step":
-                    out = iface.train_step(engine, sub, mb_spec)
-                    stats.update(out)
-                    total_flops += flops_mod.train_flops(
-                        engine.cfg, n_tokens, seqlens
-                    )
-                else:  # inference | generate
-                    fn = getattr(iface, mfc.interface_type)
-                    out = fn(engine, sub, mb_spec)
-                    if out is not None:
-                        out.remap_keys_(mfc.output_key_remap)
-                        missing = set(mfc.output_keys) - set(out.keys)
-                        if missing:
-                            raise ValueError(
-                                f"MFC {mfc.name!r} declared outputs {missing} "
-                                f"it did not produce (got {sorted(out.keys)})"
-                            )
-                        sample.update_(out.select(mfc.output_keys) if mfc.output_keys else out)
-                    total_flops += flops_mod.forward_flops(
-                        engine.cfg, n_tokens, seqlens
-                    )
+                with tracing.annotate(f"mfc:{mfc.name}"):
+                    if mfc.interface_type == "train_step":
+                        out = iface.train_step(engine, sub, mb_spec)
+                        stats.update(out)
+                        total_flops += flops_mod.train_flops(
+                            engine.cfg, n_tokens, seqlens
+                        )
+                    else:  # inference | generate
+                        fn = getattr(iface, mfc.interface_type)
+                        out = fn(engine, sub, mb_spec)
+                        if out is not None:
+                            out.remap_keys_(mfc.output_key_remap)
+                            missing = set(mfc.output_keys) - set(out.keys)
+                            if missing:
+                                raise ValueError(
+                                    f"MFC {mfc.name!r} declared outputs {missing} "
+                                    f"it did not produce (got {sorted(out.keys)})"
+                                )
+                            sample.update_(out.select(mfc.output_keys) if mfc.output_keys else out)
+                        total_flops += flops_mod.forward_flops(
+                            engine.cfg, n_tokens, seqlens
+                        )
                 for h in mfc.post_hooks:
                     self._apply_hook(h, mfc)
         stats["flops"] = total_flops

@@ -32,7 +32,7 @@ from areal_tpu.api.model import PPOHyperparameters, make_interface
 from areal_tpu.experiments import graphs
 from areal_tpu.system.buffer import SequenceBuffer
 from areal_tpu.system.function_executor import FunctionExecutor
-from areal_tpu.base import constants, name_resolve, names, recover
+from areal_tpu.base import constants, name_resolve, names, recover, tracing
 from areal_tpu.base.metrics import MetricLogger
 from areal_tpu.base.timeutil import EpochStepTimeFreqCtl
 from areal_tpu.parallel import multihost
@@ -233,7 +233,14 @@ class AsyncPPOTrainerWorker:
         if sample is None:
             return None
         t0 = time.perf_counter()
-        stats = self.train_step(sample)
+        # AREAL_DUMP_TRACE=1 dumps ONE profiled step (AREAL_TRACE_STEP) with
+        # per-MFC TraceAnnotations from the executor
+        # (≈ realhf/system/model_worker.py:79-94 torch-profiler gating)
+        if tracing.trace_enabled() and self.step == tracing.trace_step():
+            with tracing.maybe_trace(f"ppo_step{self.step}"):
+                stats = self.train_step(sample)
+        else:
+            stats = self.train_step(sample)
         stats["timeperf/e2e"] = time.perf_counter() - t0
         if "flops" in stats:  # per-step throughput line (≈ flops_counter)
             stats["tflops_per_sec"] = (

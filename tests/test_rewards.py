@@ -57,3 +57,58 @@ def test_code_verify_timeout():
     gen = "```python\nwhile True: pass\n```"
     io = {"inputs": ["1\n"], "outputs": ["1\n"]}
     assert not code_verify.verify_code_solution(gen, io, timeout=1.0)
+
+
+@pytest.mark.parametrize("a,b,eq", [
+    # latex fractions / nesting / mixed numbers
+    (r"\frac{3}{4}", "0.75", True),
+    (r"\dfrac{1}{\frac{1}{2}}", "2", True),
+    (r"1\frac{1}{2}", "1.5", True),
+    (r"\frac{3}{4}", "0.8", False),
+    # roots and pi
+    (r"\sqrt{16}", "4", True),
+    (r"\sqrt[3]{27}", "3", True),
+    (r"2\pi", "6.283185307", True),
+    (r"\sqrt{8}", r"2\sqrt{2}", True),
+    # percentages both directions
+    (r"50\%", "0.5", True),
+    ("0.5", "50%", True),
+    ("50%", "0.4", False),
+    # units / text wrappers / degrees
+    (r"12\text{ cm}", "12", True),
+    (r"90^\circ", "90", True),
+    # thousands separators and scientific notation
+    ("1,234", "1234", True),
+    ("3e2", "300", True),
+    # exponents
+    (r"2^{10}", "1024", True),
+    (r"x^2+1", r"1+x^{2}", True),
+    # tuples (ordered) and sets (unordered)
+    ("(1, 2)", r"(1, \frac{4}{2})", True),
+    ("(1, 2)", "(2, 1)", False),
+    (r"\{1, 2\}", r"\{2, 1\}", True),
+    (r"\{1, 3\}", r"\{2, 1\}", False),
+    # negatives / sanity
+    ("-0.25", r"-\frac{1}{4}", True),
+    ("", "", False),
+])
+def test_answers_equal_latex_matrix(a, b, eq):
+    assert math_verify.answers_equal(a, b) == eq, (a, b)
+
+
+@pytest.mark.parametrize("a,b,eq", [
+    (r"\frac{\sqrt{3}}{2}", "0.8660254", True),   # frac with braced command
+    ("1, 2", "12", False),                        # comma pair != twelve
+    (r"90^{\circ}", "90", True),                  # braced degree sign
+])
+def test_answers_equal_review_regressions(a, b, eq):
+    assert math_verify.answers_equal(a, b) == eq
+
+
+def test_degenerate_power_is_fast():
+    """Model-controlled giant exponents must not stall the reward worker."""
+    import time
+
+    t0 = time.time()
+    assert not math_verify.answers_equal(r"2^{999999999}", "5")
+    assert time.time() - t0 < 2.0

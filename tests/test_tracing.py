@@ -1,0 +1,32 @@
+"""Env-gated profiler tracing (≈ the reference's REAL_DUMP_TRACE gating)."""
+
+import glob
+import os
+
+import jax.numpy as jnp
+
+from areal_tpu.base import constants, tracing
+
+
+def test_disabled_is_free(monkeypatch):
+    monkeypatch.delenv(constants.TRACE_ENV, raising=False)
+    assert not tracing.trace_enabled()
+    with tracing.maybe_trace("noop"):
+        pass
+    with tracing.annotate("noop"):
+        pass
+
+
+def test_trace_dumps_profile(monkeypatch, tmp_path):
+    monkeypatch.setenv(constants.TRACE_ENV, "1")
+    monkeypatch.setenv("AREAL_FILEROOT", str(tmp_path))
+    assert tracing.trace_enabled()
+    assert tracing.trace_step() == 3
+    monkeypatch.setenv("AREAL_TRACE_STEP", "7")
+    assert tracing.trace_step() == 7
+    with tracing.maybe_trace("unit"):
+        with tracing.annotate("mfc:actor_train"):
+            jnp.ones((8, 8)).sum().block_until_ready()
+    dumped = glob.glob(str(tmp_path / "traces" / "unit" / "**" / "*"),
+                       recursive=True)
+    assert any(os.path.isfile(f) for f in dumped), dumped
