@@ -200,6 +200,11 @@ class GenerationHTTPServer:
                 "gen_throughput": self._gen_tokens / max(time.time() - self._start, 1e-6),
                 "version": self.engine.version,
                 "max_slots": self.engine.B,
+                # paged KV pool + prefix cache observability
+                "pages_free": self.engine.pool.n_free,
+                "pages_total": self.engine.n_pages,
+                "prefix_entries": len(self.engine.prefix),
+                **{f"engine_{k}": v for k, v in self.engine.stats.items()},
             }
         )
 

@@ -110,7 +110,7 @@ def main():
         remat_policy="none", layer_scan_unroll=20,
     )
 
-    primary = _bench_shape(cfg_small, [512] * 8, n_steps=16, peak=peak)
+    primary = _bench_shape(cfg_small, [512] * 8, n_steps=32, peak=peak)
     detail = {
         "primary": primary,
         "device": str(jax.devices()[0].device_kind),
