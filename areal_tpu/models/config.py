@@ -21,6 +21,14 @@ class MoEConfig:
     z_loss_coeff: float = 0.0
     input_jitter_eps: Optional[float] = None
     norm_topk_prob: bool = True
+    # "dense": every expert for every token (XLA-fused; correct under any
+    # sharding of the expert axis). "ragged": sort-by-expert grouped GEMM via
+    # ``lax.ragged_dot`` (megablox-style) — the TPU fast path when experts are
+    # replicated or fit per-device; GSPMD may all-gather expert weights if the
+    # expert axis is sharded. With nonzero aux coefficients the two modes
+    # optimize slightly different load-balance estimators under the packed
+    # training path (per-row mean vs whole-batch; see ``ops/moe.py``).
+    dispatch: str = "dense"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -173,11 +173,16 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
             mlp["b_fc"] = ("layer", "mlp")
             mlp["b_proj"] = ("layer", "embed")
     else:  # moe
+        # Expert parallelism: the expert dim takes the `model` mesh axis, so
+        # the per-expert F dim must stay unsharded (one mesh axis can map to
+        # at most one dim of a param). Dense dispatch contracts over the
+        # sharded expert dim (one psum); ragged dispatch runs with experts
+        # gathered per device — see ``ops/moe.py``.
         mlp = {
             "router": ("layer", "embed", None),
-            "w_gate": ("layer", "expert", "embed", "mlp"),
-            "w_up": ("layer", "expert", "embed", "mlp"),
-            "w_down": ("layer", "expert", "mlp", "embed"),
+            "w_gate": ("layer", "expert", "embed", None),
+            "w_up": ("layer", "expert", "embed", None),
+            "w_down": ("layer", "expert", None, "embed"),
         }
 
     axes: Params = {

@@ -70,8 +70,11 @@ def vmapped_forward(
     params, cfg: ModelConfig, arrays: Dict[str, jnp.ndarray], with_aux: bool = False
 ):
     """Model forward over ``[D, T]`` packed buffers -> ``[D, T, vocab|1]``.
-    With ``with_aux``, returns ``(out, aux)`` where aux is the mean MoE
-    router loss across rows (0 for dense models).
+    With ``with_aux``, returns ``(out, aux)`` where aux is the MoE router
+    loss (0 for non-MoE models). Estimator depends on the dispatch mode:
+    dense computes per-row losses and this returns their mean; ragged
+    computes one whole-batch loss over all rows' tokens (see ``ops/moe.py``)
+    — numerically different objectives for nonzero aux coefficients.
 
     ``spmd_axis_name`` tells any shard_map inside (the context-parallel
     attention ring) that the vmapped row axis lives on the data axes —

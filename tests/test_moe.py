@@ -1,0 +1,137 @@
+"""MoE dispatch parity: ragged (grouped-GEMM) vs dense.
+
+The dense path is parity-tested against HF Mixtral in
+``test_model_hf_parity.py``; here the ``lax.ragged_dot`` dispatch must match
+the dense formulation in forward outputs, aux loss, and parameter gradients,
+including under a sharded mesh. Counterpart of the reference's token
+dispatcher tests (``realhf/impl/model/modules/moe/token_dispatcher.py``).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.models.config import ModelConfig, MoEConfig
+from areal_tpu.ops import moe as moe_ops
+
+
+def _cfg(dispatch, top_k=2, aux=0.01, z=0.001):
+    return ModelConfig(
+        n_layers=1,
+        n_q_heads=4,
+        n_kv_heads=2,
+        head_dim=8,
+        hidden_dim=16,
+        intermediate_dim=32,
+        vocab_size=64,
+        mlp_type="moe",
+        activation_function="silu",
+        moe=MoEConfig(
+            num_experts=4,
+            top_k=top_k,
+            aux_loss_coeff=aux,
+            z_loss_coeff=z,
+            dispatch=dispatch,
+        ),
+    )
+
+
+def _params(rng, E=16, F=32, X=4):
+    k = iter(jax.random.split(rng, 4))
+    w = lambda shape: jax.random.normal(next(k), shape, jnp.float32) * 0.1
+    return {
+        "router": w((E, X)),
+        "w_gate": w((X, E, F)),
+        "w_up": w((X, E, F)),
+        "w_down": w((X, F, E)),
+    }
+
+
+@pytest.mark.parametrize("top_k", [1, 2, 3])
+def test_ragged_matches_dense_forward(top_k):
+    p = _params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 17, 16), jnp.float32)
+    out_d, aux_d = moe_ops.moe_mlp(_cfg("dense", top_k=top_k), p, x)
+    out_r, aux_r = moe_ops.moe_mlp(_cfg("ragged", top_k=top_k), p, x)
+    np.testing.assert_allclose(out_r, out_d, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(aux_r, aux_d, rtol=2e-5, atol=2e-6)
+
+
+def test_ragged_matches_dense_grads():
+    """Differentiated through a singleton vmap: the framework always
+    differentiates the ragged path under vmap (see ops/moe.py docstring —
+    un-vmapped reverse-mode AD is a known custom_vmap limitation)."""
+    p = _params(jax.random.PRNGKey(2))
+    x = jax.random.normal(jax.random.PRNGKey(3), (1, 29, 16), jnp.float32)
+
+    def loss(params, dispatch):
+        out, aux = jax.vmap(
+            lambda row: moe_ops.moe_mlp(_cfg(dispatch), params, row)
+        )(x)
+        return jnp.sum(out**2) + jnp.mean(aux)
+
+    g_d = jax.grad(loss)(p, "dense")
+    g_r = jax.grad(loss)(p, "ragged")
+    for key in p:
+        np.testing.assert_allclose(
+            g_r[key], g_d[key], rtol=5e-4, atol=5e-5, err_msg=key
+        )
+
+
+def test_ragged_matches_dense_grads_under_vmap():
+    """The train engine differentiates through vmap-over-rows; the ragged
+    custom_vmap fold must produce the same parameter gradients as dense."""
+    p = _params(jax.random.PRNGKey(6))
+    x = jax.random.normal(jax.random.PRNGKey(7), (4, 13, 16), jnp.float32)
+
+    # aux coeffs zeroed: under vmap the ragged fold computes one global aux
+    # over all rows while dense averages per-row auxes — an intentionally
+    # different (whole-batch) estimator; the main path must match exactly.
+    def loss(params, dispatch):
+        out, aux = jax.vmap(
+            lambda row: moe_ops.moe_mlp(
+                _cfg(dispatch, aux=0.0, z=0.0), params, row
+            )
+        )(x)
+        return jnp.sum(out**2) + jnp.mean(aux)
+
+    g_d = jax.jit(jax.grad(loss), static_argnums=1)(p, "dense")
+    g_r = jax.jit(jax.grad(loss), static_argnums=1)(p, "ragged")
+    for key in p:
+        np.testing.assert_allclose(
+            g_r[key], g_d[key], rtol=5e-4, atol=5e-4, err_msg=key
+        )
+
+
+def test_ragged_jits_and_runs_on_mesh():
+    """The grouped-GEMM path must jit (static shapes) and execute under the
+    8-device test mesh with data-sharded inputs and replicated experts."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("data", "model"))
+    p = _params(jax.random.PRNGKey(4))
+    x = jax.random.normal(jax.random.PRNGKey(5), (8, 16, 16), jnp.float32)
+    cfg = _cfg("ragged")
+    with mesh:
+        xs = jax.device_put(x, NamedSharding(mesh, P("data", None, None)))
+        out, aux = jax.jit(lambda pp, xx: moe_ops.moe_mlp(cfg, pp, xx))(p, xs)
+    ref, _ = moe_ops.moe_mlp(_cfg("dense"), p, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_dispatch_is_a_config_switch():
+    cfg = _cfg("dense")
+    assert dataclasses.replace(
+        cfg, moe=dataclasses.replace(cfg.moe, dispatch="ragged")
+    ).moe.dispatch == "ragged"
+
+
+def test_bad_dispatch_value_rejected():
+    p = _params(jax.random.PRNGKey(8))
+    x = jax.random.normal(jax.random.PRNGKey(9), (5, 16), jnp.float32)
+    with pytest.raises(ValueError, match="dispatch"):
+        moe_ops.moe_mlp(_cfg("megablox"), p, x)
