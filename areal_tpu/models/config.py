@@ -104,6 +104,11 @@ class ModelConfig:
     #            FLOPs; the 32k-context default),
     #   "dots" — save matmul outputs, recompute elementwise (small memory
     #            cost, near-zero recompute on MXU),
+    #   "dots_attn" — "dots" for the projections/MLP but the attention
+    #            kernel stays un-rematted (its q/k/v/out/lse residuals are
+    #            saved): a whole-layer checkpoint re-runs the flash forward
+    #            inside the backward, ~25% of a long-context step. Costs
+    #            ~4 packed activations per layer of extra HBM.
     #   "none" — save everything (fastest when activations fit HBM; right
     #            for small models / short contexts).
     remat_policy: str = "full"

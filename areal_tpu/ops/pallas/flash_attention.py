@@ -30,6 +30,19 @@ map output repeats. Inside the band the kernels run unconditionally (the
 token-level mask handles block-edge partials), so skipped steps cost neither
 FLOPs nor HBM traffic.
 
+**Interior-block specialization.** The kernels are VPU-bound, not MXU-bound:
+at D=64 each score element costs ~128 MXU FLOPs but ~10 VPU passes when the
+token-level mask is materialized (two iotas, three compares, two ands, a
+where, the exp). For a 32k causal row all but the ~3% diagonal/segment-edge
+blocks are *interior* — every token pair unmasked — so a per-(q block,
+k block) ``needs_mask`` table (computed in XLA, scalar-prefetched) routes
+each grid step to either the masked body or a mask-free fast body that runs
+just the online-softmax update. Softmax runs in the log2 domain
+(``exp2(s·scale·log2e)``) — one fewer VPU multiply per element than ``exp``,
+matching how Mosaic lowers transcendentals; the emitted ``lse`` stays in
+natural log, so the contract with the backward and with ring attention is
+unchanged.
+
 The backward follows the flash-attention-2 recipe: residuals are
 ``(q, k, v, out, lse)``; ``delta = rowsum(dо * out)`` is computed in XLA
 (cheap elementwise reduce), and ``ds = p * (dp - delta)`` inside the kernel.
@@ -47,6 +60,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -2.3819763e38
 LANES = 128
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
+# Dual-body (masked/interior) kernels pay a small branch overhead per grid
+# step; below this token count boundary blocks dominate any realistic packing
+# and the single masked body wins.
+SPECIALIZE_MIN_T = 8192
 
 
 def _interpret() -> bool:
@@ -100,6 +119,61 @@ def _first_q(ik, block_q, block_k):
     return (ik * block_k) // block_q
 
 
+def _block_needs_mask(segment_ids, block_q, block_k, sliding_window, T):
+    """``[nq*nk] int32``: 0 where the (q block, k block) pair is *interior* —
+    every token pair unmasked (block fully below the causal diagonal, one
+    shared nonzero segment, fully inside any sliding window) — so the
+    kernels skip mask construction entirely; 1 where token-level masking is
+    required. Out-of-band pairs never execute a body, so their value is
+    irrelevant."""
+    nq, nk = T // block_q, T // block_k
+    sq = segment_ids.reshape(nq, block_q)
+    sk = segment_ids.reshape(nk, block_k)
+    q_seg = sq.min(axis=1)
+    q_uni = (q_seg == sq.max(axis=1)) & (q_seg > 0)
+    k_seg = sk.min(axis=1)
+    k_uni = k_seg == sk.max(axis=1)
+    same = q_uni[:, None] & k_uni[None, :] & (q_seg[:, None] == k_seg[None, :])
+    iq = jnp.arange(nq, dtype=jnp.int32)
+    ik = jnp.arange(nk, dtype=jnp.int32)
+    causal = (iq * block_q)[:, None] >= (ik * block_k + block_k - 1)[None, :]
+    interior = same & causal
+    if sliding_window is not None:
+        maxdiff = (iq * block_q + block_q - 1)[:, None] - (ik * block_k)[None, :]
+        interior &= maxdiff < sliding_window
+    return jnp.where(interior, 0, 1).astype(jnp.int32).reshape(-1)
+
+
+def _scores_log2(q_ref, k_ref, scale, soft_cap):
+    """Block scores in the log2 domain: ``(q·kᵀ)·scale·log2e`` (soft-capped
+    in the natural domain first when requested). f32 [bq, bk]."""
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if soft_cap is None:
+        return s * (scale * LOG2E)
+    s = soft_cap * jnp.tanh(s * (scale / soft_cap))
+    return s * LOG2E
+
+
+def _token_mask(seg_q_ref, seg_k_ref, iq, ik, block_q, block_k, sliding_window):
+    """Token-level mask for a boundary block (causal ∧ same segment ∧ not
+    pad ∧ window)."""
+    q_idx = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_idx = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    seg_q = seg_q_ref[0][:, None]
+    seg_k = seg_k_ref[0][None, :]
+    mask = (q_idx >= k_idx) & (seg_q == seg_k) & (seg_q > 0)
+    if sliding_window is not None:
+        mask &= q_idx - k_idx < sliding_window
+    return mask
+
+
 # --------------------------------------------------------------------------- #
 # forward
 # --------------------------------------------------------------------------- #
@@ -107,6 +181,7 @@ def _first_q(ik, block_q, block_k):
 
 def _fwd_kernel(
     kstart_ref,  # [nq] int32 scalar-prefetch
+    needs_ref,   # [nq*nk] int32 scalar-prefetch (see _block_needs_mask)
     seg_q_ref,   # [1, block_q] int32
     seg_k_ref,   # [1, block_k] int32
     q_ref,       # [1, block_q, D]
@@ -114,15 +189,17 @@ def _fwd_kernel(
     v_ref,       # [1, block_k, D]
     o_ref,       # [1, block_q, D]
     lse_ref,     # [1, 1, block_q, 1] f32 (column layout; see _flash_forward)
-    m_scr,       # [block_q, LANES] f32
+    m_scr,       # [block_q, LANES] f32 (running max, log2 domain)
     l_scr,       # [block_q, LANES] f32
     acc_scr,     # [block_q, D] f32
     *,
     scale: float,
     block_q: int,
     block_k: int,
+    nk_blocks: int,
     soft_cap: Optional[float],
     sliding_window: Optional[int],
+    specialize: bool,
 ):
     iq = pl.program_id(1)
     j = pl.program_id(2)
@@ -135,35 +212,22 @@ def _fwd_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    @pl.when(ik <= _last_k(iq, block_q, block_k))
-    def _body():
-        s = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale                                  # [bq, bk] f32
-        if soft_cap is not None:
-            s = soft_cap * jnp.tanh(s / soft_cap)
-        q_idx = iq * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_idx = ik * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        seg_q = seg_q_ref[0][:, None]              # [bq, 1]
-        seg_k = seg_k_ref[0][None, :]              # [1, bk]
-        mask = (q_idx >= k_idx) & (seg_q == seg_k) & (seg_q > 0)
-        if sliding_window is not None:
-            mask &= q_idx - k_idx < sliding_window
-        s = jnp.where(mask, s, NEG_INF)
-
+    def _update(masked: bool):
+        s2 = _scores_log2(q_ref, k_ref, scale, soft_cap)  # [bq, bk] f32
+        if masked:
+            mask = _token_mask(
+                seg_q_ref, seg_k_ref, iq, ik, block_q, block_k, sliding_window
+            )
+            s2 = jnp.where(mask, s2, NEG_INF)
         m_prev = m_scr[:, 0:1]                     # [bq, 1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
-        m_new = jnp.maximum(m_prev, m_cur)
-        # NEG_INF is finite, so exp(s - m_new) is 1 (not 0) on fully-masked
-        # rows — zero masked entries explicitly so pad rows keep l == 0 and
-        # output 0, matching the XLA path.
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)  # [bq, bk]
-        corr = jnp.exp(m_prev - m_new)             # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s2, axis=1, keepdims=True))
+        p = jnp.exp2(s2 - m_new)                   # [bq, bk]
+        if masked:
+            # NEG_INF is finite, so exp2(s2 - m_new) is 1 (not 0) on
+            # fully-masked rows — zero masked entries explicitly so pad rows
+            # keep l == 0 and output 0, matching the XLA path.
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp2(m_prev - m_new)            # [bq, 1]
         l_new = corr * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
@@ -172,14 +236,32 @@ def _fwd_kernel(
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
+    active = ik <= _last_k(iq, block_q, block_k)
+    if specialize:
+        needs = needs_ref[iq * nk_blocks + jnp.minimum(ik, nk_blocks - 1)]
+
+        @pl.when(active & (needs == 1))
+        def _boundary():
+            _update(masked=True)
+
+        @pl.when(active & (needs == 0))
+        def _interior():
+            _update(masked=False)
+
+    else:
+
+        @pl.when(active)
+        def _body():
+            _update(masked=True)
+
     @pl.when(j == nk - 1)
     def _done():
         l = l_scr[:, 0:1]
         safe_l = jnp.where(l > 0.0, l, 1.0)
         o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
-        # logsumexp residual; NEG_INF on fully-masked (pad) rows
+        # natural-log logsumexp residual; NEG_INF on fully-masked (pad) rows
         lse = jnp.where(
-            l > 0.0, m_scr[:, 0:1] + jnp.log(safe_l), NEG_INF
+            l > 0.0, m_scr[:, 0:1] * LN2 + jnp.log(safe_l), NEG_INF
         )                                          # [bq, 1]
         lse_ref[0, 0] = lse
 
@@ -204,11 +286,12 @@ def _flash_forward(
     grid = (H, T // block_q, T // block_k)
     seg2d = segment_ids.reshape(1, T)
     kstart, _ = _band_bounds(segment_ids, block_q, block_k, sliding_window, T)
+    needs = _block_needs_mask(segment_ids, block_q, block_k, sliding_window, T)
 
-    def kmap(h, i, j, kstart, r=n_rep):
+    def kmap(h, i, j, ks, nm, r=n_rep):
         return (
             h // r,
-            jnp.minimum(kstart[i] + j, _last_k(i, block_q, block_k)),
+            jnp.minimum(ks[i] + j, _last_k(i, block_q, block_k)),
             0,
         )
 
@@ -217,31 +300,33 @@ def _flash_forward(
         scale=scale,
         block_q=block_q,
         block_k=block_k,
+        nk_blocks=T // block_k,
         soft_cap=soft_cap,
         sliding_window=sliding_window,
+        specialize=T >= SPECIALIZE_MIN_T,
     )
     out, lse4 = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, block_q), lambda h, i, j, ks: (0, i)),
+                pl.BlockSpec((1, block_q), lambda h, i, j, ks, nm: (0, i)),
                 pl.BlockSpec(
                     (1, block_k),
-                    lambda h, i, j, ks: (
+                    lambda h, i, j, ks, nm: (
                         0,
                         jnp.minimum(ks[i] + j, _last_k(i, block_q, block_k)),
                     ),
                 ),
-                pl.BlockSpec((1, block_q, D), lambda h, i, j, ks: (h, i, 0)),
+                pl.BlockSpec((1, block_q, D), lambda h, i, j, ks, nm: (h, i, 0)),
                 pl.BlockSpec((1, block_k, D), kmap),
                 pl.BlockSpec((1, block_k, D), kmap),
             ],
             out_specs=[
-                pl.BlockSpec((1, block_q, D), lambda h, i, j, ks: (h, i, 0)),
+                pl.BlockSpec((1, block_q, D), lambda h, i, j, ks, nm: (h, i, 0)),
                 pl.BlockSpec(
-                    (1, 1, block_q, 1), lambda h, i, j, ks: (h, i, 0, 0)
+                    (1, 1, block_q, 1), lambda h, i, j, ks, nm: (h, i, 0, 0)
                 ),
             ],
             scratch_shapes=[
@@ -255,7 +340,7 @@ def _flash_forward(
             jax.ShapeDtypeStruct((H, T // block_q, block_q, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(kstart, seg2d, seg2d, q, k, v)
+    )(kstart, needs, seg2d, seg2d, q, k, v)
     return out, lse4.reshape(H, T)
 
 
@@ -267,32 +352,29 @@ def _flash_forward(
 def _recompute_p_ds(
     q_ref, k_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, do_ref, v_ref,
     iq, ik, *, scale, block_q, block_k, soft_cap, sliding_window,
+    masked: bool,
 ):
     """Shared block math for both backward kernels: returns (p, ds_raw) with
-    ds_raw = dL/d(q·kᵀ) BEFORE the `scale` factor (folded in by callers)."""
-    s_raw = jax.lax.dot_general(
-        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale                                      # [bq, bk] f32
+    ds_raw = dL/d(q·kᵀ) BEFORE the `scale` factor (folded in by callers).
+    ``masked=False`` is the interior fast path: no mask construction."""
     if soft_cap is not None:
-        t = jnp.tanh(s_raw / soft_cap)
-        s = soft_cap * t
+        s_dot = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        t = jnp.tanh(s_dot * (scale / soft_cap))
+        s2 = (soft_cap * LOG2E) * t                # log2 domain
     else:
-        s = s_raw
-    q_idx = iq * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
-    )
-    k_idx = ik * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1
-    )
-    seg_q = seg_q_ref[0][:, None]
-    seg_k = seg_k_ref[0][None, :]
-    mask = (q_idx >= k_idx) & (seg_q == seg_k) & (seg_q > 0)
-    if sliding_window is not None:
-        mask &= q_idx - k_idx < sliding_window
-    lse = lse_ref[0, 0]                            # [bq, 1]
-    # pad rows have lse == NEG_INF -> masked out anyway
-    p = jnp.where(mask, jnp.exp(s - lse), 0.0)     # [bq, bk]
+        s2 = _scores_log2(q_ref, k_ref, scale, None)
+    # residual lse is natural-log; clamp the log2 conversion so pad rows
+    # (lse == NEG_INF) don't overflow to -inf and feed exp2 an inf argument
+    lse2 = jnp.maximum(lse_ref[0, 0] * LOG2E, NEG_INF)  # [bq, 1]
+    p = jnp.exp2(s2 - lse2)                        # [bq, bk]
+    if masked:
+        mask = _token_mask(
+            seg_q_ref, seg_k_ref, iq, ik, block_q, block_k, sliding_window
+        )
+        p = jnp.where(mask, p, 0.0)
     dp = jax.lax.dot_general(
         do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -306,11 +388,12 @@ def _recompute_p_ds(
 
 def _dq_kernel(
     kstart_ref,
+    needs_ref,
     seg_q_ref, seg_k_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
     dq_ref,
     dq_scr,     # [block_q, D] f32
     *,
-    scale, block_q, block_k, soft_cap, sliding_window,
+    scale, block_q, block_k, nk_blocks, soft_cap, sliding_window, specialize,
 ):
     iq = pl.program_id(1)
     j = pl.program_id(2)
@@ -321,17 +404,34 @@ def _dq_kernel(
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    @pl.when(ik <= _last_k(iq, block_q, block_k))
-    def _body():
+    def _accum(masked: bool):
         _, ds = _recompute_p_ds(
             q_ref, k_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, do_ref,
             v_ref, iq, ik, scale=scale, block_q=block_q, block_k=block_k,
-            soft_cap=soft_cap, sliding_window=sliding_window,
+            soft_cap=soft_cap, sliding_window=sliding_window, masked=masked,
         )
         dq_scr[...] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    active = ik <= _last_k(iq, block_q, block_k)
+    if specialize:
+        needs = needs_ref[iq * nk_blocks + jnp.minimum(ik, nk_blocks - 1)]
+
+        @pl.when(active & (needs == 1))
+        def _boundary():
+            _accum(masked=True)
+
+        @pl.when(active & (needs == 0))
+        def _interior():
+            _accum(masked=False)
+
+    else:
+
+        @pl.when(active)
+        def _body():
+            _accum(masked=True)
 
     @pl.when(j == nk - 1)
     def _done():
@@ -340,12 +440,14 @@ def _dq_kernel(
 
 def _dkv_kernel(
     qlast_ref,
+    needs_ref,
     seg_q_ref, seg_k_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
     dk_ref, dv_ref,
     dk_scr,     # [block_k, D] f32
     dv_scr,     # [block_k, D] f32
     *,
-    scale, block_q, block_k, soft_cap, sliding_window, n_rep,
+    scale, block_q, block_k, nk_blocks, nq_blocks, soft_cap, sliding_window,
+    specialize, n_rep,
 ):
     # grid: (Hkv, nk, n_rep, nq) — nq innermost; the (hkv, nk) output block
     # stays resident while every grouped q head and q block accumulates.
@@ -360,12 +462,11 @@ def _dkv_kernel(
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    @pl.when(iq <= qlast_ref[ik])
-    def _body():
+    def _accum(masked: bool):
         p, ds = _recompute_p_ds(
             q_ref, k_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, do_ref,
             v_ref, iq, ik, scale=scale, block_q=block_q, block_k=block_k,
-            soft_cap=soft_cap, sliding_window=sliding_window,
+            soft_cap=soft_cap, sliding_window=sliding_window, masked=masked,
         )
         # dv += pᵀ @ do ; dk += dsᵀ @ q  (bf16 operands, f32 accumulate)
         dv_scr[...] += jax.lax.dot_general(
@@ -376,6 +477,26 @@ def _dkv_kernel(
             ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    active = iq <= qlast_ref[ik]
+    if specialize:
+        needs = needs_ref[
+            jnp.minimum(iq, nq_blocks - 1) * nk_blocks + ik
+        ]
+
+        @pl.when(active & (needs == 1))
+        def _boundary():
+            _accum(masked=True)
+
+        @pl.when(active & (needs == 0))
+        def _interior():
+            _accum(masked=False)
+
+    else:
+
+        @pl.when(active)
+        def _body():
+            _accum(masked=True)
 
     @pl.when((ir == pl.num_programs(2) - 1) & (jq == nq - 1))
     def _done():
@@ -405,13 +526,15 @@ def _flash_backward(
     kstart, qlast = _band_bounds(
         segment_ids, block_q, block_k, sliding_window, T
     )
+    needs = _block_needs_mask(segment_ids, block_q, block_k, sliding_window, T)
 
     common = dict(
-        scale=scale, block_q=block_q, block_k=block_k, soft_cap=soft_cap,
-        sliding_window=sliding_window,
+        scale=scale, block_q=block_q, block_k=block_k,
+        nk_blocks=T // block_k, soft_cap=soft_cap,
+        sliding_window=sliding_window, specialize=T >= SPECIALIZE_MIN_T,
     )
 
-    def dq_kj(h, i, j, ks, r=n_rep):
+    def dq_kj(h, i, j, ks, nm, r=n_rep):
         return (
             h // r,
             jnp.minimum(ks[i] + j, _last_k(i, block_q, block_k)),
@@ -421,36 +544,36 @@ def _flash_backward(
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, **common),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(H, T // block_q, T // block_k),
             in_specs=[
-                pl.BlockSpec((1, block_q), lambda h, i, j, ks: (0, i)),
+                pl.BlockSpec((1, block_q), lambda h, i, j, ks, nm: (0, i)),
                 pl.BlockSpec(
                     (1, block_k),
-                    lambda h, i, j, ks: (
+                    lambda h, i, j, ks, nm: (
                         0,
                         jnp.minimum(ks[i] + j, _last_k(i, block_q, block_k)),
                     ),
                 ),
                 pl.BlockSpec(
-                    (1, 1, block_q, 1), lambda h, i, j, ks: (h, i, 0, 0)
+                    (1, 1, block_q, 1), lambda h, i, j, ks, nm: (h, i, 0, 0)
                 ),
                 pl.BlockSpec(
-                    (1, 1, block_q, 1), lambda h, i, j, ks: (h, i, 0, 0)
+                    (1, 1, block_q, 1), lambda h, i, j, ks, nm: (h, i, 0, 0)
                 ),
-                pl.BlockSpec((1, block_q, D), lambda h, i, j, ks: (h, i, 0)),
+                pl.BlockSpec((1, block_q, D), lambda h, i, j, ks, nm: (h, i, 0)),
                 pl.BlockSpec((1, block_k, D), dq_kj),
                 pl.BlockSpec((1, block_k, D), dq_kj),
-                pl.BlockSpec((1, block_q, D), lambda h, i, j, ks: (h, i, 0)),
+                pl.BlockSpec((1, block_q, D), lambda h, i, j, ks, nm: (h, i, 0)),
             ],
             out_specs=pl.BlockSpec(
-                (1, block_q, D), lambda h, i, j, ks: (h, i, 0)
+                (1, block_q, D), lambda h, i, j, ks, nm: (h, i, 0)
             ),
             scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((H, T, D), q.dtype),
         interpret=_interpret(),
-    )(kstart, seg2d, seg2d, lse4, delta4, q, k, v, do)
+    )(kstart, needs, seg2d, seg2d, lse4, delta4, q, k, v, do)
 
     def dkv_qi(ql, j, i):
         # clip: qlast can be -1 (all-pad k block); the step is inactive then
@@ -458,32 +581,43 @@ def _flash_backward(
             _first_q(j, block_q, block_k) + i, 0, (T // block_q) - 1
         )
 
-    def qi3(h, j, r, i, ql, nr=n_rep):
+    def qi3(h, j, r, i, ql, nm, nr=n_rep):
         return (h * nr + r, dkv_qi(ql, j, i), 0)
 
-    def qi4(h, j, r, i, ql, nr=n_rep):
+    def qi4(h, j, r, i, ql, nm, nr=n_rep):
         return (h * nr + r, dkv_qi(ql, j, i), 0, 0)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, **common, n_rep=n_rep),
+        functools.partial(
+            _dkv_kernel, **common, nq_blocks=T // block_q, n_rep=n_rep
+        ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(Hkv, T // block_k, n_rep, T // block_q),
             in_specs=[
                 pl.BlockSpec(
-                    (1, block_q), lambda h, j, r, i, ql: (0, dkv_qi(ql, j, i))
+                    (1, block_q),
+                    lambda h, j, r, i, ql, nm: (0, dkv_qi(ql, j, i)),
                 ),
-                pl.BlockSpec((1, block_k), lambda h, j, r, i, ql: (0, j)),
+                pl.BlockSpec((1, block_k), lambda h, j, r, i, ql, nm: (0, j)),
                 pl.BlockSpec((1, 1, block_q, 1), qi4),
                 pl.BlockSpec((1, 1, block_q, 1), qi4),
                 pl.BlockSpec((1, block_q, D), qi3),
-                pl.BlockSpec((1, block_k, D), lambda h, j, r, i, ql: (h, j, 0)),
-                pl.BlockSpec((1, block_k, D), lambda h, j, r, i, ql: (h, j, 0)),
+                pl.BlockSpec(
+                    (1, block_k, D), lambda h, j, r, i, ql, nm: (h, j, 0)
+                ),
+                pl.BlockSpec(
+                    (1, block_k, D), lambda h, j, r, i, ql, nm: (h, j, 0)
+                ),
                 pl.BlockSpec((1, block_q, D), qi3),
             ],
             out_specs=[
-                pl.BlockSpec((1, block_k, D), lambda h, j, r, i, ql: (h, j, 0)),
-                pl.BlockSpec((1, block_k, D), lambda h, j, r, i, ql: (h, j, 0)),
+                pl.BlockSpec(
+                    (1, block_k, D), lambda h, j, r, i, ql, nm: (h, j, 0)
+                ),
+                pl.BlockSpec(
+                    (1, block_k, D), lambda h, j, r, i, ql, nm: (h, j, 0)
+                ),
             ],
             scratch_shapes=[
                 pltpu.VMEM((block_k, D), jnp.float32),
@@ -495,7 +629,7 @@ def _flash_backward(
             jax.ShapeDtypeStruct((Hkv, T, D), v.dtype),
         ],
         interpret=_interpret(),
-    )(qlast, seg2d, seg2d, lse4, delta4, q, k, v, do)
+    )(qlast, needs, seg2d, seg2d, lse4, delta4, q, k, v, do)
     return dq, dk, dv
 
 

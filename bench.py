@@ -125,7 +125,7 @@ def main():
         import dataclasses as _dc
 
         cfg_32k = _dc.replace(
-            cfg_small, remat_policy="dots", layer_scan_unroll=1
+            cfg_small, remat_policy="dots_attn", layer_scan_unroll=1
         )
         detail["ctx32k"] = _bench_shape(cfg_32k, [32768], n_steps=4, peak=peak)
     except Exception as e:

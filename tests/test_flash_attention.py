@@ -124,3 +124,47 @@ def test_flash_bwd_matches_xla_multiblock(rng, kwargs):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4
         )
+
+
+def test_flash_specialized_path_matches_xla(rng, monkeypatch):
+    """Force the interior/boundary dual-body kernels (normally gated on
+    T >= SPECIALIZE_MIN_T) at a test-sized T: fwd and bwd must match XLA,
+    including blocks that are fully interior (one long segment spanning
+    many blocks) and boundary blocks (segment edges, padding)."""
+    from areal_tpu.ops.pallas import flash_attention as fa
+
+    monkeypatch.setattr(fa, "SPECIALIZE_MIN_T", 0)
+    T, H, Hkv, D = 512, 4, 2, 16
+    # one long segment (interior blocks at block_size=64) + short ones + pad
+    q, k, v, seg = _mk(rng, T, H, Hkv, D, [320, 64, 100])
+    scale = D**-0.5
+
+    ref = _attention_xla(q, k, v, seg, scale)
+    got = fa.packed_flash_attention(
+        q, k, v, seg, softmax_scale=scale, block_size=64
+    )
+    valid = (np.asarray(seg) > 0)[:, None, None]
+    np.testing.assert_allclose(
+        np.asarray(got) * valid, np.asarray(ref) * valid, atol=2e-5, rtol=2e-5
+    )
+
+    def loss(attn):
+        def f(q, k, v):
+            o = attn(q, k, v)
+            return jnp.sum(jnp.where((seg > 0)[:, None, None], o * o, 0.0))
+        return f
+
+    g1 = jax.grad(
+        loss(lambda q, k, v: fa.packed_flash_attention(
+            q, k, v, seg, softmax_scale=scale, block_size=64
+        )),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g2 = jax.grad(
+        loss(lambda q, k, v: _attention_xla(q, k, v, seg, scale)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4
+        )

@@ -196,7 +196,7 @@ def test_remat_policy_and_unroll_grad_parity(rng):
         return jax.value_and_grad(f)(params)
 
     ref_l, ref_g = loss(base)
-    for policy in ("full", "dots", "none"):
+    for policy in ("full", "dots", "dots_attn", "none"):
         for unroll in (1, 2):
             cfg = dataclasses.replace(
                 base, remat_policy=policy, layer_scan_unroll=unroll
