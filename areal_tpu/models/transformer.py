@@ -365,12 +365,19 @@ def forward_packed(
         # the backward just to regenerate (out, lse) — ~25% of a long-context
         # step. Here attention residuals (q, k, v, out, lse) are saved
         # (~180 MB/layer at 32k for a 768-wide model) and only the cheap
-        # projection/MLP matmul inputs are recomputed.
-        pre = jax.checkpoint(_pre, policy=dots, prevent_cse=False)
-        post = jax.checkpoint(_post, policy=dots, prevent_cse=False)
+        # projection/MLP matmul inputs are recomputed. The bf16 param cast
+        # stays INSIDE each region — hoisting it would turn every layer's
+        # cast param tree into saved residuals.
+        pre = jax.checkpoint(
+            lambda x, lp: _pre(x, _cast(cfg, lp)),
+            policy=dots, prevent_cse=False,
+        )
+        post = jax.checkpoint(
+            lambda x, ctx, lp: _post(x, ctx, _cast(cfg, lp)),
+            policy=dots, prevent_cse=False,
+        )
 
         def layer(x, lp):
-            lp = _cast(cfg, lp)
             q, k, v = pre(x, lp)
             ctx = _attend(q, k, v)
             return post(x, ctx, lp)

@@ -13,11 +13,14 @@ innermost grid axis:
 
 - **forward**: grid ``(H, nq, nk)``; online-softmax state (m, l, acc) per
   (head, q block); also emits the logsumexp ``lse [H, T]`` for the backward.
-- **dq**: grid ``(H, nq, nk)``; recomputes p from (q, k, lse) per block and
-  accumulates ``dq += ds @ k``.
-- **dkv**: grid ``(Hkv, nk, n_rep, nq)``; for one kv-head k block,
-  accumulates ``dv += pᵀ dо`` and ``dk += dsᵀ q`` over every grouped q head
-  and q block (GQA: no materialized K/V repeat — the group is a grid axis).
+- **bwd (fused)**: grid ``(Hkv, nk, n_rep, nq)``; for one kv-head k block,
+  a single (p, ds) recompute feeds ``dv += pᵀ dо``, ``dk += dsᵀ q`` (per-
+  block VMEM scratch) AND ``dq += ds k`` (whole-group ``[n_rep, T, D]`` f32
+  VMEM scratch, flushed once per kv head) — 5 dots + 1 exp per block pair
+  instead of the 7 + 2 of separate dq/dkv sweeps. Falls back to the separate
+  ``_dq_kernel``/``_dkv_kernel`` sweeps when the dq scratch exceeds
+  ``FUSED_BWD_MAX_DQ_BYTES``. GQA never materializes a K/V repeat: the group
+  is a grid axis.
 
 **Band-limited iteration.** Packed rows carry non-decreasing segment ids
 (padding 0 at the tail), so the only (q block, k block) pairs with any
@@ -66,6 +69,10 @@ LN2 = 0.6931471805599453
 # step; below this token count boundary blocks dominate any realistic packing
 # and the single masked body wins.
 SPECIALIZE_MIN_T = 8192
+# Fused-backward dq scratch + output block budget (v5e has 128 MB VMEM; the
+# rest of the kernel needs ~30 MB at block 1024). Above this the backward
+# falls back to separate dq/dkv sweeps.
+FUSED_BWD_MAX_DQ_BYTES = 48 * 2**20
 
 
 def _interpret() -> bool:
@@ -174,6 +181,28 @@ def _token_mask(seg_q_ref, seg_k_ref, iq, ik, block_q, block_k, sliding_window):
     return mask
 
 
+def _dispatch_masked(active, specialize, needs_scalar, body):
+    """Register the masked/interior pl.when branches shared by every kernel:
+    ``body(masked)`` runs under ``active``; with ``specialize`` the
+    ``needs_scalar`` table value routes to the mask-free interior body."""
+    if specialize:
+
+        @pl.when(active & (needs_scalar == 1))
+        def _boundary():
+            body(masked=True)
+
+        @pl.when(active & (needs_scalar == 0))
+        def _interior():
+            body(masked=False)
+
+    else:
+
+        @pl.when(active)
+        def _body():
+            body(masked=True)
+
+
+
 # --------------------------------------------------------------------------- #
 # forward
 # --------------------------------------------------------------------------- #
@@ -237,22 +266,8 @@ def _fwd_kernel(
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
     active = ik <= _last_k(iq, block_q, block_k)
-    if specialize:
-        needs = needs_ref[iq * nk_blocks + jnp.minimum(ik, nk_blocks - 1)]
-
-        @pl.when(active & (needs == 1))
-        def _boundary():
-            _update(masked=True)
-
-        @pl.when(active & (needs == 0))
-        def _interior():
-            _update(masked=False)
-
-    else:
-
-        @pl.when(active)
-        def _body():
-            _update(masked=True)
+    needs = needs_ref[iq * nk_blocks + jnp.minimum(ik, nk_blocks - 1)]
+    _dispatch_masked(active, specialize, needs, _update)
 
     @pl.when(j == nk - 1)
     def _done():
@@ -386,6 +401,78 @@ def _recompute_p_ds(
     return p, ds
 
 
+def _bwd_kernel(
+    qlast_ref,
+    needs_ref,
+    seg_q_ref, seg_k_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
+    dk_ref, dv_ref,
+    dq_ref,     # [n_rep, T, D] — one q-head group, written once per kv head
+    dk_scr,     # [block_k, D] f32
+    dv_scr,     # [block_k, D] f32
+    dq_scr,     # [n_rep, T, D] f32 — whole-group dq accumulator
+    *,
+    scale, block_q, block_k, nk_blocks, nq_blocks, soft_cap, sliding_window,
+    specialize, n_rep,
+):
+    # Fused flash backward, kv-stationary: grid (Hkv, nk, n_rep, nq) with nq
+    # innermost. The (hkv, ik) dk/dv blocks accumulate in VMEM scratch across
+    # the inner (r, jq) sweep; dq accumulates across the OUTER ik sweep in a
+    # whole-group [n_rep, T, D] f32 scratch (HBM read-modify-write through
+    # output aliasing is undefined across non-consecutive revisits, so the
+    # running dq must live in VMEM), flushed once per kv head. One (p, ds)
+    # recompute feeds all three gradients: 5 dots + 1 exp per block pair,
+    # vs 7 dots + 2 exps when dq and dk/dv ran as separate sweeps.
+    ik = pl.program_id(1)
+    ir = pl.program_id(2)
+    jq = pl.program_id(3)
+    nq = pl.num_programs(3)
+    nk = pl.num_programs(1)
+    iq = _first_q(ik, block_q, block_k) + jq
+
+    @pl.when((ir == 0) & (jq == 0))
+    def _init_kv():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when((ik == 0) & (ir == 0) & (jq == 0))
+    def _init_dq():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    def _accum(masked: bool):
+        p, ds = _recompute_p_ds(
+            q_ref, k_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, do_ref,
+            v_ref, iq, ik, scale=scale, block_q=block_q, block_k=block_k,
+            soft_cap=soft_cap, sliding_window=sliding_window, masked=masked,
+        )
+        # dv += pᵀ @ do ; dk += dsᵀ @ q  (bf16 operands, f32 accumulate)
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        row = jnp.minimum(iq, nq_blocks - 1) * block_q
+        dq_scr[ir, pl.ds(row, block_q), :] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    active = iq <= qlast_ref[ik]
+    needs = needs_ref[jnp.minimum(iq, nq_blocks - 1) * nk_blocks + ik]
+    _dispatch_masked(active, specialize, needs, _accum)
+
+    @pl.when((ir == pl.num_programs(2) - 1) & (jq == nq - 1))
+    def _done_kv():
+        dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+    @pl.when((ik == nk - 1) & (ir == pl.num_programs(2) - 1) & (jq == nq - 1))
+    def _done_dq():
+        dq_ref[...] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+
 def _dq_kernel(
     kstart_ref,
     needs_ref,
@@ -416,22 +503,8 @@ def _dq_kernel(
         )
 
     active = ik <= _last_k(iq, block_q, block_k)
-    if specialize:
-        needs = needs_ref[iq * nk_blocks + jnp.minimum(ik, nk_blocks - 1)]
-
-        @pl.when(active & (needs == 1))
-        def _boundary():
-            _accum(masked=True)
-
-        @pl.when(active & (needs == 0))
-        def _interior():
-            _accum(masked=False)
-
-    else:
-
-        @pl.when(active)
-        def _body():
-            _accum(masked=True)
+    needs = needs_ref[iq * nk_blocks + jnp.minimum(ik, nk_blocks - 1)]
+    _dispatch_masked(active, specialize, needs, _accum)
 
     @pl.when(j == nk - 1)
     def _done():
@@ -479,24 +552,8 @@ def _dkv_kernel(
         )
 
     active = iq <= qlast_ref[ik]
-    if specialize:
-        needs = needs_ref[
-            jnp.minimum(iq, nq_blocks - 1) * nk_blocks + ik
-        ]
-
-        @pl.when(active & (needs == 1))
-        def _boundary():
-            _accum(masked=True)
-
-        @pl.when(active & (needs == 0))
-        def _interior():
-            _accum(masked=False)
-
-    else:
-
-        @pl.when(active)
-        def _body():
-            _accum(masked=True)
+    needs = needs_ref[jnp.minimum(iq, nq_blocks - 1) * nk_blocks + ik]
+    _dispatch_masked(active, specialize, needs, _accum)
 
     @pl.when((ir == pl.num_programs(2) - 1) & (jq == nq - 1))
     def _done():
@@ -533,6 +590,81 @@ def _flash_backward(
         nk_blocks=T // block_k, soft_cap=soft_cap,
         sliding_window=sliding_window, specialize=T >= SPECIALIZE_MIN_T,
     )
+
+    def dkv_qi(ql, j, i):
+        # clip: qlast can be -1 (all-pad k block); the step is inactive then
+        return jnp.clip(
+            _first_q(j, block_q, block_k) + i, 0, (T // block_q) - 1
+        )
+
+    def qi3(h, j, r, i, ql, nm, nr=n_rep):
+        return (h * nr + r, dkv_qi(ql, j, i), 0)
+
+    def qi4(h, j, r, i, ql, nm, nr=n_rep):
+        return (h * nr + r, dkv_qi(ql, j, i), 0, 0)
+
+    kv_spec = pl.BlockSpec((1, block_k, D), lambda h, j, r, i, ql, nm: (h, j, 0))
+    group_in_specs = [
+        pl.BlockSpec(
+            (1, block_q),
+            lambda h, j, r, i, ql, nm: (0, dkv_qi(ql, j, i)),
+        ),
+        pl.BlockSpec((1, block_k), lambda h, j, r, i, ql, nm: (0, j)),
+        pl.BlockSpec((1, 1, block_q, 1), qi4),
+        pl.BlockSpec((1, 1, block_q, 1), qi4),
+        pl.BlockSpec((1, block_q, D), qi3),
+        kv_spec,
+        kv_spec,
+        pl.BlockSpec((1, block_q, D), qi3),
+    ]
+
+
+    # Whole-group dq scratch [n_rep, T, D] f32 + its output block; fall back
+    # to separate dq/dkv sweeps when that won't fit VMEM (very long context
+    # or large head groups).
+    dq_scr_bytes = n_rep * T * D * 4
+    dq_out_bytes = n_rep * T * D * q.dtype.itemsize
+    if dq_scr_bytes + dq_out_bytes <= FUSED_BWD_MAX_DQ_BYTES:
+        limit = None
+        if dq_scr_bytes + dq_out_bytes > 8 * 2**20:
+            # leave the compiler's default scoped budget alone for small
+            # shapes (raising it measurably hurt short-context throughput)
+            limit = dq_scr_bytes + dq_out_bytes + 78 * 2**20
+        dk, dv, dq = pl.pallas_call(
+            functools.partial(
+                _bwd_kernel, **common, nq_blocks=T // block_q, n_rep=n_rep
+            ),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(Hkv, T // block_k, n_rep, T // block_q),
+                in_specs=group_in_specs,
+                out_specs=[
+                    kv_spec,
+                    kv_spec,
+                    pl.BlockSpec(
+                        (n_rep, T, D), lambda h, j, r, i, ql, nm: (h, 0, 0)
+                    ),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((block_k, D), jnp.float32),
+                    pltpu.VMEM((block_k, D), jnp.float32),
+                    pltpu.VMEM((n_rep, T, D), jnp.float32),
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((Hkv, T, D), k.dtype),
+                jax.ShapeDtypeStruct((Hkv, T, D), v.dtype),
+                jax.ShapeDtypeStruct((H, T, D), q.dtype),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=(
+                    "parallel", "arbitrary", "arbitrary", "arbitrary"
+                ),
+                **({"vmem_limit_bytes": limit} if limit else {}),
+            ),
+            interpret=_interpret(),
+        )(qlast, needs, seg2d, seg2d, lse4, delta4, q, k, v, do)
+        return dq, dk, dv
 
     def dq_kj(h, i, j, ks, nm, r=n_rep):
         return (
@@ -575,18 +707,6 @@ def _flash_backward(
         interpret=_interpret(),
     )(kstart, needs, seg2d, seg2d, lse4, delta4, q, k, v, do)
 
-    def dkv_qi(ql, j, i):
-        # clip: qlast can be -1 (all-pad k block); the step is inactive then
-        return jnp.clip(
-            _first_q(j, block_q, block_k) + i, 0, (T // block_q) - 1
-        )
-
-    def qi3(h, j, r, i, ql, nm, nr=n_rep):
-        return (h * nr + r, dkv_qi(ql, j, i), 0)
-
-    def qi4(h, j, r, i, ql, nm, nr=n_rep):
-        return (h * nr + r, dkv_qi(ql, j, i), 0, 0)
-
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, **common, nq_blocks=T // block_q, n_rep=n_rep
@@ -594,31 +714,8 @@ def _flash_backward(
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(Hkv, T // block_k, n_rep, T // block_q),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, block_q),
-                    lambda h, j, r, i, ql, nm: (0, dkv_qi(ql, j, i)),
-                ),
-                pl.BlockSpec((1, block_k), lambda h, j, r, i, ql, nm: (0, j)),
-                pl.BlockSpec((1, 1, block_q, 1), qi4),
-                pl.BlockSpec((1, 1, block_q, 1), qi4),
-                pl.BlockSpec((1, block_q, D), qi3),
-                pl.BlockSpec(
-                    (1, block_k, D), lambda h, j, r, i, ql, nm: (h, j, 0)
-                ),
-                pl.BlockSpec(
-                    (1, block_k, D), lambda h, j, r, i, ql, nm: (h, j, 0)
-                ),
-                pl.BlockSpec((1, block_q, D), qi3),
-            ],
-            out_specs=[
-                pl.BlockSpec(
-                    (1, block_k, D), lambda h, j, r, i, ql, nm: (h, j, 0)
-                ),
-                pl.BlockSpec(
-                    (1, block_k, D), lambda h, j, r, i, ql, nm: (h, j, 0)
-                ),
-            ],
+            in_specs=group_in_specs,
+            out_specs=[kv_spec, kv_spec],
             scratch_shapes=[
                 pltpu.VMEM((block_k, D), jnp.float32),
                 pltpu.VMEM((block_k, D), jnp.float32),

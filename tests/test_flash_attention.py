@@ -168,3 +168,33 @@ def test_flash_specialized_path_matches_xla(rng, monkeypatch):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4
         )
+
+
+def test_flash_bwd_fallback_sweeps_match_fused(rng, monkeypatch):
+    """The separate dq/dkv fallback sweeps (taken when the fused kernel's
+    whole-group dq scratch exceeds FUSED_BWD_MAX_DQ_BYTES) must produce the
+    same gradients as the fused path — forced here by zeroing the budget."""
+    from areal_tpu.ops.pallas import flash_attention as fa
+
+    T, H, Hkv, D = 384, 6, 2, 16
+    q, k, v, seg = _mk(rng, T, H, Hkv, D, [100, 156, 60])
+    scale = D**-0.5
+
+    def g():
+        return jax.grad(
+            lambda q, k, v: jnp.sum(
+                fa.packed_flash_attention(
+                    q, k, v, seg, softmax_scale=scale, block_size=128
+                )
+                ** 2
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+
+    fused = g()
+    monkeypatch.setattr(fa, "FUSED_BWD_MAX_DQ_BYTES", 0)
+    fallback = g()
+    for a, b in zip(fused, fallback):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5
+        )
