@@ -63,9 +63,11 @@ class MathMultiTurnAgent(Agent):
             act: BundledGenerationOutputs = await act_queue.get()
             answer = self._decode(act.output_ids[0])
             _, success, *_ = await env.step((qid, [answer]))
-            ok = bool(success[0])
+            # graded envs (tool_use) return scores in [0, 1]; >= 0.5 is the
+            # success threshold (binary envs are exactly 0/1)
+            ok = float(success[0]) >= 0.5
             reward = (
-                ((float(ok) - 0.5) * 2 - self.reward_bias)
+                ((float(success[0]) - 0.5) * 2 - self.reward_bias)
                 * self.reward_scaling
                 * discount
             )

@@ -144,7 +144,8 @@ class MathSingleStepAgent(Agent):
                     {
                         "qid": str(qid),
                         "answers": answers,
-                        "success": [bool(s) for s in success],
+                        # graded envs return [0, 1] scores; >= 0.5 = success
+                        "success": [float(s) >= 0.5 for s in success],
                         "rewards": rewards,
                         "version_start": act.version_start,
                         "version_end": act.version_end,

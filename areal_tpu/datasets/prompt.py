@@ -81,6 +81,18 @@ class MathCodePromptDataset(PromptOnlyDataset):
             task = r.get("task", "math")
             if task == "math":
                 meta[qid] = {"task": "math", "solutions": r.get("solutions", [])}
+            elif task == "tool_use":
+                meta[qid] = {
+                    "task": "tool_use",
+                    "answer": str(
+                        r.get("answer", r.get("target", r.get("ground_truth", "")))
+                    ),
+                    **(
+                        {"scoring_method": r["scoring_method"]}
+                        if "scoring_method" in r
+                        else {}
+                    ),
+                }
             else:
                 meta[qid] = {
                     "task": "code",

@@ -27,6 +27,27 @@ class MathCodeSingleStepEnv(EnvironmentService):
         qid, answers = action
         meta = self.metadata[str(qid)]
         task = meta.get("task", "math")
+        if task == "tool_use":
+            # graded (not binary): token-F1/EM of the extracted answer tool
+            # call + format bonus, normalized into [0, 1] so downstream
+            # binary-success consumers (filter band with ub=1.0, the
+            # (s-0.5)*2 reward transform) stay well-defined; pure host math,
+            # never remoted
+            from areal_tpu.rewards import tool_use
+
+            cw, fw = 1.0, 0.2
+            scores = [
+                tool_use.tool_use_reward(
+                    a,
+                    str(meta.get("answer", "")),
+                    correctness_weight=cw,
+                    format_weight=fw,
+                    scoring_method=meta.get("scoring_method", "f1"),
+                )
+                / (cw + fw)
+                for a in answers
+            ]
+            return None, scores, True, False, {}
         if remote.ENABLED and remote.service_domain():
             if task == "math":
                 success = await remote.math_verify_remote(

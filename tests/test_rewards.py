@@ -112,3 +112,81 @@ def test_degenerate_power_is_fast():
     t0 = time.time()
     assert not math_verify.answers_equal(r"2^{999999999}", "5")
     assert time.time() - t0 < 2.0
+
+
+# --------------------------------------------------------------------------- #
+# tool-use reward (≈ reference tool_use_rw_interface)
+# --------------------------------------------------------------------------- #
+
+from areal_tpu.rewards import tool_use
+
+
+TOOL_RESP = (
+    'I will search first. {"function": {"name": "search", "arguments": '
+    '{"query": "capital of France"}}} ... The result says Paris. '
+    '{"function": {"name": "answer", "arguments": {"answer": "Paris"}}}'
+)
+
+
+def test_tool_use_extracts_last_answer_call():
+    two = TOOL_RESP + ' {"function": {"name": "answer", "arguments": {"answer": "Lyon"}}}'
+    assert tool_use.extract_answer(TOOL_RESP) == "Paris"
+    assert tool_use.extract_answer(two) == "Lyon"
+    assert tool_use.extract_answer('{"answer": "42"}') == "42"
+    assert tool_use.extract_answer("just text") == "just text"
+
+
+def test_tool_use_normalize_and_scores():
+    assert tool_use.normalize_answer("The  Quick, Brown Fox!") == "quick brown fox"
+    em, f1 = tool_use.em_check("the Paris", "Paris")
+    assert em == 1 and f1 == 1.0
+    em, f1 = tool_use.em_check("Paris France", "Paris")
+    assert em == 0 and 0.0 < f1 < 1.0
+    assert tool_use.f1_score("", "") == 1.0
+    assert tool_use.f1_score("x", "") == 0.0
+
+
+def test_tool_use_reward_combines_correctness_and_format():
+    r = tool_use.tool_use_reward(TOOL_RESP, "Paris")
+    assert r == pytest.approx(1.2)  # F1 1.0 + format 0.2
+    assert tool_use.tool_use_reward("Paris", "Paris") == pytest.approx(1.0)
+    assert tool_use.tool_use_reward("wrong", "Paris") == 0.0
+    assert tool_use.tool_use_reward(TOOL_RESP, "Paris", scoring_method="em") == pytest.approx(1.2)
+
+
+def test_tool_use_env_dispatch():
+    import asyncio
+
+    from areal_tpu.envs.math_code_single_step import MathCodeSingleStepEnv
+
+    env = MathCodeSingleStepEnv(
+        {"q1": {"task": "tool_use", "answer": "Paris"}}
+    )
+    _, scores, done, _, _ = asyncio.run(env.step(("q1", [TOOL_RESP, "nope"])))
+    assert done
+    # env scores are normalized into [0, 1] for binary-success consumers
+    assert scores[0] == pytest.approx(1.0)
+    assert scores[1] == 0.0
+
+
+def test_tool_use_dataset_metadata():
+    from areal_tpu.datasets.prompt import MathCodePromptDataset
+
+    ds = MathCodePromptDataset.__new__(MathCodePromptDataset)
+    ds.records = [
+        {"query_id": "a", "task": "tool_use", "prompt": "p", "answer": "42"},
+        {"query_id": "b", "task": "math", "prompt": "p", "solutions": ["\\boxed{1}"]},
+    ]
+    meta = ds.load_metadata()
+    assert meta["a"] == {"task": "tool_use", "answer": "42"}
+    assert meta["b"]["task"] == "math"
+
+
+def test_tool_use_handles_escaped_quotes():
+    resp = (
+        '{"function": {"name": "answer", "arguments": '
+        '{"answer": "He said \\"hi\\" loudly"}}}'
+    )
+    assert tool_use.extract_answer(resp) == 'He said "hi" loudly'
+    em, f1 = tool_use.em_check(tool_use.extract_answer(resp), 'he said hi loudly')
+    assert em == 1 and f1 == 1.0
