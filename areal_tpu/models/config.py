@@ -91,6 +91,13 @@ class ModelConfig:
     # where pallas only runs interpreted); True/False force it.
     use_flash_attention: Optional[bool] = None
 
+    # STATIC upper bound on any packed segment's length (e.g. max prompt +
+    # max new tokens). When set, the flash kernels iterate a statically
+    # narrowed block band instead of the full causal rectangle — a multi-x
+    # attention win when packing many short sequences. The train engine
+    # rejects batches that violate the bound.
+    attn_max_seqlen: Optional[int] = None
+
     # Layer-stack execution: 1 = lax.scan over stacked layers (one trace,
     # fast compiles — the default); an int N or True unrolls the scan (full
     # unroll removes the per-layer dynamic-update-slice bookkeeping XLA

@@ -339,6 +339,7 @@ def forward_packed(
             soft_cap=cfg.attn_logits_soft_cap,
             sliding_window=cfg.sliding_window,
             use_flash=cfg.flash_enabled(),
+            max_seqlen=cfg.attn_max_seqlen,
         )
 
     def _pre(x, lp):

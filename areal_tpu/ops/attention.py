@@ -81,6 +81,7 @@ def packed_attention(
     sliding_window: Optional[int] = None,
     use_flash: bool = False,
     flash_block_size: Optional[int] = None,
+    max_seqlen: Optional[int] = None,
 ) -> jnp.ndarray:
     """Causal self-attention over a packed token axis.
 
@@ -90,6 +91,8 @@ def packed_attention(
       flash_block_size: None = auto — 1024 at long context (T >= 8192), where
         bigger score tiles roughly double measured kernel throughput; 512
         otherwise (short packed segments straddle fewer block boundaries).
+      max_seqlen: STATIC upper bound on any segment length; narrows the
+        flash kernels' block band (see ``packed_flash_attention``).
     Returns ``[T, H, D]``.
     """
     if softmax_scale is None:
@@ -117,6 +120,7 @@ def packed_attention(
             sliding_window=sliding_window,
             block_size=flash_block_size
             or (1024 if q.shape[0] >= 8192 and q.shape[0] % 1024 == 0 else 512),
+            max_seqlen=max_seqlen,
         )
     return _attention_xla(
         q, k, v, segment_ids, softmax_scale, soft_cap, sliding_window

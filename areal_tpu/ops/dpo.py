@@ -1,0 +1,35 @@
+"""Direct-preference-optimization loss.
+
+Counterpart of ``realhf/impl/model/utils/dpo_functional.py`` (the reference
+ships the functional only — no DPO interface/experiment — and so do we).
+Sequence logprobs arrive interleaved (win, lose) pairs, exactly like the
+paired-RW dataset emits them.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def dpo_loss(
+    pi_logps: jnp.ndarray,
+    ref_logps: jnp.ndarray,
+    beta: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Returns (loss, pos_score, neg_score, kl).
+
+    ``pi_logps`` / ``ref_logps``: [2N] per-sequence logprobs, rows
+    alternating (win, lose) — ``dpo_loss`` in the reference ``:11-34``.
+    """
+    assert pi_logps.ndim == 1 and pi_logps.shape[0] % 2 == 0, pi_logps.shape
+    assert ref_logps.shape == pi_logps.shape, (pi_logps.shape, ref_logps.shape)
+    pi = pi_logps.reshape(-1, 2)
+    ref = ref_logps.reshape(-1, 2)
+    pi_logratios = pi[:, 0] - pi[:, 1]
+    ref_logratios = ref[:, 0] - ref[:, 1]
+    loss = -jnp.mean(jax.nn.log_sigmoid(beta * (pi_logratios - ref_logratios)))
+    pos_score = jax.lax.stop_gradient(beta * jnp.sum(pi[:, 0] - ref[:, 0]))
+    neg_score = jax.lax.stop_gradient(beta * jnp.sum(pi[:, 1] - ref[:, 1]))
+    kl = jax.lax.stop_gradient(-jnp.sum(pi_logps - ref_logps))
+    return loss, pos_score, neg_score, kl

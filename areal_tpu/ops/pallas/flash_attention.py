@@ -126,6 +126,25 @@ def _first_q(ik, block_q, block_k):
     return (ik * block_k) // block_q
 
 
+def _k_band_blocks(block_q, block_k, max_seqlen, T):
+    """Static bound on the k-block band width per q block: a q block's
+    earliest needed key starts at most ``max_seqlen - 1`` tokens before the
+    block (the segment containing its first token), and its last is the
+    causal diagonal — so the span is <= block_q + max_seqlen - 1 tokens."""
+    nk = T // block_k
+    if max_seqlen is None:
+        return nk
+    return min(nk, -(-(block_q + max_seqlen - 1) // block_k) + 1)
+
+
+def _q_band_blocks(block_q, block_k, max_seqlen, T):
+    """Static bound on the q-block band width per k block (symmetric)."""
+    nq = T // block_q
+    if max_seqlen is None:
+        return nq
+    return min(nq, -(-(block_k + max_seqlen - 1) // block_q) + 1)
+
+
 def _block_needs_mask(segment_ids, block_q, block_k, sliding_window, T):
     """``[nq*nk] int32``: 0 where the (q block, k block) pair is *interior* —
     every token pair unmasked (block fully below the causal diagonal, one
@@ -282,7 +301,8 @@ def _fwd_kernel(
 
 
 def _flash_forward(
-    q, k, v, segment_ids, scale, soft_cap, sliding_window, block_q, block_k
+    q, k, v, segment_ids, scale, soft_cap, sliding_window, block_q, block_k,
+    max_seqlen,
 ):
     """q: [H, T, D]; k, v: [Hkv, T, D]; segment_ids: [T]
     -> (out [H, T, D], lse [H, T] f32).
@@ -298,7 +318,7 @@ def _flash_forward(
     block_q = min(block_q, T)
     block_k = min(block_k, T)
     assert T % block_q == 0 and T % block_k == 0, (T, block_q, block_k)
-    grid = (H, T // block_q, T // block_k)
+    grid = (H, T // block_q, _k_band_blocks(block_q, block_k, max_seqlen, T))
     seg2d = segment_ids.reshape(1, T)
     kstart, _ = _band_bounds(segment_ids, block_q, block_k, sliding_window, T)
     needs = _block_needs_mask(segment_ids, block_q, block_k, sliding_window, T)
@@ -563,7 +583,7 @@ def _dkv_kernel(
 
 def _flash_backward(
     q, k, v, segment_ids, out, lse, do,
-    scale, soft_cap, sliding_window, block_q, block_k,
+    scale, soft_cap, sliding_window, block_q, block_k, max_seqlen,
 ):
     """All [H|Hkv, T, D]-layout. Returns (dq, dk, dv)."""
     H, T, D = q.shape
@@ -636,7 +656,10 @@ def _flash_backward(
             ),
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=2,
-                grid=(Hkv, T // block_k, n_rep, T // block_q),
+                grid=(
+                    Hkv, T // block_k, n_rep,
+                    _q_band_blocks(block_q, block_k, max_seqlen, T),
+                ),
                 in_specs=group_in_specs,
                 out_specs=[
                     kv_spec,
@@ -677,7 +700,10 @@ def _flash_backward(
         functools.partial(_dq_kernel, **common),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(H, T // block_q, T // block_k),
+            grid=(
+                H, T // block_q,
+                _k_band_blocks(block_q, block_k, max_seqlen, T),
+            ),
             in_specs=[
                 pl.BlockSpec((1, block_q), lambda h, i, j, ks, nm: (0, i)),
                 pl.BlockSpec(
@@ -713,7 +739,10 @@ def _flash_backward(
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(Hkv, T // block_k, n_rep, T // block_q),
+            grid=(
+                Hkv, T // block_k, n_rep,
+                _q_band_blocks(block_q, block_k, max_seqlen, T),
+            ),
             in_specs=group_in_specs,
             out_specs=[kv_spec, kv_spec],
             scratch_shapes=[
@@ -735,8 +764,9 @@ def _flash_backward(
 # --------------------------------------------------------------------------- #
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_thd(q, k, v, segment_ids, scale, soft_cap, sliding_window, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_thd(q, k, v, segment_ids, scale, soft_cap, sliding_window, block_q,
+               block_k, max_seqlen):
     """[T, H, D]-layout entry with custom vjp."""
     out, _ = _flash_forward(
         q.swapaxes(0, 1),
@@ -748,24 +778,27 @@ def _flash_thd(q, k, v, segment_ids, scale, soft_cap, sliding_window, block_q, b
         sliding_window,
         block_q,
         block_k,
+        max_seqlen,
     )
     return out.swapaxes(0, 1)
 
 
-def _flash_fwd_rule(q, k, v, segment_ids, scale, soft_cap, sliding_window, block_q, block_k):
+def _flash_fwd_rule(q, k, v, segment_ids, scale, soft_cap, sliding_window,
+                    block_q, block_k, max_seqlen):
     out, lse = _flash_forward(
         q.swapaxes(0, 1), k.swapaxes(0, 1), v.swapaxes(0, 1), segment_ids,
-        scale, soft_cap, sliding_window, block_q, block_k,
+        scale, soft_cap, sliding_window, block_q, block_k, max_seqlen,
     )
     return out.swapaxes(0, 1), (q, k, v, segment_ids, out, lse)
 
 
-def _flash_bwd_rule(scale, soft_cap, sliding_window, block_q, block_k, res, g):
+def _flash_bwd_rule(scale, soft_cap, sliding_window, block_q, block_k,
+                    max_seqlen, res, g):
     q, k, v, segment_ids, out_htd, lse = res
     dq, dk, dv = _flash_backward(
         q.swapaxes(0, 1), k.swapaxes(0, 1), v.swapaxes(0, 1), segment_ids,
         out_htd, lse, g.swapaxes(0, 1),
-        scale, soft_cap, sliding_window, block_q, block_k,
+        scale, soft_cap, sliding_window, block_q, block_k, max_seqlen,
     )
     return dq.swapaxes(0, 1), dk.swapaxes(0, 1), dv.swapaxes(0, 1), None
 
@@ -783,10 +816,19 @@ def packed_flash_attention(
     soft_cap: Optional[float] = None,
     sliding_window: Optional[int] = None,
     block_size: int = 512,
+    max_seqlen: Optional[int] = None,
 ) -> jnp.ndarray:
     """Causal packed-varlen flash attention. q ``[T, H, D]``, k/v
-    ``[T, Hkv, D]``, segment_ids ``[T]`` (0 = pad) -> ``[T, H, D]``."""
+    ``[T, Hkv, D]``, segment_ids ``[T]`` (0 = pad) -> ``[T, H, D]``.
+
+    ``max_seqlen``: STATIC upper bound on any single segment's length. When
+    set, the kernels iterate a statically narrowed (q block, k block) band
+    instead of the full causal rectangle — at short-segment packing most
+    grid steps are out-of-band no-ops that still cost ~µs each, so this is
+    a multi-x win. Segments longer than the bound get silently truncated
+    attention: callers must validate (the train engine does).
+    """
     return _flash_thd(
         q, k, v, segment_ids.astype(jnp.int32), softmax_scale, soft_cap,
-        sliding_window, block_size, block_size,
+        sliding_window, block_size, block_size, max_seqlen,
     )

@@ -411,6 +411,22 @@ class TrainEngine:
         by small allgathers; a host with fewer items than the agreed count
         pads with empty (weight-0) micro-batches.
         """
+        if self.cfg.attn_max_seqlen is not None:
+            # every sequence of every (possibly grouped) item; allreduce so
+            # all hosts raise together instead of desyncing the collectives
+            # below
+            longest = max(
+                (l for lens in sample.seqlens.values() for ln in lens for l in ln),
+                default=0,
+            )
+            longest = int(multihost.allreduce_max(np.asarray([longest]))[0])
+            if longest > self.cfg.attn_max_seqlen:
+                raise ValueError(
+                    f"batch contains a {longest}-token sequence but "
+                    f"attn_max_seqlen={self.cfg.attn_max_seqlen}: the flash "
+                    "kernels would silently truncate its attention span. "
+                    "Raise the bound or drop over-long sequences at intake."
+                )
         n_rows = self.n_local_rows
         mbs = batching.split_into_micro_batches(
             sample, mb_spec.n_mbs, mb_spec.max_tokens_per_mb, n_rows

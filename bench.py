@@ -21,6 +21,7 @@ device->host round trip costs ~70 ms on a tunneled chip), then fetch one
 scalar to drain the queue.
 """
 
+import dataclasses
 import json
 import os
 import time
@@ -98,16 +99,20 @@ def main():
     # full layer unroll + no remat: these shapes fit HBM comfortably, and
     # unrolling removes the scan's per-layer buffer shuffling (~20% step
     # time); long-context/big-model training keeps scan + remat by default
+    # attn_max_seqlen statically narrows the flash kernels' block band to
+    # the packed segments' actual length — at 512-token packing most grid
+    # steps were out-of-band no-ops
     cfg_small = ModelConfig(
         n_layers=12, n_q_heads=12, n_kv_heads=4, head_dim=64, hidden_dim=768,
         intermediate_dim=2048, vocab_size=32768, use_attention_bias=True,
         dtype="bfloat16", remat_policy="none", layer_scan_unroll=12,
+        attn_max_seqlen=512,
     )
     cfg_1b = ModelConfig(
         n_layers=20, n_q_heads=16, n_kv_heads=8, head_dim=128,
         hidden_dim=2048, intermediate_dim=5632, vocab_size=32768,
         use_attention_bias=True, dtype="bfloat16",
-        remat_policy="none", layer_scan_unroll=20,
+        remat_policy="none", layer_scan_unroll=20, attn_max_seqlen=512,
     )
 
     primary = _bench_shape(cfg_small, [512] * 8, n_steps=32, peak=peak)
@@ -116,16 +121,16 @@ def main():
         "device": str(jax.devices()[0].device_kind),
     }
     try:
-        detail["ctx8k"] = _bench_shape(cfg_small, [8192], n_steps=8, peak=peak)
+        cfg_8k = dataclasses.replace(cfg_small, attn_max_seqlen=None)
+        detail["ctx8k"] = _bench_shape(cfg_8k, [8192], n_steps=8, peak=peak)
     except Exception as e:  # keep the primary metric even if a shape OOMs
         detail["ctx8k"] = {"error": repr(e)[:200]}
     try:
         # the 32k-context protocol shape (benchmark README): one long
         # sequence through the flash kernels, matmul-saving remat
-        import dataclasses as _dc
-
-        cfg_32k = _dc.replace(
-            cfg_small, remat_policy="dots_attn", layer_scan_unroll=1
+        cfg_32k = dataclasses.replace(
+            cfg_small, remat_policy="dots_attn", layer_scan_unroll=1,
+            attn_max_seqlen=None,
         )
         detail["ctx32k"] = _bench_shape(cfg_32k, [32768], n_steps=4, peak=peak)
     except Exception as e:

@@ -198,3 +198,67 @@ def test_flash_bwd_fallback_sweeps_match_fused(rng, monkeypatch):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5
         )
+
+
+@pytest.mark.parametrize("max_seqlen", [64, 100, 200])
+def test_flash_band_narrowing_matches_xla(rng, max_seqlen):
+    """The static max_seqlen band hint must not change results as long as
+    every segment respects the bound — fwd and bwd, multi-segment + pad."""
+    T, H, Hkv, D = 512, 4, 2, 16
+    lens = [100, 64, 100, 90, 37]  # all <= 100 <= max_seqlen... for 64: no
+    if max_seqlen == 64:
+        lens = [64, 33, 64, 50, 21]
+    q, k, v, seg = _mk(rng, T, H, Hkv, D, lens)
+    scale = D**-0.5
+    ref = _attention_xla(q, k, v, seg, scale)
+    got = packed_flash_attention(
+        q, k, v, seg, softmax_scale=scale, block_size=64, max_seqlen=max_seqlen
+    )
+    valid = (np.asarray(seg) > 0)[:, None, None]
+    np.testing.assert_allclose(
+        np.asarray(got) * valid, np.asarray(ref) * valid, atol=2e-5, rtol=2e-5
+    )
+
+    g1 = jax.grad(
+        lambda q, k, v: jnp.sum(
+            packed_flash_attention(
+                q, k, v, seg, softmax_scale=scale, block_size=64,
+                max_seqlen=max_seqlen,
+            )
+            ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g2 = jax.grad(
+        lambda q, k, v: jnp.sum(_attention_xla(q, k, v, seg, scale) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4
+        )
+
+
+def test_engine_rejects_overlong_sequence():
+    from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+    from areal_tpu.models.config import ModelConfig
+    from areal_tpu.parallel.mesh import ParallelConfig
+    from areal_tpu.train.engine import OptimizerConfig, TrainEngine
+
+    cfg = ModelConfig(
+        n_layers=1, n_q_heads=2, n_kv_heads=1, head_dim=8, hidden_dim=16,
+        intermediate_dim=32, vocab_size=64, dtype="float32",
+        attn_max_seqlen=16,
+    )
+    eng = TrainEngine(cfg, ParallelConfig(), OptimizerConfig(lr=1e-3))
+    eng.init_random(0)
+    eng.setup_optimizer(10)
+    sample = SequenceSample.from_default(
+        ids=[0], seqlens=[24],
+        data={"packed_input_ids": np.zeros(24, np.int64)},
+    )
+    with pytest.raises(ValueError, match="attn_max_seqlen"):
+        eng.train_batch(
+            sample, MicroBatchSpec(n_mbs=1, max_tokens_per_mb=64),
+            lambda p, c, a: (jnp.float32(0), {}),
+        )
