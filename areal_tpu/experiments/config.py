@@ -26,13 +26,19 @@ class ModelSpec:
 
     path: Optional[str] = None           # HF checkpoint dir
     arch: Optional[Dict[str, Any]] = None  # ModelConfig kwargs (random init)
+    # Runtime ModelConfig knobs applied on top of either source — e.g.
+    # remat_policy, layer_scan_unroll, attn_max_seqlen (set it to
+    # max prompt + max new tokens to statically narrow the flash kernels'
+    # block band), use_flash_attention, dtype.
+    overrides: Optional[Dict[str, Any]] = None
     parallel: str = "d1m1"               # ParallelConfig.from_str format
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
     init_critic_from_actor: bool = False
 
     def model_config(self, is_critic: bool = False) -> ModelConfig:
+        import dataclasses as dc
+
         if self.path is not None:
-            import dataclasses as dc
             import os
 
             from areal_tpu.models import hf as hf_conv
@@ -41,9 +47,13 @@ class ModelSpec:
                 hf_cfg = json.load(f)
             fam = hf_conv.family_for_model_type(hf_cfg["model_type"])
             cfg = fam.config_from_hf(hf_cfg)
-            return dc.replace(cfg, is_critic=is_critic)
-        assert self.arch is not None, "ModelSpec needs path or arch"
-        return ModelConfig(**{**self.arch, "is_critic": is_critic})
+            cfg = dc.replace(cfg, is_critic=is_critic)
+        else:
+            assert self.arch is not None, "ModelSpec needs path or arch"
+            cfg = ModelConfig(**{**self.arch, "is_critic": is_critic})
+        if self.overrides:
+            cfg = dc.replace(cfg, **self.overrides)
+        return cfg
 
     def parallel_config(self) -> ParallelConfig:
         return ParallelConfig.from_str(self.parallel)

@@ -170,3 +170,18 @@ def test_async_ppo_experiment(tmp_path):
     # v0 was published then pruned by the manager's keep-2 policy; the two
     # per-step snapshots remain
     assert versions == ["v1", "v2"]
+
+
+def test_model_spec_overrides():
+    from areal_tpu.experiments.config import ModelSpec
+
+    spec = ModelSpec(
+        arch=dict(
+            n_layers=1, n_q_heads=2, n_kv_heads=1, head_dim=8, hidden_dim=16,
+            intermediate_dim=32, vocab_size=64,
+        ),
+        overrides=dict(attn_max_seqlen=256, remat_policy="dots_attn"),
+    )
+    cfg = spec.model_config()
+    assert cfg.attn_max_seqlen == 256
+    assert cfg.remat_policy == "dots_attn"
