@@ -1,0 +1,174 @@
+"""Codeforces ELO estimation for offline evaluation.
+
+Counterpart of ``evaluation/cf_elo_caculator.py`` (344 LoC): given
+per-problem pass/fail attempts for problems drawn from real contests, plus
+*cached* contest standings/rating-change data (the reference downloads and
+caches the same shapes from the Codeforces API; zero-egress here, so the
+cache files are an input), estimate the model's equivalent rating per
+contest by the expected-seed inversion, then aggregate to a percentile
+against a rating population.
+
+Data shapes (identical to the reference's cache):
+- standings: ``{"result": {"rows": [{"party": {"members": [{"handle"}]},
+  "points", "penalty"}...], "problems": [{"contestId", "index",
+  "points"?}...]}}``
+- rating_changes: ``{"result": [{"handle", "oldRating"}...]}``
+"""
+
+import bisect
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+MIN_PARTICIPANTS = 200  # reference drops contests with <=200 common handles
+
+
+def expected_seed(rating: float, old_ratings: Sequence[float]) -> float:
+    """1 + Σ_i P(participant i beats `rating`) — the ELO expected rank."""
+    return 1.0 + sum(
+        1.0 / (1.0 + 10.0 ** ((rating - r) / 400.0)) for r in old_ratings
+    )
+
+
+def rating_for_rank(
+    rank: int, old_ratings: Sequence[float], max_rating: float
+) -> int:
+    """Largest integer rating whose expected seed is still >= rank
+    (binary search, reference ``calc_elo_rating_offline:148-160``)."""
+    lo, hi = 0, int(max_rating) + 100
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        if expected_seed(mid, old_ratings) < rank:
+            hi = mid
+        else:
+            lo = mid
+    return lo
+
+
+def contest_score(
+    problem_status: Dict[str, List[bool]],
+    problems: List[dict],
+    pass_n: Optional[int] = None,
+) -> Tuple[float, float]:
+    """(score, penalty) under the reference's submission model: the first
+    passing attempt among the first ``pass_n`` counts, losing 50 points per
+    earlier failed attempt (scored contests) or adding 10 penalty per failed
+    attempt (ICPC-style contests without per-problem points)."""
+    score = 0.0
+    penalty = 0.0
+    for problem in problems:
+        prob = f"{problem['contestId']}{problem['index']}"
+        attempts = problem_status.get(prob)
+        if not attempts:
+            continue
+        n = len(attempts) if pass_n is None else pass_n
+        for ith, status in enumerate(attempts[:n]):
+            if status:
+                if "points" in problem:
+                    score += max(0.0, problem["points"] - 50.0 * ith)
+                else:
+                    score += 1.0
+                    penalty += ith * 10.0
+                break
+    return score, penalty
+
+
+def rank_in_standings(rows: List[dict], score: float, penalty: float) -> int:
+    """1-based rank: first row strictly beaten by (score, penalty)."""
+    for i, row in enumerate(rows):
+        if row["points"] < score or (
+            row["points"] == score and row["penalty"] > penalty
+        ):
+            return i + 1
+    return len(rows) + 1
+
+
+def calc_contest_elo(
+    standings: dict,
+    rating_changes: dict,
+    problem_status: Dict[str, List[bool]],
+    pass_n: Optional[int] = None,
+) -> Optional[int]:
+    """Equivalent rating for one contest, or None when the cached data is
+    unusable (mismatched handles / too few participants — reference
+    semantics)."""
+    try:
+        rows = standings["result"]["rows"]
+        changes = rating_changes["result"]
+        by_handle = {c["handle"]: c for c in changes}
+        rows = [
+            r for r in rows if r["party"]["members"][0]["handle"] in by_handle
+        ]
+        changes = [
+            by_handle[r["party"]["members"][0]["handle"]] for r in rows
+        ]
+        if len(rows) <= MIN_PARTICIPANTS:
+            return None
+        old_ratings = [c["oldRating"] for c in changes]
+        score, penalty = contest_score(
+            problem_status, standings["result"]["problems"], pass_n
+        )
+        rank = rank_in_standings(rows, score, penalty)
+        return rating_for_rank(rank, old_ratings, max(old_ratings))
+    except (KeyError, IndexError, TypeError):
+        return None
+
+
+def percentile(rating: float, sorted_ratings: Sequence[float]) -> float:
+    """Fraction of the population strictly below ``rating``."""
+    if not sorted_ratings:
+        return 0.0
+    return bisect.bisect_left(list(sorted_ratings), rating) / len(sorted_ratings)
+
+
+def calculate_cf_elo(
+    submissions: Dict[str, List[bool]],
+    cache_dir: str,
+    ratings_path: Optional[str] = None,
+    pass_n: Optional[int] = None,
+) -> Dict[str, float]:
+    """Aggregate ELO over every contest with cached data.
+
+    ``submissions``: problem id (e.g. ``"1700A"``) -> pass/fail attempts.
+    ``cache_dir``: per-contest JSON files ``{contest_id}.json`` holding
+    ``{"standings": ..., "rating_changes": ...}``.
+    ``ratings_path``: newline-separated rating population for percentile.
+    """
+    by_contest: Dict[int, Dict[str, List[bool]]] = {}
+    for prob, attempts in submissions.items():
+        # contest id = the LEADING digit run ("1700A1" -> 1700; indices may
+        # contain digits); keys without one are malformed — skip, don't abort
+        m = re.match(r"\d+", prob)
+        if not m:
+            continue
+        by_contest.setdefault(int(m.group()), {})[prob] = attempts
+
+    ratings: List[int] = []
+    for cid, status in sorted(by_contest.items()):
+        path = os.path.join(cache_dir, f"{cid}.json")
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            cached = json.load(f)
+        r = calc_contest_elo(
+            cached["standings"], cached["rating_changes"], status, pass_n
+        )
+        if r is not None:
+            ratings.append(r)
+
+    # keys are unconditional so metric consumers never KeyError; 0.0 is the
+    # no-usable-contest sentinel (n_contests disambiguates)
+    out: Dict[str, float] = {
+        "n_contests": float(len(ratings)),
+        "elo": 0.0,
+        "percentile": 0.0,
+    }
+    if ratings:
+        est = sum(ratings) / len(ratings)
+        out["elo"] = est
+        if ratings_path and os.path.exists(ratings_path):
+            with open(ratings_path) as f:
+                pop = sorted(float(x) for x in f.read().split() if x.strip())
+            out["percentile"] = percentile(est, pop)
+    return out

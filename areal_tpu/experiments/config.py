@@ -36,8 +36,6 @@ class ModelSpec:
     init_critic_from_actor: bool = False
 
     def model_config(self, is_critic: bool = False) -> ModelConfig:
-        import dataclasses as dc
-
         if self.path is not None:
             import os
 
@@ -47,12 +45,12 @@ class ModelSpec:
                 hf_cfg = json.load(f)
             fam = hf_conv.family_for_model_type(hf_cfg["model_type"])
             cfg = fam.config_from_hf(hf_cfg)
-            cfg = dc.replace(cfg, is_critic=is_critic)
+            cfg = dataclasses.replace(cfg, is_critic=is_critic)
         else:
             assert self.arch is not None, "ModelSpec needs path or arch"
             cfg = ModelConfig(**{**self.arch, "is_critic": is_critic})
         if self.overrides:
-            cfg = dc.replace(cfg, **self.overrides)
+            cfg = dataclasses.replace(cfg, **self.overrides)
         return cfg
 
     def parallel_config(self) -> ParallelConfig:

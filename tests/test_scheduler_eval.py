@@ -128,3 +128,91 @@ def test_eval_offline_harness(tmp_path):
         "--model-path", ckpt, "--dataset", data, "--output-dir", out,
         "--n-sampling", "2", "--allow-token-id-answers",
     ]) == 0
+
+
+# --------------------------------------------------------------------------- #
+# Codeforces ELO estimation (≈ evaluation/cf_elo_caculator.py)
+# --------------------------------------------------------------------------- #
+
+
+def _synthetic_contest(n=300, n_problems=3):
+    """Participants with ratings 1000..1000+10(n-1); points descend with
+    rating so rank order == rating order."""
+    rows = [
+        {
+            "party": {"members": [{"handle": f"h{i}"}]},
+            "points": float(2 * (n - i)),
+            "penalty": 0,
+        }
+        for i in range(n)
+    ]
+    changes = [
+        {"handle": f"h{i}", "oldRating": 1000 + 10 * (n - 1 - i)}
+        for i in range(n)
+    ]
+    problems = [
+        {"contestId": 1700, "index": chr(ord("A") + j), "points": 500.0 * (j + 1)}
+        for j in range(n_problems)
+    ]
+    return (
+        {"result": {"rows": rows, "problems": problems}},
+        {"result": changes},
+    )
+
+
+def test_cf_elo_score_and_rank_math():
+    from areal_tpu.apps import cf_elo
+
+    standings, _ = _synthetic_contest()
+    problems = standings["result"]["problems"]
+    # solve A on 1st attempt (500), B on 2nd (1000 - 50), miss C
+    status = {"1700A": [True], "1700B": [False, True], "1700C": [False, False]}
+    score, penalty = cf_elo.contest_score(status, problems)
+    assert score == 500.0 + 950.0 and penalty == 0.0
+    # rank: rows have points 600, 598, ... -> score 1450 beats rows with
+    # points < 1450
+    rank = cf_elo.rank_in_standings(standings["result"]["rows"], score, penalty)
+    assert rank == 1  # 2*(300-i) max is 600 < 1450
+
+    # expected seed is monotone decreasing in rating
+    old = [1200.0] * 100
+    assert cf_elo.expected_seed(1500, old) < cf_elo.expected_seed(1000, old)
+    assert cf_elo.rating_for_rank(1, old, 1200) > cf_elo.rating_for_rank(
+        90, old, 1200
+    )
+
+
+def test_cf_elo_end_to_end(tmp_path):
+    import json
+
+    from areal_tpu.apps import cf_elo
+
+    standings, changes = _synthetic_contest()
+    (tmp_path / "1700.json").write_text(
+        json.dumps({"standings": standings, "rating_changes": changes})
+    )
+    (tmp_path / "ratings.txt").write_text(
+        "\n".join(str(900 + i) for i in range(0, 3000, 10))
+    )
+
+    strong = cf_elo.calculate_cf_elo(
+        {"1700A": [True], "1700B": [True], "1700C": [True]},
+        str(tmp_path),
+        str(tmp_path / "ratings.txt"),
+    )
+    weak = cf_elo.calculate_cf_elo(
+        {"1700A": [False, False], "1700B": [False], "1700C": [False]},
+        str(tmp_path),
+        str(tmp_path / "ratings.txt"),
+    )
+    assert strong["n_contests"] == 1 and weak["n_contests"] == 1
+    assert strong["elo"] > weak["elo"]
+    assert 0.0 <= weak["percentile"] <= strong["percentile"] <= 1.0
+
+    # unusable contests (too few participants) are skipped, not crashed
+    small_s, small_c = _synthetic_contest(n=50)
+    (tmp_path / "1701.json").write_text(
+        json.dumps({"standings": small_s, "rating_changes": small_c})
+    )
+    out = cf_elo.calculate_cf_elo({"1701A": [True]}, str(tmp_path))
+    assert out["n_contests"] == 0.0
