@@ -149,11 +149,16 @@ def calculate_cf_elo(
         path = os.path.join(cache_dir, f"{cid}.json")
         if not os.path.exists(path):
             continue
-        with open(path) as f:
-            cached = json.load(f)
-        r = calc_contest_elo(
-            cached["standings"], cached["rating_changes"], status, pass_n
-        )
+        try:
+            with open(path) as f:
+                cached = json.load(f)
+            r = calc_contest_elo(
+                cached["standings"], cached["rating_changes"], status, pass_n
+            )
+        except (json.JSONDecodeError, KeyError, OSError):
+            # a corrupt cache file skips this contest; per-contest shape
+            # errors one level deeper already do the same
+            continue
         if r is not None:
             ratings.append(r)
 

@@ -41,6 +41,21 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--overwrite", action="store_true")
     ap.add_argument(
+        "--cf-cache-dir", default=None,
+        help="directory of cached Codeforces contest data ({cid}.json); "
+             "when set, prompts whose qid is a contest problem id (e.g. "
+             "1700A) also aggregate to an estimated ELO",
+    )
+    ap.add_argument(
+        "--cf-ratings", default=None,
+        help="rating population file for the ELO percentile",
+    )
+    ap.add_argument(
+        "--cf-pass-n", type=int, default=None,
+        help="submission budget per problem for the ELO model (default: all "
+             "n_sampling generations count as ordered submissions)",
+    )
+    ap.add_argument(
         "--allow-token-id-answers", action="store_true",
         help="debug only: grade space-joined token-id strings when no "
              "tokenizer is available (real math grading needs one)",
@@ -110,6 +125,7 @@ def main(argv=None):
     )
 
     pass1, passk, rewards_all = [], [], []
+    cf_submissions = {}
     t0 = time.time()
     with open(out_samples, "w") as f:
         for lo in range(0, n, args.batch_prompts):
@@ -121,6 +137,8 @@ def main(argv=None):
                 answers = [decode(o.tokens[len(prompt):].tolist()) for o in group]
                 rws = math_reward_fn(qid, answers, metadata.get(qid, {}))
                 oks = [r > 0 for r in rws]
+                if args.cf_cache_dir:
+                    cf_submissions[qid] = oks
                 pass1.append(float(np.mean(oks)))
                 passk.append(float(any(oks)))
                 rewards_all.extend(rws)
@@ -143,6 +161,13 @@ def main(argv=None):
         "reward_mean": float(np.mean(rewards_all)) if rewards_all else 0.0,
         "wall_s": time.time() - t0,
     }
+    if args.cf_cache_dir:
+        from areal_tpu.apps import cf_elo
+
+        agg["cf"] = cf_elo.calculate_cf_elo(
+            cf_submissions, args.cf_cache_dir, args.cf_ratings,
+            pass_n=args.cf_pass_n,
+        )
     with open(out_agg, "w") as f:
         json.dump(agg, f, indent=2)
     logger.info("aggregate: %s", agg)

@@ -24,7 +24,19 @@ def main(argv=None):
         p.add_argument(
             "overrides", nargs="*", help="dotted overrides, e.g. a.b=1"
         )
-    args = parser.parse_args(argv)
+    sub.add_parser(
+        "profile",
+        description="timed train steps on synthetic data (see apps/profile.py)",
+    )
+    # profile owns its full argument surface (apps/profile.py): parse only
+    # the subcommand here and forward the rest
+    args, rest = parser.parse_known_args(argv)
+    if args.cmd == "profile":
+        from areal_tpu.apps.profile import main as profile_main
+
+        return profile_main(rest)
+    if rest:  # only profile forwards unknown args
+        parser.error(f"unrecognized arguments: {' '.join(rest)}")
 
     from areal_tpu.apps import launcher
     from areal_tpu.experiments import (

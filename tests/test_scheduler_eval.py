@@ -216,3 +216,22 @@ def test_cf_elo_end_to_end(tmp_path):
     )
     out = cf_elo.calculate_cf_elo({"1701A": [True]}, str(tmp_path))
     assert out["n_contests"] == 0.0
+
+
+def test_profile_experiment_runs():
+    """≈ the reference's null/profile experiment: timed steps on synthetic
+    data through the real engine, reporting step time and TFLOP/s."""
+    from areal_tpu.apps.profile import run_profile
+    from areal_tpu.experiments.config import ModelSpec
+
+    spec = ModelSpec(
+        arch=dict(
+            n_layers=1, n_q_heads=2, n_kv_heads=1, head_dim=8, hidden_dim=16,
+            intermediate_dim=32, vocab_size=64, dtype="float32",
+        ),
+        parallel="d2f2m2",
+    )
+    out = run_profile(spec, [12, 9, 14, 8], n_steps=2, n_warmup=1)
+    assert out["step_time_s"] > 0
+    assert out["tokens_per_s"] > 0
+    assert out["n_params"] > 0
