@@ -9,7 +9,8 @@ and SGLang's radix/paged KV memory. Redesigned for XLA:
 - KV memory is a POOL of fixed-size pages (``models/transformer.PagedKVCache``
   + ``gen/pages.py``); each slot holds a page table, so HBM scales with the
   tokens actually resident — not ``max_slots x max_seqlen`` slabs — and
-  identical prompts SHARE their full prompt pages (one prefill serves a
+  prompts SHARE pages for their longest common page-aligned prefix (a radix
+  tree over pages; one prefill serves a
   whole GRPO group; the reason gserver routing is sticky per qid).
 - Admission = CHUNKED PREFILL: prompts stream through a fixed
   ``[n_rows, page]`` extend program, so compile count is bounded by the
@@ -307,6 +308,7 @@ class GenerationEngine:
         admitted: List[Tuple[GenRequest, int, dict]] = []
         misses: List[dict] = []
         hits: List[dict] = []
+        deferred_inserts: List[Tuple[List[int], List[int]]] = []
         still_pending: List[GenRequest] = []
         with self._pending_lock:
             take = self._pending[: len(free) + 8]  # small lookahead
@@ -349,10 +351,20 @@ class GenerationEngine:
                 self.stats["prefix_hits"] += 1
                 self.stats["prefix_hit_tokens"] += covered
                 hits.append(row)
+                if self.enable_prefix_cache and n_shared_full > len(shared):
+                    # partial hit (e.g. shared system preamble): register the
+                    # divergent tail for future siblings — but only AFTER the
+                    # extend waves run. This slot's pages are written in wave
+                    # 2; inserting now would let a same-cycle borrower (also
+                    # wave 2) read them before they are written.
+                    n_new = n_shared_full - len(shared)
+                    deferred_inserts.append((ids, shared + owned[:n_new]))
             else:
                 misses.append(row)
                 if self.enable_prefix_cache and n_shared_full > 0:
-                    # register the full prompt pages for future group members
+                    # cold prompt: register immediately — its pages are
+                    # written in wave 1, so same-cycle group members can
+                    # borrow them in wave 2
                     self.prefix.insert(ids, list(owned[:n_shared_full]))
             self.stats["prefill_tokens"] += len(row["tokens"])
             self.stats["admitted"] += 1
@@ -368,6 +380,8 @@ class GenerationEngine:
         # or by earlier admissions)
         self._run_extends(misses)
         self._run_extends(hits)
+        for ins_ids, ins_pages in deferred_inserts:
+            self.prefix.insert(ins_ids, ins_pages)
         # commit slot state in row buckets
         i = 0
         while i < len(admitted):

@@ -4,9 +4,10 @@ Counterpart of SGLang's radix-tree + block allocator that the reference gets
 for free (``patch/sglang/v0.4.6.post4.patch``, SURVEY §2.1): the generation
 engine's KV memory is a pool of fixed-size pages; slots hold page tables
 instead of dense ``[S_max]`` slabs, so HBM scales with tokens actually
-resident, and identical prompts SHARE their full prompt pages via refcounts
-(one prefill serves a whole GRPO group — the reason gserver routing is
-sticky per qid).
+resident, and prompts SHARE pages for their longest common page-aligned
+prefix through a radix tree (one prefill serves a whole GRPO group — the
+reason gserver routing is sticky per qid — and prompts over one system
+preamble share the preamble pages).
 
 Device arrays live in the engine; this module is pure host bookkeeping
 (free list, refcounts, prefix registry) — no jax imports.
@@ -63,76 +64,128 @@ class PagePool:
 
 
 @dataclasses.dataclass
-class PrefixEntry:
-    pages: List[int]        # full prompt pages (page_size tokens each)
-    n_tokens: int           # tokens covered = len(pages) * page_size
-    last_used: int          # LRU tick
+class _RadixNode:
+    page: int                                   # resident page (one ref held)
+    children: Dict[Tuple[int, ...], "_RadixNode"]
+    last_used: int                              # LRU tick
 
 
 class PrefixRegistry:
-    """prompt prefix -> resident full pages (flat-key radix cache).
+    """Page-granular radix tree: prompt prefixes -> resident KV pages.
 
-    The reference's SGLang radix tree shares arbitrary prefixes; here sharing
-    is keyed on the FULL-PAGE prefix of the prompt (the dominant case —
-    group members of one qid have identical prompts). Entries hold one
-    refcount on their pages; hits add another for the borrowing slot.
-    Weight updates invalidate everything (KV from old params must not serve
+    The counterpart of SGLang's radix cache: each tree level is one page of
+    prompt tokens (the child key is that page's token tuple), so any two
+    prompts share pages for their longest common PAGE-ALIGNED prefix — a
+    GRPO group shares the whole prompt, different questions over one system
+    preamble share the preamble pages. The tree holds one refcount per
+    resident page; lookups take another for the borrowing slot. Weight
+    updates invalidate everything (KV from old params must not serve
     new-policy generations).
     """
 
     def __init__(self, pool: PagePool):
         self.pool = pool
-        self._entries: Dict[Tuple[int, ...], PrefixEntry] = {}
+        self._children: Dict[Tuple[int, ...], _RadixNode] = {}
         self._tick = 0
+        self._n_nodes = 0
 
     def __len__(self) -> int:
-        return len(self._entries)
+        return self._n_nodes  # resident pages held by the tree
 
-    def _key(self, prompt_ids: Sequence[int], n_pages: int) -> Tuple[int, ...]:
-        return tuple(prompt_ids[: n_pages * self.pool.page_size])
+    def _chunks(self, prompt_ids: Sequence[int], n_pages: int):
+        ps = self.pool.page_size
+        return [
+            tuple(prompt_ids[i * ps : (i + 1) * ps]) for i in range(n_pages)
+        ]
 
-    def lookup(self, prompt_ids: Sequence[int], n_full_pages: int) -> Optional[List[int]]:
-        """Pages covering the first ``n_full_pages`` of the prompt, with a
-        reference taken for the caller — or None."""
-        if n_full_pages == 0:
-            return None
-        e = self._entries.get(self._key(prompt_ids, n_full_pages))
-        if e is None:
+    def lookup(
+        self, prompt_ids: Sequence[int], n_full_pages: int
+    ) -> Optional[List[int]]:
+        """Pages covering the LONGEST cached page-aligned prefix of the
+        first ``n_full_pages`` pages (possibly fewer than requested), with a
+        reference taken for the caller — or None on a cold miss."""
+        if n_full_pages <= 0:
             return None
         self._tick += 1
-        e.last_used = self._tick
-        self.pool.ref(e.pages)
-        return list(e.pages)
+        pages: List[int] = []
+        children = self._children
+        for chunk in self._chunks(prompt_ids, n_full_pages):
+            node = children.get(chunk)
+            if node is None:
+                break
+            node.last_used = self._tick
+            pages.append(node.page)
+            children = node.children
+        if not pages:
+            return None
+        self.pool.ref(pages)
+        return pages
 
     def insert(self, prompt_ids: Sequence[int], pages: List[int]):
-        """Register freshly prefilled full-prompt pages. Takes its own
-        reference (caller keeps theirs)."""
-        if not pages:
-            return
-        key = self._key(prompt_ids, len(pages))
-        if key in self._entries:
-            return  # racing identical prompt; keep the existing entry
-        self.pool.ref(pages)
+        """Register a freshly covered page chain (shared prefix + newly
+        prefilled pages). Existing nodes are kept — a racing identical
+        prefill's duplicate page stays owned by its slot and is freed when
+        that slot finishes; new nodes take their own reference."""
         self._tick += 1
-        self._entries[key] = PrefixEntry(
-            pages=list(pages), n_tokens=len(pages) * self.pool.page_size,
-            last_used=self._tick,
-        )
+        children = self._children
+        for chunk, page in zip(self._chunks(prompt_ids, len(pages)), pages):
+            node = children.get(chunk)
+            if node is None:
+                self.pool.ref([page])
+                node = _RadixNode(page=page, children={}, last_used=self._tick)
+                children[chunk] = node
+                self._n_nodes += 1
+            else:
+                node.last_used = self._tick
+            children = node.children
 
     def evict_lru(self, n_pages_needed: int) -> int:
-        """Release least-recently-used entries until ``n_pages_needed`` could
-        be freed (entries whose pages are still borrowed by running slots
-        free nothing until those slots finish). Returns entries evicted."""
+        """Drop least-recently-used LEAVES (a node only goes after all its
+        descendants) until the pool could satisfy ``n_pages_needed`` (pages
+        still borrowed by running slots free nothing until those slots
+        finish). One DFS collects every node; parents become evictable as
+        their children go — O(tree) total, not O(tree) per page. Returns
+        pages evicted."""
+        if self.pool.n_free >= n_pages_needed:
+            return 0
+        import heapq
+
+        # one DFS: entry = [parent_children, key, node, n_live_children, idx]
+        entries: List[list] = []
+        parent_idx: Dict[int, int] = {}
+        stack = [(self._children, k, n, None) for k, n in self._children.items()]
+        while stack:
+            pc, k, n, pidx = stack.pop()
+            i = len(entries)
+            entries.append([pc, k, n, len(n.children)])
+            if pidx is not None:
+                parent_idx[i] = pidx
+            stack.extend((n.children, ck, cn, i) for ck, cn in n.children.items())
+        heap = [
+            (e[2].last_used, i) for i, e in enumerate(entries) if e[3] == 0
+        ]
+        heapq.heapify(heap)
         evicted = 0
-        for key in sorted(self._entries, key=lambda k: self._entries[k].last_used):
-            if self.pool.n_free >= n_pages_needed:
-                break
-            self.pool.release(self._entries.pop(key).pages)
+        while heap and self.pool.n_free < n_pages_needed:
+            _, i = heapq.heappop(heap)
+            pc, k, n, _ = entries[i]
+            self.pool.release([n.page])
+            del pc[k]
+            self._n_nodes -= 1
             evicted += 1
+            pi = parent_idx.get(i)
+            if pi is not None:
+                entries[pi][3] -= 1
+                if entries[pi][3] == 0:
+                    heapq.heappush(heap, (entries[pi][2].last_used, pi))
         return evicted
 
     def clear(self):
         """Invalidate everything (weight update)."""
-        for e in self._entries.values():
-            self.pool.release(e.pages)
-        self._entries.clear()
+        stack = list(self._children.values())
+        while stack:
+            n = stack.pop()
+            self.pool.release([n.page])
+            stack.extend(n.children.values())
+        self._children = {}
+        self._n_nodes = 0

@@ -203,7 +203,7 @@ class GenerationHTTPServer:
                 # paged KV pool + prefix cache observability
                 "pages_free": self.engine.pool.n_free,
                 "pages_total": self.engine.n_pages,
-                "prefix_entries": len(self.engine.prefix),
+                "prefix_pages": len(self.engine.prefix),
                 **{f"engine_{k}": v for k, v in self.engine.stats.items()},
             }
         )

@@ -54,14 +54,55 @@ class TestPagePool:
         assert pool.n_free == 6
         got = reg.lookup(prompt, 2)
         assert got == pages
-        # different prompt or length: miss
+        # radix: a shorter request hits the chain's prefix...
+        one = reg.lookup(prompt, 1)
+        assert one == pages[:1]
+        pool.release(one)
+        # ...and a prompt diverging in page 2 shares page 1 only
+        sib = prompt[:4] + [99] * 6
+        part = reg.lookup(sib, 2)
+        assert part == pages[:1]
+        pool.release(part)
+        # a prompt diverging in page 1: cold miss
         assert reg.lookup([9] + prompt[1:], 2) is None
-        assert reg.lookup(prompt, 1) is None
         pool.release(got)           # borrower done
         pool.release(pages)         # original owner done; registry ref remains
         assert pool.n_free == 6
         reg.evict_lru(8)            # need pages -> registry lets go
         assert pool.n_free == 8
+
+    def test_prefix_registry_radix_extends_chains(self):
+        """Sibling prompts extend the tree past the shared preamble, and LRU
+        eviction drops leaves before their parents."""
+        pool = PagePool(8, page_size=4)
+        reg = PrefixRegistry(pool)
+        pre = [1, 2, 3, 4]
+        a = pre + [5, 6, 7, 8]
+        b = pre + [9, 10, 11, 12]
+        pa = pool.alloc(2)
+        reg.insert(a, pa)                 # chain: pre -> a-tail
+        shared = reg.lookup(b, 2)         # sibling: preamble page only
+        assert shared == pa[:1]
+        pb_tail = pool.alloc(1)
+        reg.insert(b, shared + pb_tail)   # extend: pre -> b-tail
+        assert len(reg) == 3
+        full_b = reg.lookup(b, 2)
+        assert full_b == [pa[0], pb_tail[0]]
+        pool.release(full_b)
+        pool.release(shared)
+        pool.release(pa)
+        pool.release(pb_tail)
+        # all 3 pages held only by the tree (pool.n_free == 5 of 8). Demand
+        # 7 free: the tree must give up 2 pages — the two LEAF tails — and
+        # keep the shared preamble (their parent) resident.
+        assert pool.n_free == 5
+        evicted = reg.evict_lru(7)
+        assert evicted == 2 and pool.n_free == 7 and len(reg) == 1
+        got = reg.lookup(a, 1)
+        assert got == pa[:1]       # the preamble page survived
+        pool.release(got)
+        # demand everything: the remaining parent goes too
+        assert reg.evict_lru(8) == 1 and pool.n_free == 8 and len(reg) == 0
 
 
 class TestPrefixSharing:
@@ -121,7 +162,7 @@ class TestPrefixSharing:
         eng.submit(GenRequest(rid="a", input_ids=prompt, max_new_tokens=2,
                               greedy=True))
         eng.run_until_done(decode_steps=2)
-        assert len(eng.prefix) == 1
+        assert len(eng.prefix) == 2   # 2 full prompt pages resident
         eng.update_params(params, version=1)
         assert len(eng.prefix) == 0   # old-weight KV never seeds new rollouts
         eng.submit(GenRequest(rid="b", input_ids=prompt, max_new_tokens=2,
@@ -273,3 +314,59 @@ class TestPallasPagedDecode:
         )
         # empty slot (lens 0) outputs exact zeros on both paths
         assert np.all(np.asarray(got)[3] == 0)
+
+
+class TestRadixPartialPrefix:
+    def test_sibling_prompts_share_preamble_pages(self, params):
+        """Two prompts with a common 2-page system preamble but different
+        questions: the second admission borrows the preamble pages (partial
+        radix hit) and still produces exactly the generations a cold engine
+        would — the KV served from shared pages is the same."""
+        page = 8
+        rng = np.random.default_rng(3)
+        pre = [int(x) for x in rng.integers(1, 128, 16)]   # 2 full pages
+        qa = pre + [int(x) for x in rng.integers(1, 128, 5)]
+        qb = pre + [int(x) for x in rng.integers(1, 128, 5)]
+
+        eng = GenerationEngine(
+            CFG, params, max_slots=2, max_seqlen=64, page_size=page, seed=0,
+        )
+        eng.submit(GenRequest(rid="a", input_ids=qa, max_new_tokens=4, greedy=True))
+        out_a = eng.run_until_done(decode_steps=4)
+        eng.submit(GenRequest(rid="b", input_ids=qb, max_new_tokens=4, greedy=True))
+        out_b = eng.run_until_done(decode_steps=4)
+        # b's admission partially hit a's preamble (2 pages = 16 tokens)
+        assert eng.stats["prefix_hits"] == 1
+        assert eng.stats["prefix_hit_tokens"] == 16
+        # prefilled tokens: a's 20 (plen_eff) + b's 4 uncovered
+        assert eng.stats["prefill_tokens"] == 20 + 4
+
+        cold = GenerationEngine(
+            CFG, params, max_slots=2, max_seqlen=64, page_size=page, seed=0,
+        )
+        cold.submit(GenRequest(rid="b2", input_ids=qb, max_new_tokens=4, greedy=True))
+        ref_b = cold.run_until_done(decode_steps=4)
+        assert out_b[0].output_ids == ref_b[0].output_ids
+        assert out_a[0].output_ids != out_b[0].output_ids or qa == qb
+
+    def test_partial_hit_registers_divergent_tail(self, params):
+        """After a partial hit, the divergent tail joins the radix tree so a
+        THIRD prompt identical to the second fully hits."""
+        page = 8
+        rng = np.random.default_rng(4)
+        pre = [int(x) for x in rng.integers(1, 128, 16)]
+        qb = pre + [int(x) for x in rng.integers(1, 128, 9)]  # 3 full pages
+
+        eng = GenerationEngine(
+            CFG, params, max_slots=2, max_seqlen=64, page_size=page, seed=0,
+        )
+        eng.submit(GenRequest(rid="a", input_ids=pre + [1, 2], max_new_tokens=2, greedy=True))
+        eng.run_until_done(decode_steps=2)
+        eng.submit(GenRequest(rid="b", input_ids=qb, max_new_tokens=2, greedy=True))
+        eng.run_until_done(decode_steps=2)
+        hits_before = eng.stats["prefix_hit_tokens"]
+        eng.submit(GenRequest(rid="b-twin", input_ids=qb, max_new_tokens=2, greedy=True))
+        outs = eng.run_until_done(decode_steps=2)
+        # the twin borrows ALL 3 full pages (16 preamble + 8 tail)
+        assert eng.stats["prefix_hit_tokens"] - hits_before == 24
+        assert outs[0].finish_reason in ("stop", "length")
