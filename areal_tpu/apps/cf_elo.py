@@ -75,13 +75,16 @@ def contest_score(
 
 
 def rank_in_standings(rows: List[dict], score: float, penalty: float) -> int:
-    """1-based rank: first row strictly beaten by (score, penalty)."""
+    """Row index of the first standing strictly beaten by (score, penalty) —
+    the reference's 0-based convention (``cf_elo_caculator.py:139-145``:
+    ``rank = i``, default ``n``), kept bit-compatible so estimated ratings
+    agree seed-for-seed."""
     for i, row in enumerate(rows):
         if row["points"] < score or (
             row["points"] == score and row["penalty"] > penalty
         ):
-            return i + 1
-    return len(rows) + 1
+            return i
+    return len(rows)
 
 
 def calc_contest_elo(

@@ -53,6 +53,9 @@ class PagePool:
                 raise ValueError(f"page {p} is free; cannot share")
             self._ref[p] += 1
 
+    def refcount(self, page: int) -> int:
+        return int(self._ref[page])
+
     def release(self, pages: Sequence[int]):
         """Drop one reference per page; refcount 0 returns it to the pool."""
         for p in pages:
@@ -141,11 +144,13 @@ class PrefixRegistry:
 
     def evict_lru(self, n_pages_needed: int) -> int:
         """Drop least-recently-used LEAVES (a node only goes after all its
-        descendants) until the pool could satisfy ``n_pages_needed`` (pages
-        still borrowed by running slots free nothing until those slots
-        finish). One DFS collects every node; parents become evictable as
-        their children go — O(tree) total, not O(tree) per page. Returns
-        pages evicted."""
+        descendants) until the pool could satisfy ``n_pages_needed``. Nodes
+        whose page is still borrowed by a running slot (refcount > 1) are
+        SKIPPED, not dropped — releasing them frees nothing until the slot
+        finishes, so evicting would drain hot prefixes under transient
+        pressure without yielding a single page. One DFS collects every
+        node; parents become evictable as their children go — O(tree)
+        total, not O(tree) per page. Returns pages evicted."""
         if self.pool.n_free >= n_pages_needed:
             return 0
         import heapq
@@ -169,6 +174,10 @@ class PrefixRegistry:
         while heap and self.pool.n_free < n_pages_needed:
             _, i = heapq.heappop(heap)
             pc, k, n, _ = entries[i]
+            if self.pool.refcount(n.page) > 1:
+                # borrowed by a resident slot: evicting frees nothing and
+                # loses the prefix; leave this subtree alone
+                continue
             self.pool.release([n.page])
             del pc[k]
             self._n_nodes -= 1

@@ -54,6 +54,7 @@ casts would quarter MXU throughput).
 """
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -826,8 +827,28 @@ def packed_flash_attention(
     instead of the full causal rectangle — at short-segment packing most
     grid steps are out-of-band no-ops that still cost ~µs each, so this is
     a multi-x win. Segments longer than the bound get silently truncated
-    attention: callers must validate (the train engine does).
+    attention: callers must validate (the train engine does; any other
+    caller gets a device-side check under ``AREAL_DEBUG_CHECKS=1``). The
+    flag is read at TRACE time — set it before the first jit of a calling
+    step; flipping it later does not retrace cached programs.
     """
+    if max_seqlen is not None and os.environ.get("AREAL_DEBUG_CHECKS") == "1":
+        T = segment_ids.shape[0]
+        seg_max = jnp.max(
+            jnp.bincount(
+                jnp.where(segment_ids > 0, segment_ids, 0), length=T + 1
+            )[1:]
+        )
+
+        def _check(observed, bound=max_seqlen):
+            if int(observed) > bound:
+                raise ValueError(
+                    f"packed_flash_attention: a segment has {int(observed)} "
+                    f"tokens but max_seqlen={bound}; attention beyond the "
+                    "band would be silently truncated"
+                )
+
+        jax.debug.callback(_check, seg_max)
     return _flash_thd(
         q, k, v, segment_ids.astype(jnp.int32), softmax_scale, soft_cap,
         sliding_window, block_size, block_size, max_seqlen,

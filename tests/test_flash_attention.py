@@ -239,6 +239,26 @@ def test_flash_band_narrowing_matches_xla(rng, max_seqlen):
         )
 
 
+def test_band_violation_caught_under_debug_checks(rng, monkeypatch):
+    """AREAL_DEBUG_CHECKS=1 turns the silent over-band truncation into an
+    error: a segment longer than the static max_seqlen hint must raise
+    instead of returning truncated attention (advisor round-2 finding)."""
+    monkeypatch.setenv("AREAL_DEBUG_CHECKS", "1")
+    T, H, Hkv, D = 256, 2, 2, 16
+    q, k, v, seg = _mk(rng, T, H, Hkv, D, [200, 40])  # 200 > 128 bound
+    with pytest.raises(Exception, match="max_seqlen"):
+        out = packed_flash_attention(
+            q, k, v, seg, softmax_scale=D**-0.5, block_size=64, max_seqlen=128
+        )
+        jax.block_until_ready(out)
+    # respecting the bound stays silent
+    q, k, v, seg = _mk(rng, T, H, Hkv, D, [100, 40])
+    out = packed_flash_attention(
+        q, k, v, seg, softmax_scale=D**-0.5, block_size=64, max_seqlen=128
+    )
+    jax.block_until_ready(out)
+
+
 def test_engine_rejects_overlong_sequence():
     from areal_tpu.api.data import MicroBatchSpec, SequenceSample
     from areal_tpu.models.config import ModelConfig

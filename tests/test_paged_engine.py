@@ -104,6 +104,25 @@ class TestPagePool:
         # demand everything: the remaining parent goes too
         assert reg.evict_lru(8) == 1 and pool.n_free == 8 and len(reg) == 0
 
+    def test_evict_skips_pages_borrowed_by_running_slots(self):
+        """Evicting a page a resident slot still borrows frees nothing —
+        the tree must keep it (hot prefixes survive transient pressure)
+        instead of draining itself for zero freed pages."""
+        pool = PagePool(4, page_size=4)
+        reg = PrefixRegistry(pool)
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+        pages = pool.alloc(2)
+        reg.insert(prompt, pages)
+        borrowed = reg.lookup(prompt, 2)   # a running slot holds both pages
+        assert borrowed == pages
+        pool.release(pages)                # prefill owner done
+        assert pool.n_free == 2
+        # pressure: nothing evictable actually frees -> tree stays intact
+        assert reg.evict_lru(4) == 0
+        assert len(reg) == 2
+        pool.release(borrowed)             # slot finishes
+        assert reg.evict_lru(4) == 2 and pool.n_free == 4 and len(reg) == 0
+
 
 class TestPrefixSharing:
     def test_one_prefill_serves_group_of_8(self, params):

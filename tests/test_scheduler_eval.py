@@ -172,7 +172,7 @@ def test_cf_elo_score_and_rank_math():
     # rank: rows have points 600, 598, ... -> score 1450 beats rows with
     # points < 1450
     rank = cf_elo.rank_in_standings(standings["result"]["rows"], score, penalty)
-    assert rank == 1  # 2*(300-i) max is 600 < 1450
+    assert rank == 0  # 2*(300-i) max is 600 < 1450; 0-based like the reference
 
     # expected seed is monotone decreasing in rating
     old = [1200.0] * 100
