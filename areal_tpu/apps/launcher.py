@@ -89,25 +89,38 @@ def gen_server_main(cfg, server_idx: int):
     from areal_tpu.models import hf as hf_conv
 
     mcfg = cfg.actor.model_config()
+    mesh = None
+    tp = getattr(cfg.gen, "tp_size", 1)
+    if tp > 1:
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devs = jax.local_devices()
+        lo = server_idx * tp
+        if lo + tp > len(devs):
+            raise ValueError(
+                f"gen server {server_idx} needs devices [{lo}, {lo + tp}) "
+                f"but only {len(devs)} local devices exist; lower "
+                "gen.n_servers or gen.tp_size"
+            )
+        mesh = Mesh(np.array(devs[lo : lo + tp]), ("model",))
     if cfg.actor.path:
         _, host_params = hf_conv.load_hf_checkpoint(cfg.actor.path)
-        import jax.numpy as jnp
-
-        params = jax.tree.map(
-            lambda x: jnp.asarray(x, jnp.dtype(mcfg.dtype)), host_params
-        )
     else:
         from areal_tpu.models import transformer as tfm
 
-        params = tfm.init_params(mcfg, jax.random.key(0))
+        host_params = tfm.init_params(mcfg, jax.random.key(0))
     engine = GenerationEngine(
         mcfg,
-        params,
+        host_params,  # cast + TP-shard happen inside (prepare_params)
         max_slots=cfg.gen.max_slots,
         max_seqlen=cfg.gen.max_seqlen,
         max_new_tokens_cap=cfg.gen.max_new_tokens_cap,
         stop_token_ids=cfg.gen.stop_token_ids,
         seed=cfg.seed + server_idx,
+        page_size=cfg.gen.page_size,
+        n_pages=cfg.gen.n_pages,
+        mesh=mesh,
     )
 
     async def main():

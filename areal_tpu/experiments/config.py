@@ -74,6 +74,14 @@ class GenFleetSpec:
     decode_steps_per_chunk: int = 16
     stop_token_ids: List[int] = dataclasses.field(default_factory=list)
     device: str = ""                 # "" = default; "cpu" forces CPU servers
+    # tensor parallelism per server: each server owns tp_size chips and
+    # serves the model sharded over a `model` mesh axis (the reference's
+    # per-TP-group SGLang servers, realhf/api/cli_args.py:266). 1 = one
+    # chip per server. Servers take disjoint device blocks:
+    # server i uses local devices [i*tp_size, (i+1)*tp_size).
+    tp_size: int = 1
+    page_size: int = 128
+    n_pages: Optional[int] = None    # KV pool size; None = max_slots * tables
 
 
 @dataclasses.dataclass

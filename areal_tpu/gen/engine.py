@@ -25,6 +25,15 @@ and SGLang's radix/paged KV memory. Redesigned for XLA:
   weights must not seed new-policy generations; in-flight slots keep their
   old-KV context, which is exactly the partial-rollout staleness the
   version_start/version_end tags account for.
+- Tensor parallelism: pass a ``mesh`` with a ``model`` axis and the engine
+  serves SHARDED — params split per ``GEN_RULES`` (the trainer's TP axes,
+  embed replicated), the KV page pool splits on its kv-head axis, and the
+  jitted extend/decode programs carry explicit in/out shardings so GSPMD
+  partitions attention per head group and psums the projections, exactly
+  where the reference's per-TP-group SGLang servers put NCCL
+  (``realhf/system/generation_server.py:150``). Sampling runs replicated
+  after one logits all-gather. This is what lets one server hold a 7B
+  model across 4 v5e chips (bf16 weights ~3.5 GB/chip + KV pool).
 
 Thread-safety: ``submit`` arrives on the server's asyncio thread while
 ``step`` runs in an executor thread — ALL mutable engine state
@@ -39,11 +48,24 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from areal_tpu.gen.pages import OutOfPagesError, PagePool, PrefixRegistry
 from areal_tpu.gen.sampling import SamplingParams, sample_tokens
 from areal_tpu.models import transformer as tfm
 from areal_tpu.models.config import ModelConfig
+
+# Serving-side sharding rules: tensor parallelism only. Params shard over
+# the ``model`` mesh axis exactly where the trainer's TP does (heads / mlp /
+# vocab / expert logical axes); the ``embed`` logical axis stays REPLICATED
+# — FSDP-style gathering is a training trade (params live once, gathered
+# per layer) that would put an all-gather in every decode step here.
+# Counterpart of the reference's per-TP-group SGLang servers
+# (``realhf/api/cli_args.py:266`` SGLang tp_size,
+# ``realhf/system/generation_server.py:150``).
+from areal_tpu.parallel.mesh import DEFAULT_RULES as _TRAIN_RULES
+
+GEN_RULES: Dict[str, Optional[str]] = {**_TRAIN_RULES, "embed": None}
 
 
 @jax.tree_util.register_dataclass
@@ -106,9 +128,34 @@ class GenerationEngine:
         page_size: int = 128,
         n_pages: Optional[int] = None,
         enable_prefix_cache: bool = True,
+        mesh: Optional[Mesh] = None,
     ):
         self.cfg = cfg
-        self.params = params
+        self.mesh = mesh
+        if mesh is not None:
+            if "model" not in mesh.axis_names:
+                raise ValueError(
+                    f"generation mesh needs a 'model' axis, got {mesh.axis_names}"
+                )
+            tp = mesh.shape["model"]
+            for dim, name in (
+                (cfg.n_kv_heads, "n_kv_heads"),
+                (cfg.n_q_heads, "n_q_heads"),
+                (cfg.vocab_size, "vocab_size"),
+            ):
+                if dim % tp != 0:
+                    raise ValueError(
+                        f"tensor-parallel generation needs {name} ({dim}) "
+                        f"divisible by the model-axis size {tp}"
+                    )
+            self._repl = NamedSharding(mesh, P())
+            self._pages_sh = NamedSharding(mesh, P(None, None, None, "model", None))
+            from areal_tpu.parallel.mesh import param_shardings
+
+            self._param_sh = param_shardings(
+                mesh, tfm.param_logical_axes(cfg), GEN_RULES
+            )
+        self.params = self.prepare_params(params)
         self.B = max_slots
         self.page = page_size
         self.M = -(-max_seqlen // page_size)      # table width (pages/slot)
@@ -123,20 +170,41 @@ class GenerationEngine:
         self.n_pages = n_pages if n_pages is not None else self.B * self.M
         self.pool = PagePool(self.n_pages, page_size)
         self.prefix = PrefixRegistry(self.pool)
-        self.state = GenState(
-            cache=tfm.PagedKVCache.empty(cfg, self.n_pages, page_size),
-            lens=jnp.zeros((self.B,), jnp.int32),
-            last_tokens=jnp.zeros((self.B,), jnp.int32),
-            active=jnp.zeros((self.B,), bool),
-            n_gen=jnp.zeros((self.B,), jnp.int32),
-            min_gen=jnp.zeros((self.B,), jnp.int32),
-            max_gen=jnp.zeros((self.B,), jnp.int32),
-            stop_ids=jnp.full((self.B, self.max_stop_ids), -1, jnp.int32),
-            out_tokens=jnp.zeros((self.B, self.G), jnp.int32),
-            out_logprobs=jnp.zeros((self.B, self.G), jnp.float32),
-            sp=SamplingParams.filled(self.B),
-            rng=jax.random.key(seed),
-        )
+
+        def make_state() -> GenState:
+            return GenState(
+                cache=tfm.PagedKVCache.empty(cfg, self.n_pages, page_size),
+                lens=jnp.zeros((self.B,), jnp.int32),
+                last_tokens=jnp.zeros((self.B,), jnp.int32),
+                active=jnp.zeros((self.B,), bool),
+                n_gen=jnp.zeros((self.B,), jnp.int32),
+                min_gen=jnp.zeros((self.B,), jnp.int32),
+                max_gen=jnp.zeros((self.B,), jnp.int32),
+                stop_ids=jnp.full((self.B, self.max_stop_ids), -1, jnp.int32),
+                out_tokens=jnp.zeros((self.B, self.G), jnp.int32),
+                out_logprobs=jnp.zeros((self.B, self.G), jnp.float32),
+                sp=SamplingParams.filled(self.B),
+                rng=jax.random.key(seed),
+            )
+
+        if mesh is None:
+            self._state_sh = None
+            self.state = make_state()
+        else:
+            # the KV pool shards on its Hkv axis; everything else replicates.
+            # Creating the state UNDER jit with out_shardings lands each pool
+            # shard directly on its device — no transient full-size buffer.
+            sh = jax.tree.map(
+                lambda _: self._repl, jax.eval_shape(make_state)
+            )
+            sh = dataclasses.replace(
+                sh,
+                cache=tfm.PagedKVCache(
+                    k_pages=self._pages_sh, v_pages=self._pages_sh
+                ),
+            )
+            self._state_sh = sh
+            self.state = jax.jit(make_state, out_shardings=sh)()
         self.accepting = True  # False = decode only, no new admissions
         self.paused = False
         self._slots: List[Optional[_SlotInfo]] = [None] * self.B
@@ -188,10 +256,24 @@ class GenerationEngine:
         admit buckets + decode chunk sizes, NOT by prompt lengths)."""
         return len(self._jit_extend) + len(self._jit_commit) + len(self._jit_chunk)
 
+    def prepare_params(self, params):
+        """Cast a (host or device) param pytree to the serving dtype and,
+        when TP-sharded, place each leaf on its mesh shard. Numpy leaves cast
+        on host so no full-size unsharded buffer ever lands on one device."""
+        dt = jnp.dtype(self.cfg.dtype)
+        params = jax.tree.map(
+            lambda x: x if x.dtype == dt else x.astype(dt), params
+        )
+        if self.mesh is not None:
+            return jax.device_put(params, self._param_sh)
+        return jax.tree.map(jnp.asarray, params)
+
     def update_params(self, params, version: Optional[int] = None):
         """Hot weight swap between decode chunks (≈ interrupt + reload).
         Invalidates the prefix cache: prompt KV computed under old weights
         must not seed new generations."""
+        if self.mesh is not None:
+            params = jax.device_put(params, self._param_sh)
         with self._lock:
             self.params = params
             self.version = version if version is not None else self.version + 1
@@ -226,9 +308,20 @@ class GenerationEngine:
             )
             return dataclasses.replace(state, cache=cache)
 
-        jitted = jax.jit(extend, donate_argnums=(1,))
+        jitted = jax.jit(extend, donate_argnums=(1,), **self._jit_sharding(4))
         self._jit_extend[n_rows] = jitted
         return jitted
+
+    def _jit_sharding(self, n_host_args: int, with_params: bool = True):
+        """in/out sharding kwargs for the engine's jitted programs (empty
+        without a mesh): params on their TP shards, state on its (pool
+        sharded, rest replicated) shardings, host-side arrays replicated."""
+        if self.mesh is None:
+            return {}
+        ins = ((self._param_sh,) if with_params else ()) + (
+            self._state_sh,
+        ) + (self._repl,) * n_host_args
+        return {"in_shardings": ins, "out_shardings": self._state_sh}
 
     def _commit_fn(self, n_rows: int):
         if n_rows in self._jit_commit:
@@ -254,7 +347,10 @@ class GenerationEngine:
                 ),
             )
 
-        jitted = jax.jit(commit, donate_argnums=(0,))
+        jitted = jax.jit(
+            commit, donate_argnums=(0,),
+            **self._jit_sharding(9, with_params=False),
+        )
         self._jit_commit[n_rows] = jitted
         return jitted
 
@@ -434,6 +530,11 @@ class GenerationEngine:
                 params, cfg, state.cache, state.last_tokens, table,
                 state.lens, state.active,
             )
+            if self.mesh is not None:
+                # one explicit all-gather of the [B, V] logits: sampling
+                # (sort-based top-k/top-p) runs replicated instead of
+                # through compiler-chosen per-op resharding
+                logits = jax.lax.with_sharding_constraint(logits, self._repl)
             rng, sub = jax.random.split(state.rng)
             tokens, lp = sample_tokens(sub, logits, state.sp)
             tokens = jnp.where(state.active, tokens, state.last_tokens)
@@ -469,7 +570,7 @@ class GenerationEngine:
             state, _ = jax.lax.scan(body, state, None, length=n_steps)
             return state
 
-        jitted = jax.jit(chunk, donate_argnums=(1,))
+        jitted = jax.jit(chunk, donate_argnums=(1,), **self._jit_sharding(1))
         self._jit_chunk[n_steps] = jitted
         return jitted
 

@@ -170,12 +170,9 @@ class GenerationHTTPServer:
     def _load_params(self, path: str):
         from areal_tpu.models import hf as hf_conv
 
-        cfg, host_params = hf_conv.load_hf_checkpoint(path)
-        import jax
-        import jax.numpy as jnp
-
-        dt = jnp.dtype(self.engine.cfg.dtype)
-        return jax.tree.map(lambda x: jnp.asarray(x, dt), host_params)
+        _, host_params = hf_conv.load_hf_checkpoint(path)
+        # cast + (when TP-sharded) mesh placement
+        return self.engine.prepare_params(host_params)
 
     async def _pause(self, request: web.Request) -> web.Response:
         async with self._lock:

@@ -145,3 +145,86 @@ def test_sampling_reproducible_and_diverse(params):
         ))
     outs = {o.rid: o.output_ids for o in eng.run_until_done(decode_steps=4)}
     assert len(set(map(tuple, outs.values()))) > 1  # samples differ across slots
+
+
+# --------------------------------------------------------------------------- #
+# Tensor-parallel serving (VERDICT r2 #1): engine over a `model` mesh
+# --------------------------------------------------------------------------- #
+
+
+def _tp_mesh(n):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:n]), ("model",))
+
+
+class TestTensorParallelEngine:
+    def test_tp2_greedy_matches_single_device(self, params, rng):
+        """A 2-way TP engine must generate the same greedy chains as the
+        unsharded engine (counterpart of the reference's per-TP-group SGLang
+        servers, realhf/system/generation_server.py:150)."""
+        prompts = [
+            [int(x) for x in rng.integers(1, 128, size=n)] for n in (5, 9, 3)
+        ]
+        eng1 = GenerationEngine(CFG, params, max_slots=4, max_seqlen=128)
+        eng2 = GenerationEngine(
+            CFG, params, max_slots=4, max_seqlen=128, mesh=_tp_mesh(2)
+        )
+        for eng in (eng1, eng2):
+            for i, p in enumerate(prompts):
+                eng.submit(GenRequest(
+                    rid=f"r{i}", input_ids=p, max_new_tokens=8, greedy=True
+                ))
+        o1 = {o.rid: o for o in eng1.run_until_done(decode_steps=4)}
+        o2 = {o.rid: o for o in eng2.run_until_done(decode_steps=4)}
+        assert set(o1) == set(o2)
+        for rid in o1:
+            assert o1[rid].output_ids == o2[rid].output_ids, rid
+            np.testing.assert_allclose(
+                o1[rid].output_logprobs, o2[rid].output_logprobs, atol=1e-4
+            )
+
+    def test_tp_pool_is_sharded_and_weight_swap_reshards(self, params):
+        mesh = _tp_mesh(2)
+        eng = GenerationEngine(
+            CFG, params, max_slots=2, max_seqlen=128, mesh=mesh
+        )
+        # KV pool shards over the kv-head axis: each device holds half
+        kshard = eng.state.cache.k_pages.sharding
+        assert kshard.spec == jax.sharding.PartitionSpec(
+            None, None, None, "model", None
+        )
+        # wq shards on its head-output column axis
+        wq = eng.params["layers"]["attn"]["wq"]
+        assert wq.sharding.spec[-1] == "model"
+        # hot swap from UNSHARDED host params lands back on the mesh
+        host = jax.tree.map(np.asarray, tfm.init_params(CFG, jax.random.key(9)))
+        eng.update_params(eng.prepare_params(host), version=2)
+        assert eng.params["layers"]["attn"]["wq"].sharding.spec[-1] == "model"
+        eng.submit(GenRequest(rid="a", input_ids=[1, 2, 3], max_new_tokens=2))
+        outs = eng.run_until_done(decode_steps=2)
+        assert outs[0].version == 2
+
+    def test_tp_prefix_sharing_and_sampling(self, params):
+        """Radix prefix sharing + stochastic sampling still work sharded."""
+        mesh = _tp_mesh(2)
+        eng = GenerationEngine(
+            CFG, params, max_slots=4, max_seqlen=256, page_size=4, seed=0,
+            mesh=mesh,
+        )
+        prompt = [5, 6, 7, 8, 9, 10, 11]  # 1 full page shared
+        for i in range(4):
+            eng.submit(GenRequest(
+                rid=f"s{i}", input_ids=prompt, max_new_tokens=8,
+                temperature=1.0, top_p=0.95,
+            ))
+        outs = {o.rid: o.output_ids for o in eng.run_until_done(decode_steps=4)}
+        assert len(outs) == 4
+        assert eng.stats["prefix_hits"] >= 3
+        assert len(set(map(tuple, outs.values()))) > 1
+
+    def test_tp_rejects_indivisible_heads(self, params):
+        bad = dataclasses.replace(CFG, n_kv_heads=3, n_q_heads=3)
+        p3 = tfm.init_params(bad, jax.random.key(0))
+        with pytest.raises(ValueError, match="divisible"):
+            GenerationEngine(bad, p3, max_slots=2, mesh=_tp_mesh(2))
