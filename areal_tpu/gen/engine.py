@@ -129,6 +129,7 @@ class GenerationEngine:
         n_pages: Optional[int] = None,
         enable_prefix_cache: bool = True,
         mesh: Optional[Mesh] = None,
+        admit_chunk_tokens: Optional[int] = None,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -162,6 +163,17 @@ class GenerationEngine:
         self.S = self.M * page_size
         self.G = max_new_tokens_cap
         self.version = 0
+        # prefill streams through [n_rows, admit_chunk] extend programs;
+        # bigger chunks amortize the per-chunk attention over resident KV
+        # (31.5k prompt at chunk 128 = 246 waves each re-reading the whole
+        # prefix; at 2048 = 16 waves) at the cost of padding short prompts
+        # up to one chunk. Default: one page (exact, best for short prompts).
+        if admit_chunk_tokens is None:
+            self.admit_chunk = page_size
+        else:
+            self.admit_chunk = max(
+                page_size, -(-admit_chunk_tokens // page_size) * page_size
+            )
         self.admit_buckets = sorted(admit_buckets)
         self.global_stop_ids = list(stop_token_ids)
         self.max_stop_ids = 8
@@ -209,6 +221,14 @@ class GenerationEngine:
         self.paused = False
         self._slots: List[Optional[_SlotInfo]] = [None] * self.B
         self._table_host = np.zeros((self.B, self.M), np.int32)
+        # host mirror of per-slot resident lengths: admission knows them
+        # exactly, each chunk's sync refreshes them — lets decode chunks
+        # run width-limited (see _table_width) without extra device pulls
+        self._lens_host = np.zeros((self.B,), np.int64)
+        # host mirror of "does this slot warp" (top-p/top-k active): when
+        # no resident slot warps, the decode chunk skips the [B, V] sort —
+        # the most expensive op of a step at a 152k vocab
+        self._warp_host = np.zeros((self.B,), bool)
         self._pending: List[GenRequest] = []
         self._req_meta: Dict[str, GenRequest] = {}
         # Two-tier locking: `_lock` guards device state / slots / pool and is
@@ -297,9 +317,24 @@ class GenerationEngine:
     # Admission: chunked prefill through the page pool
     # ------------------------------------------------------------------ #
 
-    def _extend_fn(self, n_rows: int):
-        if n_rows in self._jit_extend:
-            return self._jit_extend[n_rows]
+    def _table_width(self, max_pos: int) -> int:
+        """Static page-table width for a program that touches positions up
+        to ``max_pos``: enough pages, rounded up to a power of two, floored
+        at 32. The XLA gather that backs paged attention then reads
+        O(resident) pages instead of the full table — at a 256-page (32k)
+        table this turns chunked prefill from quadratic to ~linear HBM
+        traffic — while jit specializations stay bounded by log2 width
+        buckets (never by prompt length)."""
+        need = -(-max_pos // self.page)
+        w = 32
+        while w < need:
+            w *= 2
+        return min(w, self.M)
+
+    def _extend_fn(self, n_rows: int, width: int):
+        key = (n_rows, width)
+        if key in self._jit_extend:
+            return self._jit_extend[key]
         cfg = self.cfg
 
         def extend(params, state: GenState, tokens, table_rows, start, n_new):
@@ -309,7 +344,7 @@ class GenerationEngine:
             return dataclasses.replace(state, cache=cache)
 
         jitted = jax.jit(extend, donate_argnums=(1,), **self._jit_sharding(4))
-        self._jit_extend[n_rows] = jitted
+        self._jit_extend[key] = jitted
         return jitted
 
     def _jit_sharding(self, n_host_args: int, with_params: bool = True):
@@ -361,11 +396,13 @@ class GenerationEngine:
         )
 
     def _run_extends(self, rows: List[dict]):
-        """Stream each row's tokens through fixed [n_rows, page] extend
-        programs (rows: dicts with tokens/start/table_row)."""
+        """Stream each row's tokens through fixed [n_rows, admit_chunk]
+        extend programs (rows: dicts with tokens/start/table_row). Each
+        wave's program sees only the table prefix its positions can touch
+        (``_table_width``)."""
         if not rows:
             return
-        C = self.page
+        C = self.admit_chunk
         i = 0
         while i < len(rows):
             n = self._row_bucket(len(rows) - i)
@@ -382,15 +419,17 @@ class GenerationEngine:
                 starts0[j] = r["start"]
                 all_tokens[j, : len(r["tokens"])] = r["tokens"]
                 counts[j] = len(r["tokens"])
-            extend = self._extend_fn(n)
             for c in range(n_chunks):
                 n_new = np.clip(counts - c * C, 0, C)
                 if not n_new.any():
                     break
+                max_pos = int(np.max(starts0 + np.minimum(counts, (c + 1) * C)))
+                W = self._table_width(max_pos)
+                extend = self._extend_fn(n, W)
                 self.state = extend(
                     self.params, self.state,
                     jnp.asarray(all_tokens[:, c * C : (c + 1) * C]),
-                    jnp.asarray(tables),
+                    jnp.asarray(tables[:, :W]),
                     jnp.asarray(starts0 + c * C),
                     jnp.asarray(n_new),
                 )
@@ -499,6 +538,10 @@ class GenerationEngine:
                 slots[j] = slot
                 last_toks[j] = ids[-1]
                 lens[j] = len(ids) - 1
+                self._lens_host[slot] = len(ids) - 1
+                self._warp_host[slot] = (
+                    r.top_p < 1.0 or r.top_k < self.cfg.vocab_size
+                ) and not r.greedy and r.temperature > 0.0
                 temp[j] = 0.0 if r.greedy else r.temperature
                 top_p[j] = r.top_p
                 top_k[j] = min(r.top_k, 1 << 30)
@@ -520,9 +563,10 @@ class GenerationEngine:
     # Decode
     # ------------------------------------------------------------------ #
 
-    def _chunk_fn(self, n_steps: int):
-        if n_steps in self._jit_chunk:
-            return self._jit_chunk[n_steps]
+    def _chunk_fn(self, n_steps: int, width: int, warp: bool):
+        key = (n_steps, width, warp)
+        if key in self._jit_chunk:
+            return self._jit_chunk[key]
         cfg = self.cfg
 
         def one_step(state: GenState, params, table):
@@ -536,7 +580,7 @@ class GenerationEngine:
                 # through compiler-chosen per-op resharding
                 logits = jax.lax.with_sharding_constraint(logits, self._repl)
             rng, sub = jax.random.split(state.rng)
-            tokens, lp = sample_tokens(sub, logits, state.sp)
+            tokens, lp = sample_tokens(sub, logits, state.sp, warp=warp)
             tokens = jnp.where(state.active, tokens, state.last_tokens)
             rows = jnp.arange(tokens.shape[0])
             idx = jnp.clip(state.n_gen, 0, state.out_tokens.shape[1] - 1)
@@ -571,7 +615,7 @@ class GenerationEngine:
             return state
 
         jitted = jax.jit(chunk, donate_argnums=(1,), **self._jit_sharding(1))
-        self._jit_chunk[n_steps] = jitted
+        self._jit_chunk[key] = jitted
         return jitted
 
     def _harvest(
@@ -598,6 +642,8 @@ class GenerationEngine:
         if info.borrowed:
             self.pool.release(info.borrowed)
         self._table_host[b] = 0
+        self._lens_host[b] = 0
+        self._warp_host[b] = False
         self.state = dataclasses.replace(
             self.state,
             active=self.state.active.at[b].set(False),
@@ -621,14 +667,23 @@ class GenerationEngine:
             self._admit_pending()
             if self.n_running() == 0:
                 return []
-            chunk = self._chunk_fn(decode_steps)
+            # width-limit the chunk to the pages this chunk can touch
+            running = [b for b, s in enumerate(self._slots) if s is not None]
+            W = self._table_width(
+                int(self._lens_host[running].max()) + decode_steps
+            )
+            chunk = self._chunk_fn(
+                decode_steps, W, bool(self._warp_host[running].any())
+            )
             self.state = chunk(
-                self.params, self.state, jnp.asarray(self._table_host)
+                self.params, self.state, jnp.asarray(self._table_host[:, :W])
             )
             # one host sync per chunk
-            active = np.asarray(self.state.active)
-            n_gen = np.asarray(self.state.n_gen)
-            max_gen = np.asarray(self.state.max_gen)
+            active, n_gen, max_gen, lens = jax.device_get(
+                (self.state.active, self.state.n_gen, self.state.max_gen,
+                 self.state.lens)
+            )
+            self._lens_host[:] = lens
             outs = []
             for b, info in enumerate(self._slots):
                 if info is None or active[b]:

@@ -35,29 +35,33 @@ class SamplingParams:
 
 def warp_logits(logits: jnp.ndarray, sp: SamplingParams) -> jnp.ndarray:
     """[B, V] -> warped [B, V] (fp32). Greedy slots (temperature 0) pass
-    through — the sampler handles them with argmax."""
+    through — the sampler handles them with argmax.
+
+    ONE descending sort serves both warpers (a [B, V] sort at a 152k vocab
+    is the dominant cost of a decode step — the original
+    sort-per-warper formulation was 3 sorts): top-k masks the sorted TAIL
+    (suffix positions >= k), top-p thresholds the cumulative mass over the
+    same masked sorted array, and both come back to the unsorted layout as
+    VALUE comparisons — which also preserves keep-ties-at-the-threshold
+    semantics."""
     logits = logits.astype(jnp.float32)
     B, V = logits.shape
     temp = jnp.maximum(sp.temperature, 1e-6)[:, None]
     logits = logits / temp
 
-    # top-k: threshold at the k-th largest value per row
     sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
-    k_idx = jnp.clip(sp.top_k - 1, 0, V - 1)
-    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
-    logits = jnp.where(logits < kth, NEG_INF, logits)
-
-    # top-p: keep the smallest prefix of the sorted distribution with
-    # cumulative mass >= top_p (the first token always survives)
-    probs_desc = jax.nn.softmax(jnp.sort(logits, axis=-1)[:, ::-1], axis=-1)
+    # top-k in sorted space: mask suffix positions
+    pos = jnp.arange(V)[None, :]
+    masked_desc = jnp.where(pos < sp.top_k[:, None], sorted_desc, NEG_INF)
+    # top-p over the top-k-masked distribution (still sorted descending)
+    probs_desc = jax.nn.softmax(masked_desc, axis=-1)
     cum = jnp.cumsum(probs_desc, axis=-1)
-    keep_desc = (cum - probs_desc) < sp.top_p[:, None]
-    # threshold value: smallest logit still kept
-    n_keep = jnp.maximum(keep_desc.sum(-1), 1)
-    sorted_logits_desc = jnp.sort(logits, axis=-1)[:, ::-1]
-    thresh = jnp.take_along_axis(
-        sorted_logits_desc, (n_keep - 1)[:, None], axis=-1
+    keep_desc = ((cum - probs_desc) < sp.top_p[:, None]) & (
+        pos < sp.top_k[:, None]
     )
+    # threshold value: smallest logit still kept (first token always kept)
+    n_keep = jnp.maximum(keep_desc.sum(-1), 1)
+    thresh = jnp.take_along_axis(sorted_desc, (n_keep - 1)[:, None], axis=-1)
     return jnp.where(logits < thresh, NEG_INF, logits)
 
 
@@ -66,13 +70,25 @@ def sample_tokens(
     logits: jnp.ndarray,
     sp: SamplingParams,
     greedy: Optional[jnp.ndarray] = None,
+    warp: bool = True,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Sample one token per slot. Returns (tokens [B] i32, logprobs [B] f32).
 
     ``logprobs`` are w.r.t. the *warped* distribution (matching SGLang's
     returned logprobs under sampling parameters).
+
+    ``warp=False`` (STATIC) skips the top-k/top-p warp entirely — pure
+    temperature sampling needs no ``[B, V]`` sort, and the sort is the
+    single most expensive op of a decode step at a 152k vocab. Callers that
+    know no request warps (the engine tracks this host-side) pass False;
+    the result is EXACT either way.
     """
-    warped = warp_logits(logits, sp)
+    if warp:
+        warped = warp_logits(logits, sp)
+    else:
+        warped = logits.astype(jnp.float32) / jnp.maximum(
+            sp.temperature, 1e-6
+        )[:, None]
     logp = jax.nn.log_softmax(warped, axis=-1)
     sampled = jax.random.categorical(rng, warped, axis=-1)
     arg = jnp.argmax(logits, axis=-1)

@@ -5,111 +5,227 @@ SGLang/vLLM CUDA kernels. KV lives in a pool ``[n_pages, page, Hkv, D]``
 (per layer); each slot owns a page TABLE ``[M]`` instead of a dense slab, so
 HBM scales with resident tokens and identical prompts share pages.
 
+DESIGN: the pool is READ-ONLY inside these ops. The caller's layer scan
+passes each layer's pages as scan xs and the CURRENT tokens' K/V as
+separate operands; attention folds the fresh tokens in analytically
+(online-softmax merge of the pool part and the self/intra-chunk part), and
+the model writes all layers' new KV into the pool in ONE scatter after the
+scan. The previous formulation updated the pool inside the layer scan,
+which forced XLA to stream the whole multi-GB pool through the scan's
+stacked outputs every decode step (dynamic-update-slice + copy ≈ 30 ms/step
+at a 1.5B/64-slot profile — measured, round-3 xprof).
+
 Two implementations:
-- XLA gather path (here): gather the slot's pages into a contiguous view and
-  reuse the dense attention math — correct everywhere (CPU tests), with a
-  per-step gather the compiler fuses reasonably;
+- XLA gather path (here): gather the slot's pages into a contiguous view —
+  correct everywhere (CPU tests); callers pass width-limited tables so the
+  gather reads O(resident) pages.
 - Pallas kernel (``ops/pallas/paged_attention.py``): reads pages in place
   via scalar-prefetch table indices on TPU — no materialized gather.
 """
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from areal_tpu.ops import attention as attn_ops
-
 _NEG_INF = -2.3819763e38
 
 
-def gather_pages(pages: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
-    """``[P, page, Hkv, D]`` + table ``[B, M]`` -> ``[B, M*page, Hkv, D]``
-    (a contiguous per-slot view; garbage beyond the slot's length, masked by
-    the caller's ``lens``)."""
+def gather_pages(pages: jnp.ndarray, table: jnp.ndarray, layer=None) -> jnp.ndarray:
+    """``[P, page, Hkv, D]`` (or ``[L, P, ...]`` + ``layer``) + table
+    ``[B, M]`` -> ``[B, M*page, Hkv, D]`` (a contiguous per-slot view;
+    garbage beyond the slot's length, masked by the caller's ``lens``).
+    With a layer index the gather fuses the layer dimension — no
+    materialized ``[P, page, Hkv, D]`` layer slice."""
     B, M = table.shape
-    g = pages[table]                       # [B, M, page, Hkv, D]
-    return g.reshape(B, M * pages.shape[1], *pages.shape[2:])
+    if layer is None:
+        g = pages[table]                   # [B, M, page, Hkv, D]
+    else:
+        g = pages[layer, table]
+    return g.reshape(B, M * g.shape[2], *g.shape[3:])
 
 
 def paged_decode_attention(
     q: jnp.ndarray,          # [B, H, D] one new token per slot
-    k_pages: jnp.ndarray,    # [P, page, Hkv, D]
+    k_self: jnp.ndarray,     # [B, Hkv, D] the new token's K (not in pool)
+    v_self: jnp.ndarray,     # [B, Hkv, D]
+    k_pages: jnp.ndarray,    # [L, P, page, Hkv, D] the WHOLE pool
     v_pages: jnp.ndarray,
+    layer: jnp.ndarray,      # scalar i32 layer index
     table: jnp.ndarray,      # [B, M] i32
-    lens: jnp.ndarray,       # [B] valid tokens INCLUDING the current one
+    lens: jnp.ndarray,       # [B] tokens RESIDENT IN THE POOL (excl. self)
     *,
     softmax_scale: Optional[float] = None,
     soft_cap: Optional[float] = None,
     sliding_window: Optional[int] = None,
     use_pallas: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """Single-token attention against paged KV. The new token's K/V must
-    already be written at position ``lens - 1``. Returns ``[B, H, D]``."""
+    """Single-token attention against paged KV plus the token itself.
+    The pool holds positions ``[0, lens)``; the query sits at position
+    ``lens`` and always attends itself via ``k_self``/``v_self`` (its KV is
+    scattered into the pool by the caller AFTER the layer scan). Returns
+    ``[B, H, D]``. The pool rides in WHOLE (all layers): the Pallas path
+    feeds the layer index through the scalar-prefetch index map and the
+    XLA path fuses it into the gather — neither materializes a per-layer
+    slice (which costs a full pool read/write per decode step when the
+    layer scan slices its xs)."""
+    B, H, D = q.shape
+    Hkv = k_pages.shape[3]
+    n_rep = H // Hkv
+    if softmax_scale is None:
+        softmax_scale = D ** -0.5
     if use_pallas is None:
         # the kernel's in-VMEM reshapes need a full-lane head_dim; smaller
         # heads (and sub-tile pages) take the XLA gather path
         use_pallas = (
             jax.devices()[0].platform == "tpu"
             and q.shape[-1] % 128 == 0
-            and k_pages.shape[1] % 8 == 0
+            and k_pages.shape[2] % 8 == 0
         )
     if use_pallas:
         from areal_tpu.ops.pallas import paged_attention as pl_paged
 
         return pl_paged.decode(
-            q, k_pages, v_pages, table, lens,
+            q, k_self, v_self, k_pages, v_pages, layer, table, lens,
             softmax_scale=softmax_scale, soft_cap=soft_cap,
             sliding_window=sliding_window,
         )
-    k = gather_pages(k_pages, table)
-    v = gather_pages(v_pages, table)
-    return attn_ops.decode_attention(
-        q, k, v, lens,
-        softmax_scale=softmax_scale, soft_cap=soft_cap,
-        sliding_window=sliding_window,
-    )
+    k = gather_pages(k_pages, table, layer)  # [B, S, Hkv, D]
+    v = gather_pages(v_pages, table, layer)
+    S = k.shape[1]
+    qg = q.reshape(B, Hkv, n_rep, D)
+    s_pool = jnp.einsum(
+        "bgrd,bsgd->bgrs", qg, k, preferred_element_type=jnp.float32
+    ) * softmax_scale                       # [B, Hkv, r, S]
+    s_self = jnp.einsum(
+        "bgrd,bgd->bgr", qg, k_self.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+    ) * softmax_scale                       # [B, Hkv, r]
+    if soft_cap is not None:
+        s_pool = soft_cap * jnp.tanh(s_pool / soft_cap)
+        s_self = soft_cap * jnp.tanh(s_self / soft_cap)
+    pos = jnp.arange(S)[None, :]
+    mask = pos < lens[:, None]              # [B, S]
+    if sliding_window is not None:
+        # the query sits at position lens
+        mask &= pos > lens[:, None] - sliding_window
+    s_pool = jnp.where(mask[:, None, None], s_pool, _NEG_INF)
+    # online-softmax merge of pool part and the always-attended self token
+    m = jnp.maximum(s_pool.max(-1), s_self)            # [B, Hkv, r]
+    p_pool = jnp.exp(s_pool - m[..., None])
+    p_pool = jnp.where(mask[:, None, None], p_pool, 0.0)
+    p_self = jnp.exp(s_self - m)
+    denom = p_pool.sum(-1) + p_self
+    acc = jnp.einsum(
+        "bgrs,bsgd->bgrd", p_pool.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ) + p_self[..., None] * v_self[:, :, None].astype(jnp.float32)
+    out = acc / denom[..., None]
+    return out.reshape(B, H, D).astype(q.dtype)
 
 
 def paged_extend_attention(
     q: jnp.ndarray,          # [B, C, H, D] chunk of new tokens
-    k_pages: jnp.ndarray,    # [P, page, Hkv, D]
+    k_chunk: jnp.ndarray,    # [B, C, Hkv, D] the chunk's K (not in pool)
+    v_chunk: jnp.ndarray,
+    k_pages: jnp.ndarray,    # [L, P, page, Hkv, D] the WHOLE pool
     v_pages: jnp.ndarray,
+    layer: jnp.ndarray,      # scalar i32 layer index
     table: jnp.ndarray,      # [B, M]
-    start: jnp.ndarray,      # [B] chunk start position (tokens already resident)
+    start: jnp.ndarray,      # [B] tokens RESIDENT IN THE POOL (chunk start)
     n_new: jnp.ndarray,      # [B] valid new tokens in the chunk (<= C)
     *,
     softmax_scale: Optional[float] = None,
     soft_cap: Optional[float] = None,
     sliding_window: Optional[int] = None,
+    kv_block: int = 1024,
 ) -> jnp.ndarray:
     """Chunked-prefill attention: chunk token i (global position start+i)
-    attends to every resident position <= its own. The chunk's K/V must
-    already be written into the pages. Returns ``[B, C, H, D]``."""
+    attends every pool position < start plus chunk tokens <= i (intra-chunk
+    causal). The chunk's K/V ride as operands — the caller scatters them
+    into the pool after its layer scan. Returns ``[B, C, H, D]``.
+
+    The pool part runs as a blockwise online softmax over KV blocks (a
+    ``lax.scan``): the naive formulation materializes ``[B, H, C, S]`` f32
+    scores — 12.9 GB for a 4-slot x 2048-chunk x 32k-context extend — while
+    this peaks at ``[B, H, C, max(kv_block, C)]``. GQA never materializes a
+    K/V repeat: the query's group axis rides the einsum."""
     B, C, H, D = q.shape
+    Hkv = k_pages.shape[3]
+    n_rep = H // Hkv
     if softmax_scale is None:
         softmax_scale = D ** -0.5
-    k = gather_pages(k_pages, table)      # [B, S, Hkv, D]
-    v = gather_pages(v_pages, table)
-    S = k.shape[1]
-    n_rep = H // k.shape[2]
-    if n_rep > 1:
-        k = jnp.repeat(k, n_rep, axis=2)
-        v = jnp.repeat(v, n_rep, axis=2)
-    scores = jnp.einsum(
-        "bchd,bshd->bhcs", q, k, preferred_element_type=jnp.float32
-    ) * softmax_scale
+    qg = q.reshape(B, C, Hkv, n_rep, D)
+    qpos_in_chunk = jnp.arange(C)
+    valid_q = qpos_in_chunk[None, :] < n_new[:, None]        # [B, C]
+
+    # ---- intra-chunk causal part (always: every token attends itself) ---
+    s_in = jnp.einsum(
+        "bcgrd,bsgd->bgrcs", qg, k_chunk.astype(q.dtype),
+        preferred_element_type=jnp.float32,
+    ) * softmax_scale                                        # [B,g,r,C,C]
     if soft_cap is not None:
-        scores = soft_cap * jnp.tanh(scores / soft_cap)
-    qpos = start[:, None] + jnp.arange(C)[None, :]          # [B, C]
-    kpos = jnp.arange(S)[None, :]                           # [1, S]
-    mask = kpos[:, None, :] <= qpos[:, :, None]             # [B, C, S] causal
+        s_in = soft_cap * jnp.tanh(s_in / soft_cap)
+    causal = qpos_in_chunk[:, None] >= qpos_in_chunk[None, :]  # [C, C]
+    in_mask = causal[None] & valid_q[:, None, :]             # [B, C, C]
     if sliding_window is not None:
-        mask &= kpos[:, None, :] > qpos[:, :, None] - sliding_window
-    valid_q = jnp.arange(C)[None, :] < n_new[:, None]       # [B, C]
-    mask &= valid_q[:, :, None]
-    scores = jnp.where(mask[:, None], scores, _NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    # fully-masked (invalid) rows produce uniform probs; zero them
-    probs = jnp.where(valid_q[:, None, :, None], probs, 0.0)
-    return jnp.einsum("bhcs,bshd->bchd", probs, v)
+        in_mask &= (
+            qpos_in_chunk[:, None] - qpos_in_chunk[None, :] < sliding_window
+        )[None]
+    s_in = jnp.where(in_mask[:, None, None], s_in, _NEG_INF)
+    m = s_in.max(-1)                                         # [B,g,r,C]
+    p_in = jnp.exp(s_in - m[..., None])
+    p_in = jnp.where(in_mask[:, None, None], p_in, 0.0)
+    l = p_in.sum(-1)
+    acc = jnp.einsum(
+        "bgrcs,bsgd->bgrcd", p_in.astype(v_chunk.dtype), v_chunk,
+        preferred_element_type=jnp.float32,
+    )
+
+    # ---- pool part: blockwise online softmax over resident KV ----------
+    k = gather_pages(k_pages, table, layer)  # [B, S, Hkv, D]
+    v = gather_pages(v_pages, table, layer)
+    S = k.shape[1]
+    Sb = kv_block if S % kv_block == 0 else S
+    nb = S // Sb
+    kb = jnp.moveaxis(k.reshape(B, nb, Sb, Hkv, D), 1, 0)
+    vb = jnp.moveaxis(v.reshape(B, nb, Sb, Hkv, D), 1, 0)
+    offs = jnp.arange(nb) * Sb
+    qpos = start[:, None] + qpos_in_chunk[None, :]           # [B, C]
+
+    def body(carry, blk):
+        m, l, acc = carry
+        k_blk, v_blk, off = blk
+        s = jnp.einsum(
+            "bcgrd,bsgd->bgrcs", qg, k_blk,
+            preferred_element_type=jnp.float32,
+        ) * softmax_scale
+        if soft_cap is not None:
+            s = soft_cap * jnp.tanh(s / soft_cap)
+        kpos = off + jnp.arange(Sb)                          # [Sb]
+        # every pool position < start is causally visible to every chunk
+        # token; the per-token bound only matters for the sliding window
+        mask = kpos[None, None, :] < start[:, None, None]    # [B, 1|C, Sb]
+        mask = jnp.broadcast_to(mask, (B, C, Sb))
+        if sliding_window is not None:
+            mask &= kpos[None, None, :] > qpos[:, :, None] - sliding_window
+        s = jnp.where(mask[:, None, None], s, _NEG_INF)      # [B,g,r,C,Sb]
+        m_new = jnp.maximum(m, s.max(-1))
+        # m can be -inf while everything so far is masked; keep the
+        # rescale finite
+        alpha = jnp.exp(jnp.where(m > _NEG_INF / 2, m - m_new, 0.0))
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(mask[:, None, None], p, 0.0)
+        l = l * alpha + p.sum(-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bgrcs,bsgd->bgrcd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l, acc), None
+
+    (m, l, acc), _ = jax.lax.scan(body, (m, l, acc), (kb, vb, offs))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]             # [B,g,r,C,D]
+    out = jnp.moveaxis(out, 3, 1).reshape(B, C, H, D)
+    # fully-masked (invalid) rows carry garbage; zero them
+    out = jnp.where(valid_q[:, :, None, None], out, 0.0)
+    return out.astype(q.dtype)

@@ -38,11 +38,14 @@ def _n_used(lens_b, page):
 
 
 def _decode_kernel(
+    layer_ref,   # [1] int32 scalar-prefetch: which layer of the pool
     table_ref,   # [B, M] int32 scalar-prefetch
-    lens_ref,    # [B] int32 scalar-prefetch
+    lens_ref,    # [B] int32 scalar-prefetch (pool-resident, EXCL. self)
     q_ref,       # [1, Hq, D]
-    k_ref,       # [1, page, Hkv*D]
-    v_ref,       # [1, page, Hkv*D]
+    ks_ref,      # [1, Hkv, D] the current token's K (not in the pool)
+    vs_ref,      # [1, Hkv, D]
+    k_ref,       # [1, 1, page, Hkv*D]
+    v_ref,       # [1, 1, page, Hkv*D]
     o_ref,       # [1, Hq, D]
     m_scr,       # [HqP, LANES] f32
     l_scr,       # [HqP, LANES] f32
@@ -71,8 +74,8 @@ def _decode_kernel(
     def _body():
         D = q_ref.shape[2]
         q = q_ref[0].reshape(n_kv, n_rep, D)                  # [Hkv, r, D]
-        k = k_ref[0].reshape(page, n_kv, D).transpose(1, 0, 2)  # [Hkv, p, D]
-        v = v_ref[0].reshape(page, n_kv, D).transpose(1, 0, 2)
+        k = k_ref[0, 0].reshape(page, n_kv, D).transpose(1, 0, 2)  # [Hkv,p,D]
+        v = v_ref[0, 0].reshape(page, n_kv, D).transpose(1, 0, 2)
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
@@ -83,8 +86,8 @@ def _decode_kernel(
         kpos = j * page + jax.lax.broadcasted_iota(jnp.int32, (Hq, page), 1)
         mask = kpos < lens_b
         if sliding_window is not None:
-            # the query sits at position lens_b - 1
-            mask &= kpos > lens_b - 1 - sliding_window
+            # the query sits at position lens_b
+            mask &= kpos > lens_b - sliding_window
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:Hq, 0:1]
@@ -104,24 +107,48 @@ def _decode_kernel(
     @pl.when(j == M - 1)
     def _done():
         D = q_ref.shape[2]
-        l = l_scr[:Hq, 0:1]
-        safe_l = jnp.where(l > 0.0, l, 1.0)
-        o_ref[0] = (acc_scr[:Hq, :D] / safe_l).astype(o_ref.dtype)
+        # fold the current token's self-attention (always attended; its KV
+        # is scattered into the pool by the caller AFTER the layer scan)
+        q = q_ref[0].reshape(n_kv, n_rep, D)
+        ks = ks_ref[0].astype(q.dtype)                        # [Hkv, D]
+        vs = vs_ref[0]
+        s_self = jnp.sum(
+            q.astype(jnp.float32) * ks[:, None].astype(jnp.float32), axis=2
+        ) * scale                                             # [Hkv, r]
+        if soft_cap is not None:
+            s_self = soft_cap * jnp.tanh(s_self / soft_cap)
+        s_self = s_self.reshape(Hq, 1)
+        m_prev = m_scr[:Hq, 0:1]
+        m_new = jnp.maximum(m_prev, s_self)
+        corr = jnp.exp(jnp.where(m_prev > NEG_INF / 2, m_prev - m_new, 0.0))
+        p_self = jnp.exp(s_self - m_new)                      # [Hq, 1]
+        l = corr * l_scr[:Hq, 0:1] + p_self
+        v_rep = jnp.broadcast_to(
+            vs[:, None].astype(jnp.float32), (n_kv, n_rep, D)
+        ).reshape(Hq, D)
+        acc = acc_scr[:Hq, :D] * corr + p_self * v_rep
+        o_ref[0] = (acc / l).astype(o_ref.dtype)
 
 
 def decode(
     q: jnp.ndarray,          # [B, Hq, D]
-    k_pages: jnp.ndarray,    # [P, page, Hkv, D]
+    k_self: jnp.ndarray,     # [B, Hkv, D] current token's K (not in pool)
+    v_self: jnp.ndarray,     # [B, Hkv, D]
+    k_pages: jnp.ndarray,    # [L, P, page, Hkv, D] the WHOLE pool
     v_pages: jnp.ndarray,
+    layer: jnp.ndarray,      # scalar i32 layer index
     table: jnp.ndarray,      # [B, M] i32
-    lens: jnp.ndarray,       # [B] valid tokens incl. the current one
+    lens: jnp.ndarray,       # [B] tokens resident in the pool (excl. self)
     *,
     softmax_scale: Optional[float] = None,
     soft_cap: Optional[float] = None,
     sliding_window: Optional[int] = None,
 ) -> jnp.ndarray:
+    """The pool rides in whole; the LAYER index is a scalar-prefetch operand
+    feeding the BlockSpec index map, so only the addressed layer's resident
+    pages are ever DMA'd — the caller's layer scan never slices the pool."""
     B, Hq, D = q.shape
-    P, page, Hkv, _ = k_pages.shape
+    L, P, page, Hkv, _ = k_pages.shape
     M = table.shape[1]
     n_rep = Hq // Hkv
     if not _interpret() and (D % 128 != 0 or page % 8 != 0):
@@ -132,13 +159,13 @@ def decode(
     if softmax_scale is None:
         softmax_scale = D ** -0.5
     hq_pad = max(8, Hq)
-    kv_flat = k_pages.reshape(P, page, Hkv * D)
-    vv_flat = v_pages.reshape(P, page, Hkv * D)
+    kv_flat = k_pages.reshape(L, P, page, Hkv * D)
+    vv_flat = v_pages.reshape(L, P, page, Hkv * D)
 
-    def page_map(b, j, table, lens):
+    def page_map(b, j, layer, table, lens):
         # clamp to the last resident page: repeats skip the DMA
         jj = jnp.minimum(j, _n_used(lens[b], page) - 1)
-        return (table[b, jj], 0, 0)
+        return (layer[0], table[b, jj], 0, 0)
 
     kernel = functools.partial(
         _decode_kernel,
@@ -152,14 +179,18 @@ def decode(
     return pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=(B, M),
             in_specs=[
-                pl.BlockSpec((1, Hq, D), lambda b, j, t, l: (b, 0, 0)),
-                pl.BlockSpec((1, page, Hkv * D), page_map),
-                pl.BlockSpec((1, page, Hkv * D), page_map),
+                pl.BlockSpec((1, Hq, D), lambda b, j, ly, t, l: (b, 0, 0)),
+                pl.BlockSpec((1, Hkv, D), lambda b, j, ly, t, l: (b, 0, 0)),
+                pl.BlockSpec((1, Hkv, D), lambda b, j, ly, t, l: (b, 0, 0)),
+                pl.BlockSpec((1, 1, page, Hkv * D), page_map),
+                pl.BlockSpec((1, 1, page, Hkv * D), page_map),
             ],
-            out_specs=pl.BlockSpec((1, Hq, D), lambda b, j, t, l: (b, 0, 0)),
+            out_specs=pl.BlockSpec(
+                (1, Hq, D), lambda b, j, ly, t, l: (b, 0, 0)
+            ),
             scratch_shapes=[
                 pltpu.VMEM((hq_pad, LANES), jnp.float32),
                 pltpu.VMEM((hq_pad, LANES), jnp.float32),
@@ -169,4 +200,7 @@ def decode(
         ),
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         interpret=_interpret(),
-    )(table, lens, q, kv_flat, vv_flat)
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1), table, lens,
+        q, k_self, v_self, kv_flat, vv_flat,
+    )

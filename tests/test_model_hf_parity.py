@@ -217,3 +217,39 @@ def test_critic_head_shape(rng):
         params, cfg, jnp.asarray(ids), jnp.asarray(segs), jnp.asarray(pos)
     )
     assert out.shape == (8, 1)
+
+
+def test_prefill_flash_path_matches_dense(rng):
+    """The flattened varlen-flash prefill (the 32k-capable path used on TPU)
+    must match the dense-mask prefill: same last-token logits, same cache."""
+    import dataclasses
+
+    import jax
+
+    base = tfm.ModelConfig(
+        n_layers=2, n_q_heads=4, n_kv_heads=2, head_dim=16, hidden_dim=32,
+        intermediate_dim=64, vocab_size=128, dtype="float32",
+        use_flash_attention=False,
+    )
+    params = tfm.init_params(base, jax.random.key(3))
+    B, S = 2, 256
+    prompt_lens = np.array([200, 256], np.int32)
+    prompts = np.zeros((B, S), np.int32)
+    for i, n in enumerate(prompt_lens):
+        prompts[i, :n] = rng.integers(1, 128, size=n)
+
+    outs = {}
+    for flash in (False, True):
+        cfg = dataclasses.replace(base, use_flash_attention=flash)
+        cache = tfm.KVCache.empty(cfg, batch=B, capacity=S)
+        logits, cache = tfm.prefill(
+            params, cfg, cache, jnp.asarray(prompts), jnp.asarray(prompt_lens)
+        )
+        outs[flash] = (np.asarray(logits), np.asarray(cache.k),
+                       np.asarray(cache.v))
+    np.testing.assert_allclose(outs[True][0], outs[False][0], atol=2e-4,
+                               rtol=2e-4)
+    np.testing.assert_allclose(outs[True][1], outs[False][1], atol=2e-5,
+                               rtol=2e-5)
+    np.testing.assert_allclose(outs[True][2], outs[False][2], atol=2e-5,
+                               rtol=2e-5)
