@@ -26,7 +26,7 @@ from areal_tpu.base import constants
 from areal_tpu.base.metrics import MetricLogger
 from areal_tpu.experiments import graphs
 from areal_tpu.parallel import multihost
-from areal_tpu.rewards.math_verify import verify_math_solution
+from areal_tpu.rewards.math_verify import grade_math_answers
 from areal_tpu.system.function_executor import FunctionExecutor
 from areal_tpu.system.trainer_worker import TrainerControl
 from areal_tpu.train.engine import TrainEngine
@@ -39,8 +39,7 @@ RewardFn = Callable[[str, List[str], dict], List[float]]
 
 
 def math_reward_fn(qid: str, answers: List[str], metadata: dict) -> List[float]:
-    sols = metadata.get("solutions", [])
-    return [1.0 if verify_math_solution(a, sols) else -1.0 for a in answers]
+    return grade_math_answers(answers, metadata.get("solutions", []))
 
 
 def build_group_sample(

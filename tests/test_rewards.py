@@ -190,3 +190,45 @@ def test_tool_use_handles_escaped_quotes():
     assert tool_use.extract_answer(resp) == 'He said "hi" loudly'
     em, f1 = tool_use.em_check(tool_use.extract_answer(resp), 'he said hi loudly')
     assert em == 1 and f1 == 1.0
+
+
+class TestMathParityCorpus:
+    """Parity corpus vs the reference verifier (math_parser.py): verdicts
+    mined from its strip_string/math_equal semantics. Gate: >= 95%
+    agreement on the answer-level corpus; full-text cases mirror
+    process_results (no last-number fallback on the generated side);
+    deliberate divergences assert OUR documented behavior."""
+
+    @pytest.fixture(scope="class")
+    def corpus(self):
+        import json
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "data",
+                            "math_parity.json")
+        with open(path) as f:
+            return json.load(f)
+
+    def test_answer_level_agreement(self, corpus):
+        from areal_tpu.rewards.math_verify import answers_equal
+
+        wrong = []
+        for given, truth, expected, family in corpus["answers"]:
+            if answers_equal(given, truth) != expected:
+                wrong.append((family, given, truth, expected))
+        agreement = 1 - len(wrong) / len(corpus["answers"])
+        assert agreement >= 0.95, (
+            f"agreement {agreement:.3f}; disagreements: {wrong}"
+        )
+
+    def test_full_text_process_results_semantics(self, corpus):
+        from areal_tpu.rewards.math_verify import verify_math_solution
+
+        for generated, sols, expected, family in corpus["full_text"]:
+            assert verify_math_solution(generated, sols) == expected, family
+
+    def test_documented_divergences(self, corpus):
+        from areal_tpu.rewards.math_verify import answers_equal
+
+        for given, truth, expected, why in corpus["divergences"]:
+            assert answers_equal(given, truth) == expected, why
