@@ -157,30 +157,41 @@ def global_from_local(
     return out
 
 
+_collective_rounds = 0
+
+
+def collective_rounds() -> int:
+    """Host-collective rounds issued by this process (one DCN round trip
+    each) — observability for keeping the per-train-step count low."""
+    return _collective_rounds
+
+
+def _gather(x: np.ndarray) -> np.ndarray:
+    global _collective_rounds
+    _collective_rounds += 1
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(np.asarray(x)))
+
+
 def allreduce_sum(x: np.ndarray) -> np.ndarray:
     """Sum a small host-side numpy array across processes (stats, weights —
     NOT the data path; XLA handles device collectives)."""
     if not is_multihost():
         return np.asarray(x)
-    from jax.experimental import multihost_utils
-
-    return np.asarray(multihost_utils.process_allgather(np.asarray(x))).sum(axis=0)
+    return _gather(x).sum(axis=0)
 
 
 def allreduce_max(x: np.ndarray) -> np.ndarray:
     if not is_multihost():
         return np.asarray(x)
-    from jax.experimental import multihost_utils
-
-    return np.asarray(multihost_utils.process_allgather(np.asarray(x))).max(axis=0)
+    return _gather(x).max(axis=0)
 
 
 def allreduce_min(x: np.ndarray) -> np.ndarray:
     if not is_multihost():
         return np.asarray(x)
-    from jax.experimental import multihost_utils
-
-    return np.asarray(multihost_utils.process_allgather(np.asarray(x))).min(axis=0)
+    return _gather(x).min(axis=0)
 
 
 def main_decides(flag: bool) -> bool:
@@ -196,9 +207,7 @@ def allgather_rows(x: np.ndarray) -> np.ndarray:
     """[P, ...] stack of every process's copy of ``x`` (same shape everywhere)."""
     if not is_multihost():
         return np.asarray(x)[None]
-    from jax.experimental import multihost_utils
-
-    return np.asarray(multihost_utils.process_allgather(np.asarray(x)))
+    return _gather(x)
 
 
 def assert_same_across_hosts(tag: str, payload: str) -> None:

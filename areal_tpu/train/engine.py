@@ -401,62 +401,123 @@ class TrainEngine:
         )
 
     def _make_micro_batches(
-        self, sample: SequenceSample, mb_spec: MicroBatchSpec, capacity=None
+        self,
+        sample: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        capacity=None,
+        weight_fn=None,
     ):
         """Split + pack this host's sample into micro-batches.
 
         Multi-host: every process enters the same jit dispatch, so the
-        micro-batch COUNT and buffer CAPACITY must agree globally even though
-        each host packs its own (differently-sized) local rows. Hosts agree
-        by small allgathers; a host with fewer items than the agreed count
-        pads with empty (weight-0) micro-batches.
+        micro-batch COUNT and buffer CAPACITY must agree globally even
+        though each host packs its own (differently-sized) local rows. All
+        agreements ride TWO consolidated allgather rounds (each is a DCN
+        round trip): round 1 carries [longest-sequence, mb-count] together;
+        round 2 carries [capacity, per-mb weights] together — repacking at
+        a larger agreed capacity only adds padding, so weights computed on
+        the first packing stay valid. Extra rounds happen only in the rare
+        case hosts disagree on the count after round 1.
+
+        Returns ``(mbs, packed, weights)`` where weights (summed across
+        hosts, one per packed mb, or None when ``weight_fn`` is None) are
+        computed in the same round as the capacity agreement.
         """
-        if self.cfg.attn_max_seqlen is not None:
-            # every sequence of every (possibly grouped) item; allreduce so
-            # all hosts raise together instead of desyncing the collectives
-            # below
+        bound = self.cfg.attn_max_seqlen
+        longest = 0
+        if bound is not None:
+            # every sequence of every (possibly grouped) item; agreed
+            # globally below so all hosts raise together instead of
+            # desyncing the collectives
             longest = max(
                 (l for lens in sample.seqlens.values() for ln in lens for l in ln),
                 default=0,
             )
-            longest = int(multihost.allreduce_max(np.asarray([longest]))[0])
-            if longest > self.cfg.attn_max_seqlen:
-                raise ValueError(
-                    f"batch contains a {longest}-token sequence but "
-                    f"attn_max_seqlen={self.cfg.attn_max_seqlen}: the flash "
-                    "kernels would silently truncate its attention span. "
-                    "Raise the bound or drop over-long sequences at intake."
-                )
         n_rows = self.n_local_rows
-        mbs = batching.split_into_micro_batches(
-            sample, mb_spec.n_mbs, mb_spec.max_tokens_per_mb, n_rows
-        )
+
+        def try_split(n_parts):
+            # a LOCAL raise (over-long sequence on this host only) would
+            # leave the other hosts blocked in the next gather; return the
+            # error and raise collectively after the agreement round
+            try:
+                return batching.split_into_micro_batches(
+                    sample, n_parts, mb_spec.max_tokens_per_mb, n_rows
+                ), None
+            except ValueError as e:
+                return None, e
+
+        mbs, split_err = try_split(mb_spec.n_mbs)
         n_empty = 0
         if multihost.is_multihost():
-            # fixed-point on the part count: identical allgather sequence on
-            # every host (the gathered vector is the same everywhere, so all
-            # hosts take the same branch each iteration)
-            for _ in range(8):
-                counts = multihost.allgather_rows(np.int64(len(mbs)))
-                g = int(counts.max())
+            # round 1: longest sequence + mb count in ONE gather (-1 count
+            # signals a failed local split so every host raises together)
+            g1 = multihost.allgather_rows(np.asarray(
+                [longest, -1 if mbs is None else len(mbs)], np.int64
+            ))
+            longest = int(g1[:, 0].max())
+            counts = g1[:, 1]
+            if (counts < 0).any():
+                raise split_err if split_err is not None else RuntimeError(
+                    "micro-batch split failed on another host"
+                )
+            g = int(counts.max())
+            # fixed-point on the part count: identical gather sequence on
+            # every host (the gathered vector is the same everywhere, so
+            # all hosts take the same branch each iteration). Converges on
+            # the first try unless re-splitting at the agreed count
+            # produces even more parts on some host.
+            for _ in range(7):
                 if (counts == g).all():
                     break
                 if len(mbs) < g:
-                    mbs = batching.split_into_micro_batches(
-                        sample, g, mb_spec.max_tokens_per_mb, n_rows
+                    mbs, split_err = try_split(g)
+                counts = multihost.allgather_rows(
+                    np.int64(-1 if mbs is None else len(mbs))
+                )
+                if (counts < 0).any():
+                    raise split_err if split_err is not None else RuntimeError(
+                        "micro-batch split failed on another host"
                     )
-            g = int(multihost.allreduce_max(np.int64(len(mbs))))
+                g = int(counts.max())
+            if not (counts == g).all():
+                raise RuntimeError(
+                    f"micro-batch count did not converge: {counts.tolist()}"
+                )
             n_empty = g - len(mbs)  # host has fewer items than the agreement
+        elif split_err is not None:
+            raise split_err
+        if bound is not None and longest > bound:
+            raise ValueError(
+                f"batch contains a {longest}-token sequence but "
+                f"attn_max_seqlen={bound}: the flash kernels would "
+                "silently truncate its attention span. Raise the bound or "
+                "drop over-long sequences at intake."
+            )
         cap = capacity or mb_spec.max_tokens_per_mb
         packed = [
             batching.pack_sequences(mb, n_rows, capacity=cap) for mb in mbs
         ]
+        cap_local = cap if cap is not None else max(
+            (pb.capacity for pb in packed), default=0
+        )
+        # round 2: capacity + weights in ONE gather (weights depend only on
+        # mb CONTENT, not padding, so pre-repack values are final)
+        w_local = None
+        if weight_fn is not None:
+            w_local = [float(weight_fn(pb)) for pb in packed]
+            w_local += [0.0] * n_empty          # padding mbs carry no loss
+        weights = None
+        if multihost.is_multihost() and (cap is None or w_local is not None):
+            g2 = multihost.allgather_rows(
+                np.asarray([float(cap_local)] + (w_local or []), np.float64)
+            )
+            cap_local = int(g2[:, 0].max())
+            if w_local is not None:
+                weights = g2[:, 1:].sum(axis=0)
+        elif w_local is not None:
+            weights = np.asarray(w_local, np.float64)
         if cap is None:
-            # uniform capacity so micro-batches stack into one [n_mbs, D, T]
-            # buffer (and share one compiled step) — agreed across hosts
-            cap = max(pb.capacity for pb in packed)
-            if multihost.is_multihost():
-                cap = int(multihost.allreduce_max(np.int64(cap)))
+            cap = cap_local
             packed = [
                 pb
                 if pb.capacity == cap
@@ -465,7 +526,7 @@ class TrainEngine:
             ]
         for _ in range(n_empty):
             packed.append(batching.empty_like(packed[0]))
-        return mbs, packed
+        return mbs, packed, weights
 
     # ------------------------------------------------------------------ #
     # PipelinableEngine API (≈ model_api.py:514)
@@ -496,14 +557,15 @@ class TrainEngine:
         assert self.tx is not None, "call setup_optimizer() first"
         if loss_weight_fn is None:
             loss_weight_fn = batching.count_action_tokens
-        _, packed = self._make_micro_batches(sample, mb_spec)
         # Per-mb loss weights must be identical on every process (they enter
         # the jit replicated), and the loss each mb computes inside pjit is
         # already GLOBAL over all hosts' rows — so weight by the global
-        # action-token count of each micro-batch.
-        weights = multihost.allreduce_sum(
-            np.asarray([loss_weight_fn(pb) for pb in packed], np.float32)
+        # action-token count of each micro-batch (gathered in the same
+        # round as the capacity agreement).
+        _, packed, weights = self._make_micro_batches(
+            sample, mb_spec, weight_fn=loss_weight_fn
         )
+        weights = np.asarray(weights, np.float32)
         total_w = weights.sum() or 1.0
         weights = weights / total_w
 
@@ -522,16 +584,13 @@ class TrainEngine:
     def eval_batch(
         self, sample: SequenceSample, mb_spec: MicroBatchSpec, loss_fn: LossFn
     ) -> Dict[str, float]:
-        _, packed = self._make_micro_batches(sample, mb_spec)
-        ev = self._get_jitted("eval", loss_fn)
-        # ONE cross-host reduce for all mb weights and ONE device pull for
-        # all losses (each costs a full round trip on remote accelerators)
-        weights = multihost.allreduce_sum(
-            np.asarray(
-                [(pb.arrays["segment_ids"] > 0).sum() for pb in packed],
-                np.float64,
-            )
+        _, packed, weights = self._make_micro_batches(
+            sample, mb_spec,
+            weight_fn=lambda pb: (pb.arrays["segment_ids"] > 0).sum(),
         )
+        ev = self._get_jitted("eval", loss_fn)
+        # weights rode the capacity-agreement gather; ONE device pull for
+        # all losses (each costs a full round trip on remote accelerators)
         losses = [ev(self.params, self._put_batch(pb))[0] for pb in packed]
         losses = np.asarray(jax.device_get(losses), np.float64)
         # all-padding mbs can yield nan means; their weight is 0
@@ -549,7 +608,7 @@ class TrainEngine:
         the [T, vocab] logits never leave the device). Returns one array per
         sequence, in the sample's original (item, seq) order — the micro-batch
         split reorders items, so results are matched back via item ids."""
-        mbs, packed = self._make_micro_batches(sample, mb_spec)
+        mbs, packed, _ = self._make_micro_batches(sample, mb_spec)
         fwd = self._get_jitted("forward", output_fn)
         by_key: Dict[Any, np.ndarray] = {}
         # iterate over `packed` (not zip) — trailing multi-host padding
