@@ -40,13 +40,21 @@ def _setup_worker_env(cfg, device: str = ""):
         jax.config.update("jax_platforms", "cpu")
     from areal_tpu.base import constants, name_resolve, seeding
 
-    # cross-process rendezvous goes through the shared-filesystem backend
-    # (the in-memory default only works within one process)
-    name_resolve.reconfigure(
-        name_resolve.NameResolveConfig(
-            type="file", root=os.environ["AREAL_NAME_RESOLVE_ROOT"]
+    # cross-process rendezvous: the TCP server when one is advertised
+    # (multi-node, no shared FS — AREAL_NAME_RESOLVE_RPC=host:port), else
+    # the shared-filesystem backend (the in-memory default only works
+    # within one process)
+    rpc_addr = os.environ.get("AREAL_NAME_RESOLVE_RPC")
+    if rpc_addr:
+        name_resolve.reconfigure(
+            name_resolve.NameResolveConfig(type="rpc", root=rpc_addr)
         )
-    )
+    else:
+        name_resolve.reconfigure(
+            name_resolve.NameResolveConfig(
+                type="file", root=os.environ["AREAL_NAME_RESOLVE_ROOT"]
+            )
+        )
 
     constants.set_experiment_trial_names(cfg.experiment_name, cfg.trial_name)
     if cfg.fileroot:

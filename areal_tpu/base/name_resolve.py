@@ -8,6 +8,10 @@ TPU-native counterpart of the reference's ``realhf/base/name_resolve.py``
 - ``FileNameRecordRepository``   — a shared-filesystem backend (works on any
   POSIX FS incl. NFS/GCS-fuse on TPU pods). Values are small text files; keys
   map to directories. This is the default for multi-process runs.
+- ``RpcNameRecordRepository``    — a TCP backend against the self-hosted
+  ``base/name_resolve_server.py`` (newline-JSON protocol, etcd-style
+  keepalive leases): multi-NODE rendezvous without a shared FS and without
+  the reference's etcd3/Redis dependencies.
 
 Semantics kept from the reference: ``add`` (with ``replace`` /
 ``delete_on_exit`` / ``keepalive_ttl``), ``get``, ``wait`` (poll until a key
@@ -286,10 +290,168 @@ class FileNameRecordRepository(NameRecordRepository):
                 pass
 
 
+class RpcNameRecordRepository(NameRecordRepository):
+    """TCP rendezvous backend (``base/name_resolve_server.py``) — the
+    no-shared-FS, no-etcd multi-node path. One persistent socket
+    (newline-JSON protocol) with reconnect; a daemon thread refreshes the
+    lease of every key added with ``keepalive_ttl`` (etcd-style: a dead
+    process's keys expire, which is what death-watches rely on).
+
+    Address: ``host:port``, from the config root or
+    ``AREAL_NAME_RESOLVE_RPC``.
+    """
+
+    def __init__(self, address: Optional[str] = None):
+        import socket as _socket
+
+        address = address or os.environ.get("AREAL_NAME_RESOLVE_RPC")
+        if not address or ":" not in address:
+            raise ValueError(
+                "rpc name_resolve needs 'host:port' (config root or "
+                "AREAL_NAME_RESOLVE_RPC)"
+            )
+        host, _, port = address.rpartition(":")
+        self._addr = (host, int(port))
+        self._socket_mod = _socket
+        self._sock = None
+        self._rfile = None
+        self._lock = threading.Lock()
+        self._to_delete = set()
+        self._leases: Dict[str, float] = {}      # name -> ttl
+        self._keepalive: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def _connect_locked(self):
+        if self._sock is not None:
+            return
+        s = self._socket_mod.create_connection(self._addr, timeout=10.0)
+        s.settimeout(30.0)
+        self._sock = s
+        self._rfile = s.makefile("rb")
+
+    # ops safe to blindly re-send after a lost reply; mutating ops are NOT:
+    # a retried add whose first attempt landed would raise a spurious
+    # NameEntryExistsError for the caller's own key
+    _IDEMPOTENT = frozenset({"get", "get_subtree", "find_subtree", "touch",
+                             "ping"})
+
+    def _call(self, req: dict) -> dict:
+        import json as _json
+
+        with self._lock:
+            for attempt in (0, 1):
+                sent = False
+                try:
+                    self._connect_locked()
+                    self._sock.sendall((_json.dumps(req) + "\n").encode())
+                    sent = True
+                    line = self._rfile.readline()
+                    if not line:
+                        raise ConnectionError("server closed connection")
+                    return _json.loads(line)
+                except (OSError, ConnectionError):
+                    self._sock = None
+                    if attempt or (sent and req["op"] not in self._IDEMPOTENT):
+                        raise
+
+    def _ensure_keepalive(self):
+        if self._keepalive is not None:
+            return
+
+        def _loop():
+            while not self._stop.wait(1.0):
+                with self._lock:
+                    leases = dict(self._leases)
+                if not leases:
+                    continue
+                # one touch per distinct TTL — refreshing every key with
+                # the minimum would silently shorten longer leases
+                by_ttl: Dict[float, List[str]] = {}
+                for n, t in leases.items():
+                    by_ttl.setdefault(t, []).append(n)
+                for ttl, names in by_ttl.items():
+                    try:
+                        self._call(
+                            {"op": "touch", "names": names, "ttl": ttl}
+                        )
+                    except Exception:  # noqa: BLE001 — retried next tick
+                        pass
+
+        self._keepalive = threading.Thread(target=_loop, daemon=True)
+        self._keepalive.start()
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None,
+            replace=False):
+        name = name.rstrip("/")
+        resp = self._call({
+            "op": "add", "name": name, "value": str(value),
+            "replace": replace, "ttl": keepalive_ttl,
+        })
+        if not resp["ok"]:
+            raise NameEntryExistsError(name)
+        if delete_on_exit:
+            self._to_delete.add(name)
+        if keepalive_ttl:
+            with self._lock:
+                self._leases[name] = float(keepalive_ttl)
+            self._ensure_keepalive()
+
+    def get(self, name):
+        resp = self._call({"op": "get", "name": name.rstrip("/")})
+        if not resp["ok"]:
+            raise NameEntryNotFoundError(name)
+        return resp["value"]
+
+    def delete(self, name):
+        name = name.rstrip("/")
+        resp = self._call({"op": "delete", "name": name})
+        self._to_delete.discard(name)
+        with self._lock:
+            self._leases.pop(name, None)
+        if not resp["ok"]:
+            raise NameEntryNotFoundError(name)
+
+    def clear_subtree(self, name_root):
+        self._call({"op": "clear_subtree", "name": name_root.rstrip("/")})
+        root = name_root.rstrip("/")
+        self._to_delete = {
+            n for n in self._to_delete
+            if not (n == root or n.startswith(root + "/"))
+        }
+
+    def get_subtree(self, name_root):
+        return self._call(
+            {"op": "get_subtree", "name": name_root.rstrip("/")}
+        )["values"]
+
+    def find_subtree(self, name_root):
+        return self._call(
+            {"op": "find_subtree", "name": name_root.rstrip("/")}
+        )["keys"]
+
+    def reset(self):
+        names = list(self._to_delete)
+        self._to_delete.clear()
+        with self._lock:
+            self._leases.clear()
+        if names:
+            self._call({"op": "delete_many", "names": names})
+
+    def close(self):
+        self._stop.set()
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
 @dataclasses.dataclass
 class NameResolveConfig:
-    type: str = "file"  # "memory" | "file"
-    root: Optional[str] = None
+    type: str = "file"  # "memory" | "file" | "rpc"
+    root: Optional[str] = None  # file: directory; rpc: "host:port"
 
 
 _DEFAULT: NameRecordRepository = MemoryNameRecordRepository()
@@ -300,6 +462,8 @@ def make_repository(cfg: NameResolveConfig) -> NameRecordRepository:
         return MemoryNameRecordRepository()
     if cfg.type == "file":
         return FileNameRecordRepository(cfg.root)
+    if cfg.type == "rpc":
+        return RpcNameRecordRepository(cfg.root)
     raise ValueError(f"Unknown name_resolve backend: {cfg.type}")
 
 

@@ -12,9 +12,23 @@ from areal_tpu.base.name_resolve import (
 )
 
 
-@pytest.fixture(params=["memory", "file"])
+@pytest.fixture(scope="module")
+def rpc_server():
+    from areal_tpu.base.name_resolve_server import NameResolveServer
+
+    srv = NameResolveServer("127.0.0.1", 0)
+    addr = srv.start()
+    yield addr
+    srv.stop()
+
+
+@pytest.fixture(params=["memory", "file", "rpc"])
 def repo(request, tmp_path):
-    cfg = NameResolveConfig(type=request.param, root=str(tmp_path / "nr"))
+    if request.param == "rpc":
+        root = request.getfixturevalue("rpc_server")
+    else:
+        root = str(tmp_path / "nr")
+    cfg = NameResolveConfig(type=request.param, root=root)
     r = make_repository(cfg)
     yield r
     r.reset()
@@ -82,3 +96,40 @@ def test_module_level_default():
     name_resolve.add("m/k", "v")
     assert name_resolve.get("m/k") == "v"
     name_resolve.reset()
+
+
+def test_rpc_cross_client_visibility_and_reset(rpc_server):
+    """Two clients (= two workers on different nodes) share the tree; one
+    client's reset() removes only ITS delete_on_exit keys."""
+    a = make_repository(NameResolveConfig(type="rpc", root=rpc_server))
+    b = make_repository(NameResolveConfig(type="rpc", root=rpc_server))
+    a.add("fleet/server/0", "http://h0:1", replace=True)
+    b.add("fleet/server/1", "http://h1:1", replace=True)
+    assert a.get_subtree("fleet/server") == ["http://h0:1", "http://h1:1"]
+    assert b.find_subtree("fleet/server") == [
+        "fleet/server/0", "fleet/server/1",
+    ]
+    a.reset()
+    with pytest.raises(NameEntryNotFoundError):
+        b.get("fleet/server/0")
+    assert b.get("fleet/server/1") == "http://h1:1"
+    b.reset()
+    a.close(), b.close()
+
+
+def test_rpc_lease_expires_without_keepalive(rpc_server):
+    """A key with keepalive_ttl outlives its TTL only while its owner's
+    keepalive thread runs — kill the owner (close) and the key expires
+    (the death-watch mechanism for crashed workers)."""
+    import time as _time
+
+    owner = make_repository(NameResolveConfig(type="rpc", root=rpc_server))
+    other = make_repository(NameResolveConfig(type="rpc", root=rpc_server))
+    owner.add("hb/w0", "alive", keepalive_ttl=1.5)
+    _time.sleep(2.5)          # > ttl: keepalive thread kept it alive
+    assert other.get("hb/w0") == "alive"
+    owner.close()             # owner dies; no more touches
+    _time.sleep(2.5)
+    with pytest.raises(NameEntryNotFoundError):
+        other.get("hb/w0")
+    other.close()
