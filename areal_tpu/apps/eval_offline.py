@@ -1,16 +1,26 @@
 """Offline evaluation harness: generate → grade → aggregate, standalone.
 
-Counterpart of the reference's ``evaluation/eval_and_aggregate.py`` (math
-answer grading + pass@k aggregation over sampled generations; the CF-ELO
-half is dataset-specific and out of scope). Runs against any HF checkpoint
-this framework exports:
+Counterpart of the reference's ``evaluation/eval_and_aggregate.py`` +
+``math_eval.py`` protocol: one CLI call evaluates a checkpoint on MULTIPLE
+benchmark files (math and code), runs an optional greedy pass next to the
+sampling pass, and aggregates per benchmark —
 
-    python -m areal_tpu.apps.eval_offline \
-        --model-path /ckpts/step100 --dataset math_test.jsonl \
-        --output-dir /tmp/eval --n-sampling 8 --max-gen-tokens 1024
+- ``pass@k`` via the unbiased estimator ``1 - prod(1 - k/arange(n-c+1, n+1))``
+  (``eval_and_aggregate.py:75-88``) at k = 1 and every power of two <= n,
+- ``maj@k`` majority voting over answer-equivalence groups
+  (``rm_maj_eval.py:group_pred``),
+- mean generated length (tokens), greedy accuracy, mean reward,
+- CF ELO when a contest cache is provided (``cf_elo_caculator.py``).
 
-Writes per-sample generations to ``samples.jsonl`` and the aggregate
-(pass@1, pass@k, mean reward) to ``aggregate.json``.
+    python -m areal_tpu.apps.eval_offline --model-path /ckpts/step100 \
+        --dataset aime=aime24.jsonl --dataset mathd=math_500.jsonl \
+        --output-dir /tmp/eval --n-sampling 8 --with-greedy
+
+Per-benchmark sampling overrides ride ``--sampling-config cfg.json``:
+``{"aime": {"temperature": 1.0, "max_gen_tokens": 4096}}``.
+
+Writes ``<output-dir>/<name>/samples.jsonl`` per benchmark and ONE
+``<output-dir>/aggregate.json`` across all of them.
 """
 
 import argparse
@@ -19,15 +29,206 @@ import logging
 import os
 import sys
 import time
+from typing import Dict, List, Optional
 
 logger = logging.getLogger("areal_tpu.eval_offline")
+
+
+def unbiased_pass_at_k(n: int, c: int, k: int) -> float:
+    """P(at least one of k draws without replacement is correct) given c of
+    n samples were correct — the estimator from the reference
+    (``eval_and_aggregate.py:77-80``) and Codex (Chen et al. 2021)."""
+    import numpy as np
+
+    if n - c < k:
+        return 1.0
+    return float(1.0 - np.prod(1.0 - k / np.arange(n - c + 1, n + 1)))
+
+
+def majority_score(answers: List[str], scores: List[float], k: int) -> float:
+    """maj@k: group the first k answers by answer-equivalence, take the
+    largest group's representative score (``rm_maj_eval.py:group_pred``)."""
+    from areal_tpu.rewards.math_verify import answers_equal, extract_answer
+
+    preds = [
+        extract_answer(a, use_last_number=True) or "" for a in answers[:k]
+    ]
+    groups: List[List] = []  # [representative, member indices]
+    for i, p in enumerate(preds):
+        placed = False
+        for g in groups:
+            if p == g[0] or (p and g[0] and answers_equal(p, g[0])):
+                g[1].append(i)
+                placed = True
+                break
+        if not placed:
+            groups.append([p, [i]])
+    best = max(groups, key=lambda g: len(g[1]))
+    return float(scores[best[1][0]] > 0)
+
+
+def grade_answers(qid: str, answers: List[str], metadata: dict) -> List[float]:
+    """Task-dispatching grader: math via the parity verifier, code via the
+    subprocess test runner (the reference's functioncall/code path)."""
+    task = metadata.get("task", "math")
+    if task == "code":
+        from areal_tpu.rewards.code_verify import verify_code_solution
+
+        return [
+            1.0 if verify_code_solution(a, metadata.get("input_output", {}))
+            else -1.0
+            for a in answers
+        ]
+    from areal_tpu.rewards.math_verify import grade_math_answers
+
+    return grade_math_answers(answers, metadata.get("solutions", []))
+
+
+def _parse_datasets(specs: List[str]) -> Dict[str, str]:
+    out = {}
+    for spec in specs:
+        name, sep, path = spec.partition("=")
+        # 'name=path' only when the prefix looks like a NAME — a bare path
+        # containing '=' (e.g. /data/run=3/x.jsonl) must stay whole
+        if not sep or os.sep in name or not name:
+            name, path = "", spec
+            name = os.path.splitext(os.path.basename(path))[0]
+        out[name] = path
+    return out
+
+
+def evaluate_benchmark(
+    gen,
+    name: str,
+    path: str,
+    out_dir: str,
+    ghp_base,
+    decode,
+    *,
+    tokenizer=None,
+    n_sampling: int,
+    batch_prompts: int,
+    max_prompts: Optional[int],
+    seed: int,
+    with_greedy: bool,
+    cf_cache_dir: Optional[str],
+    cf_ratings: Optional[str],
+    cf_pass_n: Optional[int],
+) -> dict:
+    import dataclasses
+
+    import numpy as np
+
+    from areal_tpu.api.dataset import (
+        DatasetUtility,
+        dataset_metadata,
+        make_dataset,
+    )
+
+    util = DatasetUtility(
+        seed=seed, dp_rank=0, world_size=1, tokenizer=tokenizer
+    )
+    dataset = make_dataset("math_code_prompt", util, path=path)
+    metadata = dataset_metadata(dataset)
+    n = len(dataset) if max_prompts is None else min(max_prompts, len(dataset))
+    os.makedirs(out_dir, exist_ok=True)
+
+    per_prompt: List[dict] = []
+    cf_submissions = {}
+    t0 = time.time()
+    with open(os.path.join(out_dir, "samples.jsonl"), "w") as f:
+        for lo in range(0, n, batch_prompts):
+            samples = [
+                dataset[i] for i in range(lo, min(lo + batch_prompts, n))
+            ]
+            qids = [str(s.ids[0]) for s in samples]
+            prompts = [
+                np.asarray(s.data["packed_prompts"]).tolist() for s in samples
+            ]
+            groups = gen.generate(prompts, ghp_base, seed=seed + lo)
+            if with_greedy:
+                ghp_g = dataclasses.replace(ghp_base, n=1, greedy=True)
+                greedy_groups = gen.generate(prompts, ghp_g, seed=seed)
+            else:
+                greedy_groups = [None] * len(prompts)
+            for qid, prompt, group, ggroup in zip(
+                qids, prompts, groups, greedy_groups
+            ):
+                answers = [
+                    decode(o.tokens[len(prompt):].tolist()) for o in group
+                ]
+                rws = grade_answers(qid, answers, metadata.get(qid, {}))
+                rec = {
+                    "qid": qid,
+                    "answers": answers,
+                    "rewards": rws,
+                    "gen_lens": [len(o.gen_logprobs) for o in group],
+                    "no_eos": [bool(o.no_eos) for o in group],
+                }
+                if ggroup is not None:
+                    g_ans = decode(ggroup[0].tokens[len(prompt):].tolist())
+                    g_rw = grade_answers(qid, [g_ans], metadata.get(qid, {}))
+                    rec["greedy_answer"] = g_ans
+                    rec["greedy_reward"] = g_rw[0]
+                    rec["greedy_len"] = len(ggroup[0].gen_logprobs)
+                if cf_cache_dir:
+                    cf_submissions[qid] = [r > 0 for r in rws]
+                per_prompt.append(rec)
+                f.write(json.dumps(rec) + "\n")
+            logger.info(
+                "[%s] evaluated %d/%d prompts",
+                name, min(lo + batch_prompts, n), n,
+            )
+
+    ks = [1] + [k for k in (2, 4, 8, 16, 32) if k <= n_sampling]
+    agg: dict = {
+        "dataset": path,
+        "n_prompts": len(per_prompt),
+        "n_sampling": n_sampling,
+        "sample_length": float(np.mean(
+            [l for r in per_prompt for l in r["gen_lens"]]
+        )) if per_prompt else 0.0,
+        "reward_mean": float(np.mean(
+            [x for r in per_prompt for x in r["rewards"]]
+        )) if per_prompt else 0.0,
+        "wall_s": time.time() - t0,
+    }
+    for k in ks:
+        agg[f"pass@{k}"] = float(np.mean([
+            unbiased_pass_at_k(
+                len(r["rewards"]), sum(x > 0 for x in r["rewards"]), k
+            )
+            for r in per_prompt
+        ])) if per_prompt else 0.0
+    for k in (k for k in (8, 16, 32) if k <= n_sampling):
+        agg[f"maj@{k}"] = float(np.mean([
+            majority_score(r["answers"], r["rewards"], k) for r in per_prompt
+        ])) if per_prompt else 0.0
+    if with_greedy and per_prompt and "greedy_reward" in per_prompt[0]:
+        agg["greedy_acc"] = float(np.mean(
+            [r["greedy_reward"] > 0 for r in per_prompt]
+        ))
+        agg["greedy_length"] = float(np.mean(
+            [r["greedy_len"] for r in per_prompt]
+        ))
+    if cf_cache_dir:
+        from areal_tpu.apps import cf_elo
+
+        agg["cf"] = cf_elo.calculate_cf_elo(
+            cf_submissions, cf_cache_dir, cf_ratings, pass_n=cf_pass_n
+        )
+    return agg
 
 
 def main(argv=None):
     logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser()
     ap.add_argument("--model-path", required=True, help="HF checkpoint dir")
-    ap.add_argument("--dataset", required=True, help="prompt jsonl (math_code_prompt format)")
+    ap.add_argument(
+        "--dataset", action="append", required=True,
+        help="benchmark jsonl, repeatable; 'name=path' or bare path "
+             "(name defaults to the file stem)",
+    )
     ap.add_argument("--output-dir", required=True)
     ap.add_argument("--tokenizer", default=None, help="tokenizer path (defaults to model)")
     ap.add_argument("--parallel", default="d1m1")
@@ -35,7 +236,16 @@ def main(argv=None):
     ap.add_argument("--max-gen-tokens", type=int, default=1024)
     ap.add_argument("--temperature", type=float, default=0.6)
     ap.add_argument("--top-p", type=float, default=0.95)
-    ap.add_argument("--greedy", action="store_true")
+    ap.add_argument("--greedy", action="store_true",
+                    help="sampling pass itself decodes greedily (n forced 1)")
+    ap.add_argument("--with-greedy", action="store_true",
+                    help="ALSO run a greedy pass per benchmark (greedy_acc)")
+    ap.add_argument(
+        "--sampling-config", default=None,
+        help="JSON file: benchmark name -> overrides (temperature, top_p, "
+             "max_gen_tokens, n_sampling) — the reference's per-benchmark "
+             "prompt/sampling configs",
+    )
     ap.add_argument("--max-prompts", type=int, default=None)
     ap.add_argument("--batch-prompts", type=int, default=32)
     ap.add_argument("--seed", type=int, default=0)
@@ -58,23 +268,24 @@ def main(argv=None):
     ap.add_argument(
         "--allow-token-id-answers", action="store_true",
         help="debug only: grade space-joined token-id strings when no "
-             "tokenizer is available (real math grading needs one)",
+             "tokenizer is available (real grading needs one)",
     )
     args = ap.parse_args(argv)
 
-    out_samples = os.path.join(args.output_dir, "samples.jsonl")
     out_agg = os.path.join(args.output_dir, "aggregate.json")
     if os.path.exists(out_agg) and not args.overwrite:
         logger.info("aggregate exists (%s); pass --overwrite to redo", out_agg)
         return 0
     os.makedirs(args.output_dir, exist_ok=True)
 
-    import numpy as np
+    datasets = _parse_datasets(args.dataset)
+    overrides = {}
+    if args.sampling_config:
+        with open(args.sampling_config) as f:
+            overrides = json.load(f)
 
-    from areal_tpu.api.dataset import DatasetUtility, make_dataset
     from areal_tpu.api.model import GenerationHyperparameters
-    from areal_tpu.parallel.mesh import ParallelConfig
-    from areal_tpu.system.sync_trainer import math_reward_fn
+    from areal_tpu.experiments.config import ModelSpec
     from areal_tpu.train.engine import TrainEngine
     from areal_tpu.train.generation import SyncGenerator
 
@@ -93,84 +304,44 @@ def main(argv=None):
                 "--allow-token-id-answers (debug)"
             )
         logger.warning("no tokenizer at %s; decoding as token-id strings", tok_path)
-    util = DatasetUtility(seed=args.seed, dp_rank=0, world_size=1, tokenizer=tokenizer)
-    dataset = make_dataset("math_code_prompt", util, path=args.dataset)
-    from areal_tpu.api.dataset import dataset_metadata
-
-    metadata = dataset_metadata(dataset)
-    n = len(dataset) if args.max_prompts is None else min(args.max_prompts, len(dataset))
-
-    from areal_tpu.experiments.config import ModelSpec
-
-    spec = ModelSpec(path=args.model_path, parallel=args.parallel)
-    eng = TrainEngine(spec.model_config(), spec.parallel_config())
-    eng.load_hf(args.model_path)
-    gen = SyncGenerator(eng)
-    ghp = GenerationHyperparameters(
-        n=args.n_sampling,
-        max_new_tokens=args.max_gen_tokens,
-        greedy=args.greedy,
-        temperature=args.temperature,
-        top_p=args.top_p,
-        stop_token_ids=(
-            [tokenizer.eos_token_id]
-            if tokenizer is not None and tokenizer.eos_token_id is not None
-            else []
-        ),
-    )
     decode = (
         (lambda ids: tokenizer.decode(ids, skip_special_tokens=True))
         if tokenizer is not None
         else (lambda ids: " ".join(map(str, ids)))
     )
 
-    pass1, passk, rewards_all = [], [], []
-    cf_submissions = {}
-    t0 = time.time()
-    with open(out_samples, "w") as f:
-        for lo in range(0, n, args.batch_prompts):
-            samples = [dataset[i] for i in range(lo, min(lo + args.batch_prompts, n))]
-            qids = [str(s.ids[0]) for s in samples]
-            prompts = [np.asarray(s.data["packed_prompts"]).tolist() for s in samples]
-            groups = gen.generate(prompts, ghp, seed=args.seed + lo)
-            for qid, prompt, group in zip(qids, prompts, groups):
-                answers = [decode(o.tokens[len(prompt):].tolist()) for o in group]
-                rws = math_reward_fn(qid, answers, metadata.get(qid, {}))
-                oks = [r > 0 for r in rws]
-                if args.cf_cache_dir:
-                    cf_submissions[qid] = oks
-                pass1.append(float(np.mean(oks)))
-                passk.append(float(any(oks)))
-                rewards_all.extend(rws)
-                f.write(json.dumps({
-                    "qid": qid,
-                    "answers": answers,
-                    "rewards": rws,
-                    "gen_lens": [len(o.gen_logprobs) for o in group],
-                    "no_eos": [bool(o.no_eos) for o in group],
-                }) + "\n")
-            logger.info("evaluated %d/%d prompts", min(lo + args.batch_prompts, n), n)
+    spec = ModelSpec(path=args.model_path, parallel=args.parallel)
+    eng = TrainEngine(spec.model_config(), spec.parallel_config())
+    eng.load_hf(args.model_path)
+    gen = SyncGenerator(eng)
 
-    agg = {
-        "model": args.model_path,
-        "dataset": args.dataset,
-        "n_prompts": n,
-        "n_sampling": args.n_sampling,
-        "pass@1": float(np.mean(pass1)) if pass1 else 0.0,
-        f"pass@{args.n_sampling}": float(np.mean(passk)) if passk else 0.0,
-        "reward_mean": float(np.mean(rewards_all)) if rewards_all else 0.0,
-        "wall_s": time.time() - t0,
-    }
-    if args.cf_cache_dir:
-        from areal_tpu.apps import cf_elo
-
-        agg["cf"] = cf_elo.calculate_cf_elo(
-            cf_submissions, args.cf_cache_dir, args.cf_ratings,
-            pass_n=args.cf_pass_n,
+    aggregate = {"model": args.model_path, "benchmarks": {}}
+    for name, path in datasets.items():
+        ov = overrides.get(name, {})
+        n_sampling = int(ov.get("n_sampling", args.n_sampling))
+        ghp = GenerationHyperparameters(
+            n=1 if args.greedy else n_sampling,
+            max_new_tokens=int(ov.get("max_gen_tokens", args.max_gen_tokens)),
+            greedy=args.greedy,
+            temperature=float(ov.get("temperature", args.temperature)),
+            top_p=float(ov.get("top_p", args.top_p)),
+            stop_token_ids=(
+                [tokenizer.eos_token_id]
+                if tokenizer is not None and tokenizer.eos_token_id is not None
+                else []
+            ),
+        )
+        aggregate["benchmarks"][name] = evaluate_benchmark(
+            gen, name, path, os.path.join(args.output_dir, name), ghp, decode,
+            tokenizer=tokenizer,
+            n_sampling=ghp.n, batch_prompts=args.batch_prompts,
+            max_prompts=args.max_prompts, seed=args.seed,
+            with_greedy=args.with_greedy, cf_cache_dir=args.cf_cache_dir,
+            cf_ratings=args.cf_ratings, cf_pass_n=args.cf_pass_n,
         )
     with open(out_agg, "w") as f:
-        json.dump(agg, f, indent=2)
-    logger.info("aggregate: %s", agg)
+        json.dump(aggregate, f, indent=2)
+    logger.info("aggregate: %s", json.dumps(aggregate, indent=2))
     return 0
 
 

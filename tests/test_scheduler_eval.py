@@ -102,32 +102,69 @@ def test_eval_offline_harness(tmp_path):
         cfg, "qwen2", ckpt,
     )
     data = str(tmp_path / "math.jsonl")
+    data2 = str(tmp_path / "more.jsonl")
     rng = np.random.default_rng(0)
-    with open(data, "w") as f:
-        for i in range(4):
-            f.write(json.dumps({
-                "query_id": f"q{i}",
-                "prompt_ids": [int(x) for x in rng.integers(1, 128, 6)],
-                "task": "math",
-                "solutions": ["\\boxed{7}"],
-            }) + "\n")
+    for path, n_prompts in ((data, 4), (data2, 2)):
+        with open(path, "w") as f:
+            for i in range(n_prompts):
+                f.write(json.dumps({
+                    "query_id": f"q{i}",
+                    "prompt_ids": [int(x) for x in rng.integers(1, 128, 6)],
+                    "task": "math",
+                    "solutions": ["\\boxed{7}"],
+                }) + "\n")
+    # per-benchmark sampling override (the reference's per-benchmark configs)
+    sampling_cfg = str(tmp_path / "sampling.json")
+    with open(sampling_cfg, "w") as f:
+        json.dump({"more": {"max_gen_tokens": 4, "temperature": 1.0}}, f)
     out = str(tmp_path / "eval")
     rc = eval_offline.main([
-        "--model-path", ckpt, "--dataset", data, "--output-dir", out,
-        "--n-sampling", "2", "--max-gen-tokens", "8", "--greedy",
+        "--model-path", ckpt, "--dataset", data,
+        "--dataset", f"more={data2}", "--output-dir", out,
+        "--n-sampling", "2", "--max-gen-tokens", "8", "--with-greedy",
         "--batch-prompts", "2", "--allow-token-id-answers",
+        "--sampling-config", sampling_cfg,
     ])
     assert rc == 0
     agg = json.load(open(os.path.join(out, "aggregate.json")))
-    assert agg["n_prompts"] == 4 and "pass@1" in agg and "pass@2" in agg
-    lines = [json.loads(l) for l in open(os.path.join(out, "samples.jsonl"))]
+    assert set(agg["benchmarks"]) == {"math", "more"}
+    m = agg["benchmarks"]["math"]
+    assert m["n_prompts"] == 4 and "pass@1" in m and "pass@2" in m
+    assert "greedy_acc" in m and "sample_length" in m
+    assert agg["benchmarks"]["more"]["n_prompts"] == 2
+    lines = [json.loads(l) for l in
+             open(os.path.join(out, "math", "samples.jsonl"))]
     assert len(lines) == 4
     assert all(len(l["answers"]) == 2 for l in lines)
+    assert all("greedy_answer" in l for l in lines)
+    # the override capped generation length for the second benchmark
+    lines2 = [json.loads(l) for l in
+              open(os.path.join(out, "more", "samples.jsonl"))]
+    assert all(max(l["gen_lens"]) <= 4 for l in lines2)
     # idempotence: a second run without --overwrite is a no-op
     assert eval_offline.main([
         "--model-path", ckpt, "--dataset", data, "--output-dir", out,
         "--n-sampling", "2", "--allow-token-id-answers",
     ]) == 0
+
+
+def test_pass_at_k_estimator_and_majority():
+    from areal_tpu.apps.eval_offline import (
+        majority_score,
+        unbiased_pass_at_k,
+    )
+
+    # exact combinatorial identities
+    assert unbiased_pass_at_k(8, 8, 1) == 1.0
+    assert unbiased_pass_at_k(8, 0, 8) == 0.0
+    assert abs(unbiased_pass_at_k(8, 4, 1) - 0.5) < 1e-12
+    # n=4, c=2, k=2: 1 - C(2,2)/C(4,2) = 1 - 1/6
+    assert abs(unbiased_pass_at_k(4, 2, 2) - (1 - 1 / 6)) < 1e-12
+    # majority voting groups equivalent answers ("0.5" with "\\frac{1}{2}")
+    answers = ["\\boxed{0.5}", "\\boxed{\\frac{1}{2}}", "\\boxed{3}"]
+    assert majority_score(answers, [1.0, 1.0, -1.0], 3) == 1.0
+    assert majority_score(["\\boxed{3}", "\\boxed{3}", "\\boxed{0.5}"],
+                          [-1.0, -1.0, 1.0], 3) == 0.0
 
 
 # --------------------------------------------------------------------------- #
