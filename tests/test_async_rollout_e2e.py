@@ -186,3 +186,167 @@ async def test_async_rollout_end_to_end(tmp_path, rng):
     stream.close()
     await gen_runner.cleanup()
     await mgr_runner.cleanup()
+
+
+async def test_weight_sync_sharded_trainer_to_tp_gen_server(tmp_path, rng):
+    """VERDICT r2 #6: the full weight-sync channel across HETEROGENEOUS
+    placements — trainer params sharded over a 4-device dp x tp mesh,
+    generation served TP-sharded on a DIFFERENT 2-device block — driven
+    through TWO complete round trips:
+    train_step -> save_hf (gathers shards) -> name_resolve version bump ->
+    manager fan-out (HTTP update_weights_from_disk) -> TP engine re-shard.
+    After each swap the engine's greedy outputs must match the trainer's
+    current policy, and version tags must propagate to outputs."""
+    import dataclasses as dc
+
+    from jax.sharding import Mesh
+    from areal_tpu.models import hf as hf_conv
+    from areal_tpu.parallel.mesh import ParallelConfig
+    from areal_tpu.train.engine import OptimizerConfig, TrainEngine
+
+    name_resolve.reset()
+    exp, trial = "e2e-sync", "t0"
+    cfg = dc.replace(CFG, use_attention_bias=True)  # qwen2-exportable
+
+    # trainer: d2 x m2 over devices [0:4]
+    teng = TrainEngine(
+        cfg, ParallelConfig(data=2, model=2), OptimizerConfig(lr=5e-2)
+    )
+    teng.init_random(0)
+    teng.setup_optimizer(10)
+
+    # generation server: TP over devices [4:6]
+    gmesh = Mesh(np.array(jax.devices()[4:6]), ("model",))
+    ckpt0 = str(tmp_path / "v0")
+    teng.save_hf(ckpt0, "qwen2")
+    _, host0 = hf_conv.load_hf_checkpoint(ckpt0)
+    geng = GenerationEngine(
+        cfg, host0, max_slots=2, max_seqlen=128, seed=0, mesh=gmesh
+    )
+    gen_port = network.find_free_port()
+    gen_runner = await serve(geng, "127.0.0.1", gen_port, decode_steps=4)
+    name_resolve.add(
+        names.gen_server(exp, trial, 0),
+        f"http://127.0.0.1:{gen_port}", replace=True,
+    )
+
+    mcfg = GserverManagerConfig(
+        experiment_name=exp, trial_name=trial, train_batch_size=4,
+        max_head_offpolicyness=1, max_concurrent_rollouts=8,
+    )
+    manager = GserverManager(mcfg)
+    manager.discover_servers()
+    mgr_runner = await serve_manager(manager, "127.0.0.1", network.find_free_port())
+
+    import aiohttp
+
+    async def greedy_via_server(n=6):
+        """Probe through the HTTP endpoint — the engine is owned by the
+        server's background loop; direct step() calls would race it."""
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(
+                f"http://127.0.0.1:{gen_port}/generate",
+                json={
+                    "rid": f"probe{np.random.randint(1 << 30)}",
+                    "input_ids": [3, 14, 15, 9, 2],
+                    "sampling_params": {"max_new_tokens": n, "greedy": True},
+                },
+            ) as r:
+                d = await r.json()
+        import types
+
+        return types.SimpleNamespace(
+            output_ids=d["output_ids"], version=d["version"]
+        )
+
+    def trainer_greedy(n=6):
+        """Teacher-forced argmax chain on the trainer's CURRENT params."""
+        host = jax.tree.map(np.asarray, multihost_gather(teng))
+        ids = [3, 14, 15, 9, 2]
+        for _ in range(n):
+            T = len(ids)
+            pad = ((T + 127) // 128) * 128
+            logits = tfm.forward_packed(
+                jax.tree.map(jnp_asarray, host), cfg,
+                _arr(np.r_[ids, np.zeros(pad - T)], np.int32),
+                _arr(np.r_[np.ones(T), np.zeros(pad - T)], np.int32),
+                _arr(np.r_[np.arange(T), np.zeros(pad - T)], np.int32),
+                remat=False,
+            )
+            ids.append(int(np.argmax(np.asarray(logits)[T - 1])))
+        return ids[5:]
+
+    import jax.numpy as _jnp
+
+    def multihost_gather(eng):
+        from areal_tpu.parallel import multihost
+        return multihost.gather_params_to_host(eng.params)
+
+    def jnp_asarray(x):
+        return _jnp.asarray(x)
+
+    def _arr(x, dt):
+        return _jnp.asarray(np.asarray(x, dt))
+
+    def train_one_step():
+        n, t = 4, 24
+        sample = SequenceSample.from_default(
+            ids=list(range(n)), seqlens=[t] * n,
+            data={
+                "packed_input_ids": np.random.default_rng(1).integers(
+                    5, 120, size=n * t
+                ).astype(np.int64),
+                "prompt_mask": np.tile(
+                    np.r_[np.ones(4, np.bool_), np.zeros(t - 4, np.bool_)], n
+                ),
+            },
+        )
+        from areal_tpu.interfaces.sft import sft_loss_fn
+        teng.train_batch(sample, MicroBatchSpec(max_tokens_per_mb=128),
+                         sft_loss_fn)
+
+    try:
+        # round trip 1
+        train_one_step()
+        ckpt1 = str(tmp_path / "v1")
+        teng.save_hf(ckpt1, "qwen2")
+        name_resolve.add(
+            names.model_version(exp, trial, "actor"), f"1:{ckpt1}",
+            replace=True,
+        )
+        path = await manager.check_new_params()
+        assert path == ckpt1 and manager.version == 1 and geng.version == 1
+        # the TP engine now serves the trainer's post-step policy, sharded
+        assert geng.params["layers"]["attn"]["wq"].sharding.spec[-1] == "model"
+        out1 = await greedy_via_server()
+        assert out1.version == 1
+        assert out1.output_ids == trainer_greedy()
+
+        # round trip 2 (lr is large so params demonstrably moved)
+        train_one_step()
+        ckpt2 = str(tmp_path / "v2")
+        teng.save_hf(ckpt2, "qwen2")
+        name_resolve.add(
+            names.model_version(exp, trial, "actor"), f"2:{ckpt2}",
+            replace=True,
+        )
+        path = await manager.check_new_params()
+        assert path == ckpt2 and manager.version == 2 and geng.version == 2
+        out2 = await greedy_via_server()
+        assert out2.version == 2
+        assert out2.output_ids == trainer_greedy()
+
+        # staleness gate reflects the synced version: with version=2 and
+        # max_head_offpolicyness=1, intake stays open until training_samples
+        # implies a version > 3
+        name_resolve.add(
+            names.training_samples(exp, trial), "12", replace=True
+        )
+        assert not manager.is_staled()   # 12 // 4 = 3 <= 2 + 1
+        name_resolve.add(
+            names.training_samples(exp, trial), "16", replace=True
+        )
+        assert manager.is_staled()       # 16 // 4 = 4 > 3
+    finally:
+        await gen_runner.cleanup()
+        await mgr_runner.cleanup()
