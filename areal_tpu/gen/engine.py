@@ -150,7 +150,10 @@ class GenerationEngine:
                         f"divisible by the model-axis size {tp}"
                     )
             self._repl = NamedSharding(mesh, P())
-            self._pages_sh = NamedSharding(mesh, P(None, None, None, "model", None))
+            # pool [L, P, 2, Hkv, page, D]: shard the kv-head dim
+            self._pages_sh = NamedSharding(
+                mesh, P(None, None, None, "model", None, None)
+            )
             from areal_tpu.parallel.mesh import param_shardings
 
             self._param_sh = param_shardings(
@@ -210,10 +213,7 @@ class GenerationEngine:
                 lambda _: self._repl, jax.eval_shape(make_state)
             )
             sh = dataclasses.replace(
-                sh,
-                cache=tfm.PagedKVCache(
-                    k_pages=self._pages_sh, v_pages=self._pages_sh
-                ),
+                sh, cache=tfm.PagedKVCache(pages=self._pages_sh)
             )
             self._state_sh = sh
             self.state = jax.jit(make_state, out_shardings=sh)()

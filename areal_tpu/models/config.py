@@ -98,6 +98,18 @@ class ModelConfig:
     # rejects batches that violate the bound.
     attn_max_seqlen: Optional[int] = None
 
+    # Flash-attention block size override (None = auto: 1024 at T >= 8192,
+    # else 512). Bigger score tiles amortize the kernels' VPU mask/softmax
+    # passes at very long context; may need more VMEM.
+    flash_block_size: Optional[int] = None
+
+    # Cross-entropy in token blocks of this size (None = dense): the LM
+    # head + log-softmax + label gather run per block under remat, so the
+    # [T, vocab] logits (4 GB f32 at the 32k protocol shape) never
+    # materialize. Trades one extra head matmul in the backward for ~8 GB
+    # of HBM round trips per step.
+    loss_chunk_size: Optional[int] = None
+
     # Layer-stack execution: 1 = lax.scan over stacked layers (one trace,
     # fast compiles — the default); an int N or True unrolls the scan (full
     # unroll removes the per-layer dynamic-update-slice bookkeeping XLA

@@ -318,10 +318,14 @@ def forward_packed(
     *,
     remat: bool = True,
     with_aux: bool = False,
+    with_head: bool = True,
 ) -> jnp.ndarray:
     """Full forward over a packed token axis. Returns ``[T, vocab]`` logits
     (fp32) or ``[T, 1]`` values for critics; with ``with_aux`` returns
     ``(out, aux_loss)`` where aux is the summed MoE router loss over layers.
+    ``with_head=False`` returns the final-norm HIDDEN states ``[T, E]``
+    instead — the chunked-loss path applies the head per token block so the
+    ``[T, vocab]`` logits (4 GB f32 at 32k x 32k) never materialize.
     Padding rows are garbage — mask downstream with ``segment_ids > 0``."""
     x = _embed(cfg, params, input_ids, positions)
     if cfg.apply_rotary:
@@ -339,6 +343,7 @@ def forward_packed(
             soft_cap=cfg.attn_logits_soft_cap,
             sliding_window=cfg.sliding_window,
             use_flash=cfg.flash_enabled(),
+            flash_block_size=cfg.flash_block_size,
             max_seqlen=cfg.attn_max_seqlen,
         )
 
@@ -401,10 +406,53 @@ def forward_packed(
         layer, x, params["layers"], unroll=cfg.layer_scan_unroll or 1
     )
     x = _norm(cfg, _cast(cfg, params["final_ln"]), x)
-    out = _head(cfg, params, x)
+    out = _head(cfg, params, x) if with_head else x
     if with_aux:
         return out, jnp.sum(auxes)
     return out
+
+
+def chunked_next_token_logprobs(
+    params: Params,
+    cfg: ModelConfig,
+    hidden: jnp.ndarray,       # [T, E] final-norm hidden (with_head=False)
+    input_ids: jnp.ndarray,    # [T]
+    segment_ids: jnp.ndarray,  # [T]
+    chunk: int = 4096,
+) -> jnp.ndarray:
+    """Next-token logprobs ``[T]`` without ever materializing ``[T, vocab]``
+    logits: a remat'd ``lax.scan`` over token blocks applies the LM head,
+    log-softmaxes, and gathers the label per block — forward peak memory
+    ``[chunk, vocab]``, and the backward recomputes each block's logits
+    instead of keeping 4 GB of f32 logits alive at the 32k protocol shape
+    (the head matmul recompute is ~2 TFLOP vs ~8 GB of HBM round trips).
+    Semantics match ``ops.ppo.gather_packed_shifted_log_probs``."""
+    from areal_tpu.ops import ppo as ppo_ops
+
+    T = hidden.shape[0]
+    if T % chunk:
+        # round DOWN to a divisor of T — falling back to one [T, vocab]
+        # block would re-materialize exactly the logits this path exists
+        # to avoid
+        chunk = next(c for c in range(min(chunk, T), 0, -1) if T % c == 0)
+    nc = T // chunk
+    nxt = jnp.concatenate([input_ids[1:], jnp.zeros((1,), input_ids.dtype)])
+
+    def block(_, blk):
+        h_c, ids_c = blk
+        logits = _head(cfg, params, h_c)              # [chunk, V] f32
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        lp = jnp.take_along_axis(logp, ids_c[:, None], axis=-1)[:, 0]
+        return None, lp
+
+    _, lps = jax.lax.scan(
+        jax.checkpoint(block, prevent_cse=False),
+        None,
+        (hidden.reshape(nc, chunk, -1), nxt.reshape(nc, chunk)),
+    )
+    lp = lps.reshape(T)
+    has_next = (segment_ids > 0) & ~ppo_ops.is_segment_end(segment_ids)
+    return jnp.where(has_next, lp, 0.0)
 
 
 # --------------------------------------------------------------------------- #
@@ -592,19 +640,26 @@ def decode_step(
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class PagedKVCache:
-    """KV page pool: ``k/v_pages [L, P, page, Hkv, D]``. Slot state (page
-    tables, lengths) lives with the generation engine — the pool itself has
-    no per-sequence structure, which is exactly what lets prompts share
-    pages (counterpart of SGLang's radix-cache memory, SURVEY §2.1)."""
+    """KV page pool: ``pages [L, P, 2, Hkv, page, D]`` — K and V INTERLEAVED
+    per page (index 0 = K, 1 = V), so one page is ONE contiguous block and
+    the decode kernel fetches a page's K and V with a single DMA, and the
+    HEAD dim comes before the token dim so pages DMA straight into the
+    kernel's ``[Hkv, S, D]`` compute layout with NO in-VMEM transpose
+    (per-body relayouts of the KV block, not bandwidth or DMA count,
+    bounded scattered-page decode — measured round 3). Slot state (page
+    tables, lengths) lives with the generation engine — the pool itself
+    has no per-sequence structure, which is exactly what lets prompts
+    share pages (counterpart of SGLang's radix-cache memory, SURVEY
+    §2.1)."""
 
-    k_pages: jnp.ndarray
-    v_pages: jnp.ndarray
+    pages: jnp.ndarray
 
     @classmethod
     def empty(cls, cfg: ModelConfig, n_pages: int, page_size: int) -> "PagedKVCache":
-        shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
-        dt = jnp.dtype(cfg.dtype)
-        return cls(k_pages=jnp.zeros(shape, dt), v_pages=jnp.zeros(shape, dt))
+        shape = (
+            cfg.n_layers, n_pages, 2, cfg.n_kv_heads, page_size, cfg.head_dim
+        )
+        return cls(pages=jnp.zeros(shape, jnp.dtype(cfg.dtype)))
 
 
 def _scatter_chunk_kv(cache: PagedKVCache, ks, vs, table, positions, valid):
@@ -614,9 +669,10 @@ def _scatter_chunk_kv(cache: PagedKVCache, ks, vs, table, positions, valid):
     layer scan — the pool never rides the scan carry (which streamed the
     whole multi-GB pool through stacked scan outputs every step; measured
     ~30 ms/step at a 1.5B/64-slot decode, round-3 xprof). No flat reshape
-    either: the scatter indexes ``(layer, page, offset)`` natively."""
+    either: the scatter indexes ``(layer, page, offset)`` natively, and
+    K + V land together through the pool's interleaved kv dim."""
     L = ks.shape[0]
-    P, page = cache.k_pages.shape[1:3]
+    P, _, _, page = cache.pages.shape[1:5]
     M = table.shape[1]
     page_idx = jnp.take_along_axis(
         table, jnp.clip(positions // page, 0, M - 1), axis=1
@@ -627,10 +683,11 @@ def _scatter_chunk_kv(cache: PagedKVCache, ks, vs, table, positions, valid):
     li = jnp.broadcast_to(l_idx, (L,) + page_idx.shape)
     pi = jnp.broadcast_to(page_idx[None], (L,) + page_idx.shape)
     oi = jnp.broadcast_to(off[None], (L,) + off.shape)
-    dt = cache.k_pages.dtype
+    dt = cache.pages.dtype
+    # pages[li, pi, :, :, oi]: advanced dims first -> update [L,B,C, 2,H,D]
+    kv = jnp.stack([ks, vs], axis=3).astype(dt)
     return PagedKVCache(
-        k_pages=cache.k_pages.at[li, pi, oi].set(ks.astype(dt), mode="drop"),
-        v_pages=cache.v_pages.at[li, pi, oi].set(vs.astype(dt), mode="drop"),
+        pages=cache.pages.at[li, pi, :, :, oi].set(kv, mode="drop")
     )
 
 
@@ -668,7 +725,7 @@ def extend_paged(
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
         ctx = paged_ops.paged_extend_attention(
-            q, k, v, cache.k_pages, cache.v_pages, li, table, start, n_new,
+            q, k, v, cache.pages, li, table, start, n_new,
             softmax_scale=cfg.softmax_scale,
             soft_cap=cfg.attn_logits_soft_cap,
             sliding_window=cfg.sliding_window,
@@ -716,7 +773,7 @@ def decode_step_paged(
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
         ctx = paged_ops.paged_decode_attention(
-            q, k, v, cache.k_pages, cache.v_pages, li, table, lens,
+            q, k, v, cache.pages, li, table, lens,
             softmax_scale=cfg.softmax_scale,
             soft_cap=cfg.attn_logits_soft_cap,
             sliding_window=cfg.sliding_window,

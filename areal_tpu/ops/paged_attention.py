@@ -1,12 +1,14 @@
 """Paged KV attention: decode + chunked-extend over a page pool.
 
 TPU-native counterpart of the paged attention the reference inherits from
-SGLang/vLLM CUDA kernels. KV lives in a pool ``[n_pages, page, Hkv, D]``
-(per layer); each slot owns a page TABLE ``[M]`` instead of a dense slab, so
-HBM scales with resident tokens and identical prompts share pages.
+SGLang/vLLM CUDA kernels. KV lives in a pool ``[L, P, 2, Hkv, page, D]``
+(K and V interleaved per page — one page, one contiguous block, one DMA,
+heads before tokens so the decode kernel needs no in-VMEM transpose);
+each slot owns a page TABLE ``[M]`` instead of a dense slab, so HBM scales
+with resident tokens and identical prompts share pages.
 
 DESIGN: the pool is READ-ONLY inside these ops. The caller's layer scan
-passes each layer's pages as scan xs and the CURRENT tokens' K/V as
+passes the whole pool plus a layer index and the CURRENT tokens' K/V as
 separate operands; attention folds the fresh tokens in analytically
 (online-softmax merge of the pool part and the self/intra-chunk part), and
 the model writes all layers' new KV into the pool in ONE scatter after the
@@ -16,11 +18,11 @@ stacked outputs every decode step (dynamic-update-slice + copy ≈ 30 ms/step
 at a 1.5B/64-slot profile — measured, round-3 xprof).
 
 Two implementations:
-- XLA gather path (here): gather the slot's pages into a contiguous view —
-  correct everywhere (CPU tests); callers pass width-limited tables so the
-  gather reads O(resident) pages.
+- XLA gather path (here): one fused gather of the slot's pages into a
+  contiguous view — correct everywhere (CPU tests); callers pass
+  width-limited tables so the gather reads O(resident) pages.
 - Pallas kernel (``ops/pallas/paged_attention.py``): reads pages in place
-  via scalar-prefetch table indices on TPU — no materialized gather.
+  via kernel-issued DMAs on TPU — no materialized gather.
 """
 
 from typing import Optional, Tuple
@@ -31,26 +33,28 @@ import jax.numpy as jnp
 _NEG_INF = -2.3819763e38
 
 
-def gather_pages(pages: jnp.ndarray, table: jnp.ndarray, layer=None) -> jnp.ndarray:
-    """``[P, page, Hkv, D]`` (or ``[L, P, ...]`` + ``layer``) + table
-    ``[B, M]`` -> ``[B, M*page, Hkv, D]`` (a contiguous per-slot view;
+def gather_pages(
+    pages: jnp.ndarray, table: jnp.ndarray, layer
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``[L, P, 2, Hkv, page, D]`` + table ``[B, M]`` + layer index ->
+    ``(k, v)`` each ``[B, M*page, Hkv, D]`` (contiguous per-slot views;
     garbage beyond the slot's length, masked by the caller's ``lens``).
-    With a layer index the gather fuses the layer dimension — no
-    materialized ``[P, page, Hkv, D]`` layer slice."""
+    ONE gather serves K and V, and the layer index fuses into it — no
+    materialized per-layer slice."""
     B, M = table.shape
-    if layer is None:
-        g = pages[table]                   # [B, M, page, Hkv, D]
-    else:
-        g = pages[layer, table]
-    return g.reshape(B, M * g.shape[2], *g.shape[3:])
+    g = pages[layer, table]                # [B, M, 2, Hkv, page, D]
+    Hkv, page, D = g.shape[3:]
+    g = jnp.swapaxes(g, 3, 4)              # [B, M, 2, page, Hkv, D]
+    k = g[:, :, 0].reshape(B, M * page, Hkv, D)
+    v = g[:, :, 1].reshape(B, M * page, Hkv, D)
+    return k, v
 
 
 def paged_decode_attention(
     q: jnp.ndarray,          # [B, H, D] one new token per slot
     k_self: jnp.ndarray,     # [B, Hkv, D] the new token's K (not in pool)
     v_self: jnp.ndarray,     # [B, Hkv, D]
-    k_pages: jnp.ndarray,    # [L, P, page, Hkv, D] the WHOLE pool
-    v_pages: jnp.ndarray,
+    pages: jnp.ndarray,      # [L, P, 2, Hkv, page, D] the WHOLE pool
     layer: jnp.ndarray,      # scalar i32 layer index
     table: jnp.ndarray,      # [B, M] i32
     lens: jnp.ndarray,       # [B] tokens RESIDENT IN THE POOL (excl. self)
@@ -64,13 +68,9 @@ def paged_decode_attention(
     The pool holds positions ``[0, lens)``; the query sits at position
     ``lens`` and always attends itself via ``k_self``/``v_self`` (its KV is
     scattered into the pool by the caller AFTER the layer scan). Returns
-    ``[B, H, D]``. The pool rides in WHOLE (all layers): the Pallas path
-    feeds the layer index through the scalar-prefetch index map and the
-    XLA path fuses it into the gather — neither materializes a per-layer
-    slice (which costs a full pool read/write per decode step when the
-    layer scan slices its xs)."""
+    ``[B, H, D]``."""
     B, H, D = q.shape
-    Hkv = k_pages.shape[3]
+    Hkv = pages.shape[3]
     n_rep = H // Hkv
     if softmax_scale is None:
         softmax_scale = D ** -0.5
@@ -80,18 +80,17 @@ def paged_decode_attention(
         use_pallas = (
             jax.devices()[0].platform == "tpu"
             and q.shape[-1] % 128 == 0
-            and k_pages.shape[2] % 8 == 0
+            and pages.shape[4] % 8 == 0
         )
     if use_pallas:
         from areal_tpu.ops.pallas import paged_attention as pl_paged
 
         return pl_paged.decode(
-            q, k_self, v_self, k_pages, v_pages, layer, table, lens,
+            q, k_self, v_self, pages, layer, table, lens,
             softmax_scale=softmax_scale, soft_cap=soft_cap,
             sliding_window=sliding_window,
         )
-    k = gather_pages(k_pages, table, layer)  # [B, S, Hkv, D]
-    v = gather_pages(v_pages, table, layer)
+    k, v = gather_pages(pages, table, layer)  # [B, S, Hkv, D]
     S = k.shape[1]
     qg = q.reshape(B, Hkv, n_rep, D)
     s_pool = jnp.einsum(
@@ -128,8 +127,7 @@ def paged_extend_attention(
     q: jnp.ndarray,          # [B, C, H, D] chunk of new tokens
     k_chunk: jnp.ndarray,    # [B, C, Hkv, D] the chunk's K (not in pool)
     v_chunk: jnp.ndarray,
-    k_pages: jnp.ndarray,    # [L, P, page, Hkv, D] the WHOLE pool
-    v_pages: jnp.ndarray,
+    pages: jnp.ndarray,      # [L, P, 2, Hkv, page, D] the WHOLE pool
     layer: jnp.ndarray,      # scalar i32 layer index
     table: jnp.ndarray,      # [B, M]
     start: jnp.ndarray,      # [B] tokens RESIDENT IN THE POOL (chunk start)
@@ -151,7 +149,7 @@ def paged_extend_attention(
     this peaks at ``[B, H, C, max(kv_block, C)]``. GQA never materializes a
     K/V repeat: the query's group axis rides the einsum."""
     B, C, H, D = q.shape
-    Hkv = k_pages.shape[3]
+    Hkv = pages.shape[3]
     n_rep = H // Hkv
     if softmax_scale is None:
         softmax_scale = D ** -0.5
@@ -183,8 +181,7 @@ def paged_extend_attention(
     )
 
     # ---- pool part: blockwise online softmax over resident KV ----------
-    k = gather_pages(k_pages, table, layer)  # [B, S, Hkv, D]
-    v = gather_pages(v_pages, table, layer)
+    k, v = gather_pages(pages, table, layer)  # [B, S, Hkv, D]
     S = k.shape[1]
     Sb = kv_block if S % kv_block == 0 else S
     nb = S // Sb

@@ -375,6 +375,11 @@ def _flash_forward(
             jax.ShapeDtypeStruct((H, T, D), q.dtype),
             jax.ShapeDtypeStruct((H, T // block_q, block_q, 1), jnp.float32),
         ],
+        # blocks >= 2048 carry a [block_q, block_k] f32 score tile past the
+        # default scoped-vmem budget; raise it (v5e VMEM is 128 MB)
+        compiler_params=pltpu.CompilerParams(
+            **({"vmem_limit_bytes": 100 * 2**20} if block_q >= 2048 else {})
+        ),
         interpret=_interpret(),
     )(kstart, needs, seg2d, seg2d, q, k, v)
     return out, lse4.reshape(H, T)

@@ -67,7 +67,8 @@ class OptimizerConfig:
 
 
 def vmapped_forward(
-    params, cfg: ModelConfig, arrays: Dict[str, jnp.ndarray], with_aux: bool = False
+    params, cfg: ModelConfig, arrays: Dict[str, jnp.ndarray],
+    with_aux: bool = False, with_head: bool = True,
 ):
     """Model forward over ``[D, T]`` packed buffers -> ``[D, T, vocab|1]``.
     With ``with_aux``, returns ``(out, aux)`` where aux is the MoE router
@@ -81,7 +82,8 @@ def vmapped_forward(
     without it the ring would silently all-gather rows/heads every layer."""
     out = jax.vmap(
         lambda ids, seg, pos: tfm.forward_packed(
-            params, cfg, ids, seg, pos, with_aux=with_aux
+            params, cfg, ids, seg, pos, with_aux=with_aux,
+            with_head=with_head,
         ),
         spmd_axis_name=("data", "fsdp"),
     )(arrays["input_ids"], arrays["segment_ids"], arrays["positions"])

@@ -190,9 +190,9 @@ class TestTensorParallelEngine:
             CFG, params, max_slots=2, max_seqlen=128, mesh=mesh
         )
         # KV pool shards over the kv-head axis: each device holds half
-        kshard = eng.state.cache.k_pages.sharding
+        kshard = eng.state.cache.pages.sharding
         assert kshard.spec == jax.sharding.PartitionSpec(
-            None, None, None, "model", None
+            None, None, None, "model", None, None
         )
         # wq shards on its head-output column axis
         wq = eng.params["layers"]["attn"]["wq"]

@@ -321,17 +321,19 @@ class TestPallasPagedDecode:
         q = rng.normal(size=(B, Hq, D)).astype(np.float32)
         k_self = rng.normal(size=(B, Hkv, D)).astype(np.float32)
         v_self = rng.normal(size=(B, Hkv, D)).astype(np.float32)
-        k_pages = rng.normal(size=(L, P, page, Hkv, D)).astype(np.float32)
-        v_pages = rng.normal(size=(L, P, page, Hkv, D)).astype(np.float32)
+        pool = rng.normal(size=(L, P, 2, Hkv, page, D)).astype(np.float32)
+        # dense views in [P, page, Hkv, D] order for the numpy reference
+        k_pages = np.swapaxes(pool[:, :, 0], 2, 3)
+        v_pages = np.swapaxes(pool[:, :, 1], 2, 3)
         table = rng.permutation(P)[: B * M].reshape(B, M).astype(np.int32)
         lens = np.asarray([1, 9, 32, 0], np.int32)  # partial/full/empty pool
 
         got = pl_paged.decode(
-            q, k_self, v_self, k_pages, v_pages, jnp.int32(layer), table,
+            q, k_self, v_self, pool, jnp.int32(layer), table,
             lens, soft_cap=soft_cap, sliding_window=window,
         )
         want = xla_paged.paged_decode_attention(
-            q, k_self, v_self, k_pages, v_pages, jnp.int32(layer), table,
+            q, k_self, v_self, pool, jnp.int32(layer), table,
             lens, soft_cap=soft_cap, sliding_window=window, use_pallas=False,
         )
         np.testing.assert_allclose(

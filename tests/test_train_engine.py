@@ -208,3 +208,38 @@ def test_remat_policy_and_unroll_grad_parity(rng):
                     np.asarray(a), np.asarray(b), atol=1e-5,
                     err_msg=f"{policy}/{unroll}",
                 )
+
+
+def test_chunked_loss_matches_dense(rng):
+    """cfg.loss_chunk_size (blockwise LM-head cross-entropy, the 32k-logit
+    memory saver) must match the dense loss in value AND gradients — incl.
+    a chunk size that does not divide T (rounded down to a divisor)."""
+    import dataclasses
+
+    from areal_tpu.interfaces.sft import sft_loss_fn
+    from areal_tpu.models import transformer as tfm
+
+    cfg = TINY
+    params = tfm.init_params(cfg, jax.random.key(3))
+    T = 64
+    arrays = {
+        "input_ids": jnp.asarray(rng.integers(1, 128, (2, T)), jnp.int32),
+        "segment_ids": jnp.asarray(
+            np.tile(np.r_[np.ones(50), np.zeros(T - 50)], (2, 1)), jnp.int32
+        ),
+        "positions": jnp.asarray(np.tile(np.arange(T), (2, 1)), jnp.int32),
+        "prompt_mask": jnp.asarray(
+            np.tile(np.r_[np.ones(5), np.zeros(T - 5)], (2, 1)), bool
+        ),
+    }
+    l_dense, _ = sft_loss_fn(params, cfg, arrays)
+    g_dense = jax.grad(lambda p: sft_loss_fn(p, cfg, arrays)[0])(params)
+    for chunk in (16, 24):  # 24 does not divide 64 -> rounds down to 16
+        cfgc = dataclasses.replace(cfg, loss_chunk_size=chunk)
+        l_c, _ = sft_loss_fn(params, cfgc, arrays)
+        np.testing.assert_allclose(float(l_dense), float(l_c), atol=1e-5)
+        g_c = jax.grad(lambda p: sft_loss_fn(p, cfgc, arrays)[0])(params)
+        for a, b in zip(jax.tree.leaves(g_dense), jax.tree.leaves(g_c)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            )
