@@ -25,7 +25,7 @@ from areal_tpu.api.model import ModelInterface, PPOHyperparameters
 from areal_tpu.ops import ppo as ppo_ops
 from areal_tpu.parallel import multihost
 from areal_tpu.train import batching
-from areal_tpu.train.engine import vmapped_forward
+from areal_tpu.train.engine import vmapped_forward, vmapped_next_token_logprobs
 
 
 def _action_mask(arrays) -> jnp.ndarray:
@@ -40,11 +40,9 @@ def _action_mask(arrays) -> jnp.ndarray:
 
 def logprob_output_fn(params, cfg, arrays):
     """Token-aligned logprobs of the next token — the "inference" MFC that
-    recomputes proximal logprobs (≈ ``ppo_interface.py:474``)."""
-    logits = vmapped_forward(params, cfg, arrays)
-    return jax.vmap(ppo_ops.gather_packed_shifted_log_probs)(
-        logits, arrays["input_ids"], arrays["segment_ids"]
-    )
+    recomputes proximal logprobs (≈ ``ppo_interface.py:474``). Honors
+    ``cfg.loss_chunk_size`` (no [T, vocab] logits at long context)."""
+    return vmapped_next_token_logprobs(params, cfg, arrays)
 
 
 def value_output_fn(params, cfg, arrays):
@@ -76,9 +74,8 @@ class PPOActorInterface(ModelInterface):
 
         def actor_loss(params, cfg, arrays):
             mask = _action_mask(arrays)
-            logits, aux = vmapped_forward(params, cfg, arrays, with_aux=True)
-            new_lp = jax.vmap(ppo_ops.gather_packed_shifted_log_probs)(
-                logits, arrays["input_ids"], arrays["segment_ids"]
+            new_lp, aux = vmapped_next_token_logprobs(
+                params, cfg, arrays, with_aux=True
             )
             old_lp = arrays["packed_logprobs"].astype(jnp.float32)
             prox = arrays.get("prox_logp")

@@ -14,31 +14,17 @@ import jax.numpy as jnp
 from areal_tpu.api.data import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model import ModelInterface
 from areal_tpu.ops import ppo as ppo_ops
-from areal_tpu.train.engine import vmapped_forward
+from areal_tpu.train.engine import (
+    vmapped_forward,
+    vmapped_next_token_logprobs,
+)
 
 
 def sft_loss_fn(params, cfg, arrays):
     """-mean log p(next token) over answer tokens (prompt_mask==0).
-
-    With ``cfg.loss_chunk_size`` the LM head + softmax + gather run per
-    token block under remat (``transformer.chunked_next_token_logprobs``)
-    — the [T, vocab] logits never materialize."""
-    if cfg.loss_chunk_size:
-        from areal_tpu.models import transformer as tfm
-
-        hidden, aux = vmapped_forward(
-            params, cfg, arrays, with_aux=True, with_head=False
-        )
-        lp = jax.vmap(
-            lambda h, ids, seg: tfm.chunked_next_token_logprobs(
-                params, cfg, h, ids, seg, chunk=cfg.loss_chunk_size
-            )
-        )(hidden, arrays["input_ids"], arrays["segment_ids"])
-    else:
-        logits, aux = vmapped_forward(params, cfg, arrays, with_aux=True)
-        lp = jax.vmap(ppo_ops.gather_packed_shifted_log_probs)(
-            logits, arrays["input_ids"], arrays["segment_ids"]
-        )
+    ``cfg.loss_chunk_size`` routes through the chunked LM-head path — the
+    [T, vocab] logits never materialize."""
+    lp, aux = vmapped_next_token_logprobs(params, cfg, arrays, with_aux=True)
     seg = arrays["segment_ids"]
     has_next = (seg > 0) & ~jax.vmap(ppo_ops.is_segment_end)(seg)
     mask = has_next

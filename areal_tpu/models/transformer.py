@@ -663,32 +663,39 @@ class PagedKVCache:
 
 
 def _scatter_chunk_kv(cache: PagedKVCache, ks, vs, table, positions, valid):
-    """ONE multi-dim scatter of every layer's fresh K/V into the pool.
+    """ONE scatter of every layer's fresh K/V into the pool.
 
     ks/vs ``[L, B, C, Hkv, D]``; positions/valid ``[B, C]``. Runs AFTER the
     layer scan — the pool never rides the scan carry (which streamed the
     whole multi-GB pool through stacked scan outputs every step; measured
-    ~30 ms/step at a 1.5B/64-slot decode, round-3 xprof). No flat reshape
-    either: the scatter indexes ``(layer, page, offset)`` natively, and
-    K + V land together through the pool's interleaved kv dim."""
-    L = ks.shape[0]
+    ~30 ms/step at a 1.5B/64-slot decode, round-3 xprof).
+
+    The scatter runs on a FLAT ``[L*P*2*Hkv*page, D]`` row view: flattening
+    every dim but the minor one is a layout-preserving bitcast, and a 2D
+    row scatter keeps the default layout — the earlier multi-dim scatter
+    was assigned a PERMUTED pool layout by XLA, forcing two full-pool
+    relayout copies per decode step around the (default-layout) attention
+    kernel (~11 ms/step at a 1.5B/64-slot profile; HLO ``copy.14/.27``)."""
+    L, B, C, Hkv, D = ks.shape
     P, _, _, page = cache.pages.shape[1:5]
     M = table.shape[1]
     page_idx = jnp.take_along_axis(
         table, jnp.clip(positions // page, 0, M - 1), axis=1
     )                                                   # [B, C]
-    page_idx = jnp.where(valid, page_idx, P)            # out of range => drop
     off = positions % page                              # [B, C]
-    l_idx = jnp.arange(L)[:, None, None]                # [L, 1, 1]
-    li = jnp.broadcast_to(l_idx, (L,) + page_idx.shape)
-    pi = jnp.broadcast_to(page_idx[None], (L,) + page_idx.shape)
-    oi = jnp.broadcast_to(off[None], (L,) + off.shape)
     dt = cache.pages.dtype
-    # pages[li, pi, :, :, oi]: advanced dims first -> update [L,B,C, 2,H,D]
-    kv = jnp.stack([ks, vs], axis=3).astype(dt)
-    return PagedKVCache(
-        pages=cache.pages.at[li, pi, :, :, oi].set(kv, mode="drop")
-    )
+    kv = jnp.stack([ks, vs], axis=3).astype(dt)         # [L, B, C, 2, Hkv, D]
+    # flat row = (((l*P + p)*2 + kv)*Hkv + h)*page + off
+    n_rows = L * P * 2 * Hkv * page
+    base = page_idx[None] + P * jnp.arange(L)[:, None, None]     # [L, B, C]
+    kvi = jnp.arange(2)[None, None, None, :, None]
+    hi = jnp.arange(Hkv)[None, None, None, None, :]
+    rows = ((base[..., None, None] * 2 + kvi) * Hkv + hi) * page \
+        + off[None, :, :, None, None]                   # [L, B, C, 2, Hkv]
+    rows = jnp.where(valid[None, :, :, None, None], rows, n_rows)  # => drop
+    flat = cache.pages.reshape(n_rows, D)
+    flat = flat.at[rows].set(kv, mode="drop")
+    return PagedKVCache(pages=flat.reshape(cache.pages.shape))
 
 
 def extend_paged(

@@ -110,6 +110,14 @@ def packed_attention(
     if use_flash:
         from areal_tpu.ops.pallas import flash_attention as _fa
 
+        T = q.shape[0]
+        bs = flash_block_size or (
+            1024 if T >= 8192 and T % 1024 == 0 else 512
+        )
+        while T % bs:
+            # an override that does not divide T would silently truncate
+            # the kernel grid; fall back to the largest dividing block
+            bs //= 2
         return _fa.packed_flash_attention(
             q,
             k,
@@ -118,8 +126,7 @@ def packed_attention(
             softmax_scale=softmax_scale,
             soft_cap=soft_cap,
             sliding_window=sliding_window,
-            block_size=flash_block_size
-            or (1024 if q.shape[0] >= 8192 and q.shape[0] % 1024 == 0 else 512),
+            block_size=bs,
             max_seqlen=max_seqlen,
         )
     return _attention_xla(

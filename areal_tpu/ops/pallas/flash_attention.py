@@ -736,6 +736,11 @@ def _flash_backward(
             scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((H, T, D), q.dtype),
+        # split-backward p/ds tiles need the same scoped-vmem raise as the
+        # forward at block sizes >= 2048
+        compiler_params=pltpu.CompilerParams(
+            **({"vmem_limit_bytes": 100 * 2**20} if block_q >= 2048 else {})
+        ),
         interpret=_interpret(),
     )(kstart, needs, seg2d, seg2d, lse4, delta4, q, k, v, do)
 
@@ -760,6 +765,9 @@ def _flash_backward(
             jax.ShapeDtypeStruct((Hkv, T, D), k.dtype),
             jax.ShapeDtypeStruct((Hkv, T, D), v.dtype),
         ],
+        compiler_params=pltpu.CompilerParams(
+            **({"vmem_limit_bytes": 100 * 2**20} if block_k >= 2048 else {})
+        ),
         interpret=_interpret(),
     )(qlast, needs, seg2d, seg2d, lse4, delta4, q, k, v, do)
     return dq, dk, dv

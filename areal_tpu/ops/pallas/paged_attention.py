@@ -272,3 +272,4 @@ def decode(
         jnp.asarray(layer, jnp.int32).reshape(1), table, lens,
         q, k_self, v_self, pages,
     )
+

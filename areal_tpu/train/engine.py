@@ -93,6 +93,34 @@ def vmapped_forward(
     return out
 
 
+def vmapped_next_token_logprobs(params, cfg, arrays, with_aux: bool = False):
+    """Token-aligned next-token logprobs over ``[D, T]`` packed buffers —
+    the shared primitive behind the SFT loss, the PPO logprob-recompute
+    MFC, and the PPO actor loss. Honors ``cfg.loss_chunk_size``: the LM
+    head + softmax + gather run per token block under remat so the
+    ``[T, vocab]`` logits (4 GB f32 at the 32k protocol shape) never
+    materialize on ANY of those paths."""
+    from areal_tpu.ops import ppo as ppo_ops
+
+    if cfg.loss_chunk_size:
+        out = vmapped_forward(
+            params, cfg, arrays, with_aux=with_aux, with_head=False
+        )
+        hidden, aux = out if with_aux else (out, None)
+        lp = jax.vmap(
+            lambda h, ids, seg: tfm.chunked_next_token_logprobs(
+                params, cfg, h, ids, seg, chunk=cfg.loss_chunk_size
+            )
+        )(hidden, arrays["input_ids"], arrays["segment_ids"])
+    else:
+        out = vmapped_forward(params, cfg, arrays, with_aux=with_aux)
+        logits, aux = out if with_aux else (out, None)
+        lp = jax.vmap(ppo_ops.gather_packed_shifted_log_probs)(
+            logits, arrays["input_ids"], arrays["segment_ids"]
+        )
+    return (lp, aux) if with_aux else lp
+
+
 class TrainEngine:
     """Owns mesh + sharded params (+ optional optimizer state) for one model."""
 

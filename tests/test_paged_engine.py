@@ -496,3 +496,36 @@ class TestProtocolLengthGeneration:
         assert eng.n_pages - eng.pool.n_free == len(eng.prefix)
         eng.prefix.clear()
         assert eng.pool.n_free == eng.n_pages
+
+
+def test_pool_scatter_matches_reference():
+    """The flat-row pool scatter (layout-neutral form: a permuted-layout
+    multi-dim scatter forced two full-pool relayout copies per decode
+    step) must write active slots at (table[lens//page], lens%page) and
+    leave inactive slots untouched."""
+    from areal_tpu.models.transformer import PagedKVCache, _scatter_chunk_kv
+
+    rng = np.random.default_rng(0)
+    L, P, Hkv, page, D, B, M = 3, 10, 2, 8, 16, 4, 2
+    pages = rng.normal(size=(L, P, 2, Hkv, page, D)).astype(np.float32)
+    ks = rng.normal(size=(L, B, Hkv, D)).astype(np.float32)
+    vs = rng.normal(size=(L, B, Hkv, D)).astype(np.float32)
+    table = rng.permutation(P)[: B * M].reshape(B, M).astype(np.int32)
+    lens = np.asarray([0, 7, 8, 15], np.int32)     # page starts/ends
+    active = np.asarray([True, True, False, True])
+
+    got = np.asarray(_scatter_chunk_kv(
+        PagedKVCache(pages=jnp.asarray(pages)),
+        jnp.asarray(ks[:, :, None]), jnp.asarray(vs[:, :, None]),
+        jnp.asarray(table), jnp.asarray(lens[:, None]),
+        jnp.asarray(active[:, None]),
+    ).pages)
+    want = pages.copy()
+    for b in range(B):
+        if not active[b]:
+            continue
+        p_, o = table[b, lens[b] // page], lens[b] % page
+        for l in range(L):
+            want[l, p_, 0, :, o, :] = ks[l, b]
+            want[l, p_, 1, :, o, :] = vs[l, b]
+    np.testing.assert_array_equal(got, want)
