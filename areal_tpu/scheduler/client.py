@@ -21,7 +21,7 @@ import re
 import shutil
 import subprocess
 import time
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 logger = logging.getLogger("areal_tpu.scheduler")
 
@@ -349,9 +349,131 @@ class SlurmSchedulerClient(SchedulerClient):
             subprocess.run(["scancel", job_id], check=False)
 
 
+class RaySchedulerClient(SchedulerClient):
+    """Ray-cluster backend: each worker command runs as a named Ray remote
+    task pinned to the requested resources — the TPU-native counterpart of
+    the reference's Ray actor fleet (``training/utils.py:119-254``, which
+    wraps worker classes in ``RayWorker`` actors). Here workers stay the
+    same subprocess entrypoints the local/Slurm backends launch, so one
+    worker implementation serves all three schedulers; Ray only does
+    placement, restarts and log capture. Jobs are keyed by ``worker_type``,
+    matching the local backend's find/stop contract.
+
+    ``ray`` is not bundled with this image: construction raises a clear
+    error when it is missing (install ray on the cluster driver)."""
+
+    def __init__(
+        self,
+        expr_name: str,
+        trial_name: str,
+        num_cpus: float = 1.0,
+        num_tpus: float = 0.0,
+        runtime_env: Optional[dict] = None,
+        address: Optional[str] = None,
+    ):
+        super().__init__(expr_name, trial_name)
+        try:
+            import ray
+        except ImportError as e:  # pragma: no cover - ray absent in CI image
+            raise ImportError(
+                "scheduler mode 'ray' needs the ray package (not bundled "
+                "with this image): pip install 'ray[default]' on the "
+                "cluster driver, or use mode 'local'/'slurm'"
+            ) from e
+        self._ray = ray
+        self._default_resources = {"num_cpus": num_cpus}
+        if num_tpus:
+            self._default_resources["resources"] = {"TPU": num_tpus}
+        if ray.is_initialized():
+            if address or runtime_env:
+                logger.warning(
+                    "Ray already initialized; ignoring address=%r / "
+                    "runtime_env", address,
+                )
+        else:
+            ray.init(
+                address=address, runtime_env=runtime_env,
+                ignore_reinit_error=True,
+            )
+
+        @ray.remote
+        def _run(cmd, env):
+            import os as _os
+            import signal as _signal
+            import subprocess as _sp
+
+            full_env = dict(_os.environ)
+            full_env.update(env or {})
+            # own session so a cancel kills the whole worker process group,
+            # not just the Ray task wrapper (orphaned workers would keep
+            # holding TPU devices across a restart-the-world relaunch)
+            proc = _sp.Popen(cmd, env=full_env, start_new_session=True)
+            try:
+                return proc.wait()
+            finally:
+                if proc.poll() is None:
+                    try:
+                        _os.killpg(proc.pid, _signal.SIGTERM)
+                    except ProcessLookupError:
+                        pass
+
+        self._run_remote = _run
+        self._refs: Dict[str, Any] = {}
+        self._cancelled: set = set()
+
+    def submit(self, worker_type: str, cmd: List[str], env=None,
+               **resources) -> str:
+        if worker_type in self._refs:
+            raise ValueError(f"job {worker_type} already submitted")
+        opts = dict(self._default_resources)
+        opts.update(resources)
+        ref = self._run_remote.options(
+            name=f"{self.run_name}/{worker_type}", **opts
+        ).remote(list(cmd), dict(env or {}))
+        self._refs[worker_type] = ref
+        return worker_type
+
+    def _jobs(self) -> List[str]:
+        return list(self._refs)
+
+    def find(self, job_name: str) -> JobInfo:
+        ref = self._refs.get(job_name)
+        if ref is None:
+            return JobInfo(name=job_name, state=JobState.NOT_FOUND)
+        ready, _ = self._ray.wait([ref], timeout=0)
+        if not ready:
+            return JobInfo(name=job_name, state=JobState.RUNNING)
+        try:
+            rc = self._ray.get(ref)
+        except self._ray.exceptions.TaskCancelledError:
+            return JobInfo(name=job_name, state=JobState.CANCELLED)
+        except Exception:  # noqa: BLE001 - task died
+            state = (
+                JobState.CANCELLED if job_name in self._cancelled
+                else JobState.FAILED
+            )
+            return JobInfo(name=job_name, state=state)
+        state = JobState.COMPLETED if rc == 0 else JobState.FAILED
+        return JobInfo(name=job_name, state=state)
+
+    def stop(self, job_name: str):
+        ref = self._refs.get(job_name)
+        if ref is not None:
+            self._cancelled.add(job_name)
+            # non-force: interrupts the task so its finally kills the
+            # worker's process group
+            self._ray.cancel(ref)
+
+    def stop_all(self):
+        for n in list(self._refs):
+            self.stop(n)
+
+
 def make_scheduler(mode: str, expr_name: str, trial_name: str, **kwargs) -> SchedulerClient:
     if mode == "local":
         return LocalSchedulerClient(expr_name, trial_name)
     if mode == "slurm":
         return SlurmSchedulerClient(expr_name, trial_name, **kwargs)
+    if mode == "ray":
+        return RaySchedulerClient(expr_name, trial_name, **kwargs)
     raise ValueError(f"unknown scheduler mode {mode!r}")

@@ -272,3 +272,20 @@ def test_profile_experiment_runs():
     assert out["step_time_s"] > 0
     assert out["tokens_per_s"] > 0
     assert out["n_params"] > 0
+
+
+def test_ray_scheduler_gated():
+    """The Ray backend exists in the registry; without the ray package (not
+    bundled with this image) it raises a clear, actionable error instead of
+    an opaque ModuleNotFoundError deep in a worker."""
+    from areal_tpu.scheduler.client import make_scheduler
+
+    try:
+        import ray  # noqa: F401
+        has_ray = True
+    except ImportError:
+        has_ray = False
+    if has_ray:
+        pytest.skip("ray installed; gate untestable")
+    with pytest.raises(ImportError, match="pip install 'ray"):
+        make_scheduler("ray", "e", "t")
