@@ -303,10 +303,24 @@ class GenerationEngine:
         """Stop generating and harvest all running slots as interrupted."""
         with self._lock:
             self.paused = True
+            if not any(s is not None for s in self._slots):
+                return []
+            # ONE device pull for every slot (a per-slot fetch costs a full
+            # round trip each on a tunneled chip)
+            n_gen, out_tokens, out_logprobs = jax.device_get(
+                (self.state.n_gen, self.state.out_tokens,
+                 self.state.out_logprobs)
+            )
+            host_state = {
+                "n_gen": n_gen, "out_tokens": out_tokens,
+                "out_logprobs": out_logprobs,
+            }
             outs = []
             for b, s in enumerate(self._slots):
                 if s is not None:
-                    outs.append(self._harvest(b, "interrupted"))
+                    outs.append(
+                        self._harvest(b, "interrupted", host_state=host_state)
+                    )
             return outs
 
     def resume(self):

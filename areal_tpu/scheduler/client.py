@@ -414,6 +414,10 @@ class RaySchedulerClient(SchedulerClient):
                 if proc.poll() is None:
                     try:
                         _os.killpg(proc.pid, _signal.SIGTERM)
+                        try:
+                            proc.wait(timeout=10)
+                        except _sp.TimeoutExpired:
+                            _os.killpg(proc.pid, _signal.SIGKILL)
                     except ProcessLookupError:
                         pass
 
@@ -461,12 +465,8 @@ class RaySchedulerClient(SchedulerClient):
         if ref is not None:
             self._cancelled.add(job_name)
             # non-force: interrupts the task so its finally kills the
-            # worker's process group
+            # worker's process group (SIGTERM, then SIGKILL after 10 s)
             self._ray.cancel(ref)
-
-    def stop_all(self):
-        for n in list(self._refs):
-            self.stop(n)
 
 
 def make_scheduler(mode: str, expr_name: str, trial_name: str, **kwargs) -> SchedulerClient:
