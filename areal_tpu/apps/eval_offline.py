@@ -93,6 +93,11 @@ def _parse_datasets(specs: List[str]) -> Dict[str, str]:
         if not sep or os.sep in name or not name:
             name, path = "", spec
             name = os.path.splitext(os.path.basename(path))[0]
+        if name in out:
+            raise ValueError(
+                f"duplicate benchmark name {name!r} ({out[name]} vs {path});"
+                " disambiguate with an explicit 'name=path' spec"
+            )
         out[name] = path
     return out
 

@@ -318,6 +318,7 @@ class RpcNameRecordRepository(NameRecordRepository):
         self._lock = threading.Lock()
         self._to_delete = set()
         self._leases: Dict[str, float] = {}      # name -> ttl
+        self._lease_values: Dict[str, str] = {}  # name -> value (for re-add)
         self._keepalive: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -371,9 +372,20 @@ class RpcNameRecordRepository(NameRecordRepository):
                     by_ttl.setdefault(t, []).append(n)
                 for ttl, names in by_ttl.items():
                     try:
-                        self._call(
+                        resp = self._call(
                             {"op": "touch", "names": names, "ttl": ttl}
                         )
+                        # a lease that lapsed (we stalled past the TTL) is
+                        # gone for good server-side; re-ADD it — an explicit
+                        # re-registration after the death-watch window
+                        for n in resp.get("missing", []):
+                            with self._lock:
+                                value = self._lease_values.get(n)
+                            if value is not None:
+                                self._call({
+                                    "op": "add", "name": n, "value": value,
+                                    "replace": True, "ttl": ttl,
+                                })
                     except Exception:  # noqa: BLE001 — retried next tick
                         pass
 
@@ -388,12 +400,17 @@ class RpcNameRecordRepository(NameRecordRepository):
             "replace": replace, "ttl": keepalive_ttl,
         })
         if not resp["ok"]:
-            raise NameEntryExistsError(name)
+            if resp.get("error") == "exists":
+                raise NameEntryExistsError(name)
+            raise RuntimeError(
+                f"name_resolve add({name!r}) failed: {resp.get('error')}"
+            )
         if delete_on_exit:
             self._to_delete.add(name)
         if keepalive_ttl:
             with self._lock:
                 self._leases[name] = float(keepalive_ttl)
+                self._lease_values[name] = str(value)
             self._ensure_keepalive()
 
     def get(self, name):
@@ -408,6 +425,7 @@ class RpcNameRecordRepository(NameRecordRepository):
         self._to_delete.discard(name)
         with self._lock:
             self._leases.pop(name, None)
+            self._lease_values.pop(name, None)
         if not resp["ok"]:
             raise NameEntryNotFoundError(name)
 
@@ -434,6 +452,7 @@ class RpcNameRecordRepository(NameRecordRepository):
         self._to_delete.clear()
         with self._lock:
             self._leases.clear()
+            self._lease_values.clear()
         if names:
             self._call({"op": "delete_many", "names": names})
 

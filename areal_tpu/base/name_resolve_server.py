@@ -62,12 +62,20 @@ class _Store:
 
     def touch(self, names: List[str], ttl: float):
         with self._lock:
+            # expire first: a keepalive arriving after the lease lapsed must
+            # NOT resurrect the key — death-watchers rely on expiry being
+            # final. Lapsed names are reported back so the (live) client can
+            # re-ADD them, which is an explicit re-registration.
+            self._expire_locked()
             now = time.monotonic()
+            missing = []
             for n in names:
                 n = n.rstrip("/")
                 if n in self._kv:
                     self._expiry[n] = now + ttl
-            return {"ok": True}
+                else:
+                    missing.append(n)
+            return {"ok": True, "missing": missing}
 
     def get(self, name: str):
         name = name.rstrip("/")

@@ -133,12 +133,20 @@ class GenerationEngine:
     ):
         self.cfg = cfg
         self.mesh = mesh
+        self._decode_use_pallas: Optional[bool] = None
         if mesh is not None:
             if "model" not in mesh.axis_names:
                 raise ValueError(
                     f"generation mesh needs a 'model' axis, got {mesh.axis_names}"
                 )
             tp = mesh.shape["model"]
+            # pallas_call has no GSPMD partitioning rule: with the KV pool
+            # sharded on its kv-head axis, the Pallas decode kernel under a
+            # >1-way 'model' axis would all-gather the whole pool per layer
+            # (or fail to lower). TP serving pins the XLA gather path, which
+            # GSPMD partitions per head group (ADVICE r3, medium).
+            if tp > 1:
+                self._decode_use_pallas = False
             for dim, name in (
                 (cfg.n_kv_heads, "n_kv_heads"),
                 (cfg.n_q_heads, "n_q_heads"),
@@ -587,6 +595,7 @@ class GenerationEngine:
             logits, cache, new_lens = tfm.decode_step_paged(
                 params, cfg, state.cache, state.last_tokens, table,
                 state.lens, state.active,
+                use_pallas=self._decode_use_pallas,
             )
             if self.mesh is not None:
                 # one explicit all-gather of the [B, V] logits: sampling

@@ -756,11 +756,18 @@ def decode_step_paged(
     table: jnp.ndarray,        # [B, M]
     lens: jnp.ndarray,         # [B] resident tokens (write position)
     active: jnp.ndarray,       # [B] bool
+    use_pallas: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, PagedKVCache, jnp.ndarray]:
     """One decode step over the page pool. Returns (fp32 logits ``[B, V]``,
     cache, new lens — incremented where active). The pool is read-only in
     the layer scan; each layer's fresh K/V merges into attention as the
-    self token and lands in the pool via one post-scan scatter."""
+    self token and lands in the pool via one post-scan scatter.
+
+    ``use_pallas`` threads through to the attention dispatch; TP-sharded
+    serving passes False — ``pallas_call`` has no GSPMD partitioning rule,
+    so with the pool sharded on its kv-head axis the kernel would force a
+    full-pool all-gather (or fail to lower), while the XLA gather path
+    partitions cleanly per head group."""
     from areal_tpu.ops import paged_attention as paged_ops
 
     positions = lens
@@ -784,6 +791,7 @@ def decode_step_paged(
             softmax_scale=cfg.softmax_scale,
             soft_cap=cfg.attn_logits_soft_cap,
             sliding_window=cfg.sliding_window,
+            use_pallas=use_pallas,
         )
         x = x + _attn_out(lp["attn"], ctx.astype(x.dtype))
         h = _norm(cfg, lp["ln2"], x)

@@ -223,6 +223,18 @@ class TestTensorParallelEngine:
         assert eng.stats["prefix_hits"] >= 3
         assert len(set(map(tuple, outs.values()))) > 1
 
+    def test_tp_pins_xla_decode_path(self, params):
+        """A >1-way 'model' mesh must force the XLA gather decode path:
+        pallas_call has no GSPMD partitioning rule, so the kernel under a
+        kv-head-sharded pool would all-gather the pool per layer or fail to
+        lower (ADVICE r3, medium)."""
+        eng = GenerationEngine(
+            CFG, params, max_slots=2, max_seqlen=128, mesh=_tp_mesh(2)
+        )
+        assert eng._decode_use_pallas is False
+        eng1 = GenerationEngine(CFG, params, max_slots=2, max_seqlen=128)
+        assert eng1._decode_use_pallas is None  # platform auto-dispatch
+
     def test_tp_rejects_indivisible_heads(self, params):
         bad = dataclasses.replace(CFG, n_kv_heads=3, n_q_heads=3)
         p3 = tfm.init_params(bad, jax.random.key(0))

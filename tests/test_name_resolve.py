@@ -133,3 +133,67 @@ def test_rpc_lease_expires_without_keepalive(rpc_server):
     with pytest.raises(NameEntryNotFoundError):
         other.get("hb/w0")
     other.close()
+
+
+def test_store_touch_does_not_resurrect_expired_key():
+    """A keepalive arriving AFTER the lease lapsed must not revive the key
+    (ADVICE r3): expiry is final — a worker that stalled past its TTL stays
+    dead and must re-add."""
+    import time as _time
+
+    from areal_tpu.base.name_resolve_server import _Store
+
+    st = _Store()
+    st.add("hb/w0", "alive", replace=True, ttl=0.05)
+    _time.sleep(0.1)          # lease lapsed, not yet lazily expired
+    # the lapsed name comes back as `missing` so the client can re-ADD
+    assert st.touch(["hb/w0"], ttl=60.0) == {"ok": True, "missing": ["hb/w0"]}
+    assert st.get("hb/w0") == {"ok": False, "error": "not_found"}
+
+
+def test_rpc_keepalive_readds_after_stall(rpc_server):
+    """A client that stalls past its TTL loses the lease (death-watchers see
+    it gone) but its keepalive loop re-ADDs on the next tick — the worker
+    re-registers instead of staying silently invisible forever."""
+    import time as _time
+
+    owner = make_repository(NameResolveConfig(type="rpc", root=rpc_server))
+    other = make_repository(NameResolveConfig(type="rpc", root=rpc_server))
+    owner.add("hb/stall", "alive", keepalive_ttl=1.5)
+    # simulate a stall: silence the keepalive thread past the TTL by taking
+    # its lease snapshot away, then restore it
+    with owner._lock:
+        saved = dict(owner._leases)
+        owner._leases.clear()
+    _time.sleep(2.5)
+    with pytest.raises(NameEntryNotFoundError):
+        other.get("hb/stall")          # lease lapsed while stalled
+    with owner._lock:
+        owner._leases.update(saved)    # stall ends; keepalive resumes
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline:
+        try:
+            assert other.get("hb/stall") == "alive"
+            break
+        except NameEntryNotFoundError:
+            _time.sleep(0.2)
+    else:
+        pytest.fail("keepalive did not re-add the lapsed key")
+    owner.close(); other.close()
+
+
+def test_rpc_add_distinguishes_exists_from_protocol_error(rpc_server):
+    """Only an 'exists' server response maps to NameEntryExistsError; any
+    other failure surfaces as RuntimeError with the server's message
+    (ADVICE r3)."""
+    repo = make_repository(NameResolveConfig(type="rpc", root=rpc_server))
+    repo.add("err/x", "1", replace=True)
+    with pytest.raises(NameEntryExistsError):
+        repo.add("err/x", "2", replace=False)
+    orig_call = repo._call
+    repo._call = lambda msg: {"ok": False, "error": "bad_request"}
+    with pytest.raises(RuntimeError, match="bad_request"):
+        repo.add("err/y", "1")
+    repo._call = orig_call
+    repo.delete("err/x")  # don't leak into the module-scoped server
+    repo.close()

@@ -148,6 +148,20 @@ def test_eval_offline_harness(tmp_path):
     ]) == 0
 
 
+def test_parse_datasets_rejects_stem_collisions():
+    """Two dataset paths with the same basename must not silently collide
+    (ADVICE r3) — only the last would be evaluated."""
+    import pytest as _pytest
+
+    from areal_tpu.apps.eval_offline import _parse_datasets
+
+    assert _parse_datasets(["math=a/test.jsonl", "b/test.jsonl"]) == {
+        "math": "a/test.jsonl", "test": "b/test.jsonl",
+    }
+    with _pytest.raises(ValueError, match="duplicate benchmark name"):
+        _parse_datasets(["a/test.jsonl", "b/test.jsonl"])
+
+
 def test_pass_at_k_estimator_and_majority():
     from areal_tpu.apps.eval_offline import (
         majority_score,
