@@ -70,6 +70,7 @@ def _load_engine(spec, is_critic=False, with_optimizer=True, total_steps=100):
         cfg,
         spec.parallel_config(),
         spec.optimizer if with_optimizer else None,
+        param_dtype=getattr(spec, "param_dtype", "float32"),
     )
     if spec.path:
         eng.load_hf(spec.path, init_critic_head=is_critic)

@@ -34,6 +34,9 @@ class ModelSpec:
     parallel: str = "d1m1"               # ParallelConfig.from_str format
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
     init_critic_from_actor: bool = False
+    # "bfloat16" halves param+grad memory (fits ~1B-param models with Adam
+    # on one 16 GiB chip) at some optimizer-precision cost
+    param_dtype: str = "float32"
 
     def model_config(self, is_critic: bool = False) -> ModelConfig:
         if self.path is not None:

@@ -86,7 +86,9 @@ class GenerationHTTPServer:
             if time.time() >= next_hbm:
                 next_hbm = time.time() + hbm_period
                 try:
-                    self._hbm.check()
+                    # off the event loop: memory_stats() can be a blocking
+                    # RPC (same reason _metrics offloads it)
+                    await loop.run_in_executor(None, self._hbm.check)
                 except hbm.HBMPressureError:
                     logger.critical(
                         "HBM past kill threshold; dying for launcher restart",

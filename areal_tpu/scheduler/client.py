@@ -305,8 +305,13 @@ class SlurmSchedulerClient(SchedulerClient):
                 lines.extend([h] * tasks_per_host)
             hostfile = "\n".join(lines[:count]) + "\n"
         script_path = f"{self.log_dir}/{tag}.sbatch"
-        multiprog_path = f"{self.log_dir}/{tag}.multiprog"
-        hostfile_path = f"{self.log_dir}/{tag}.hostfile" if hostfile else None
+        # srun reads the multiprog/hostfile AT RUN TIME on the batch node —
+        # a submit-host log_dir (node-local /tmp by default) would not exist
+        # there, silently failing the whole array. The batch script writes
+        # both files itself into a job-local mktemp dir: only the script has
+        # to travel, and sbatch captures that at submit.
+        multiprog_path = "$AREAL_JOBDIR/multiprog"
+        hostfile_path = "$AREAL_JOBDIR/hostfile" if hostfile else None
         srun = (
             f"srun -K -l --ntasks={count} --cpus-per-task={cpus_per_task} "
             f"--mem-per-cpu={mem_gb_per_task * 1024 // max(cpus_per_task, 1)}M "
@@ -335,12 +340,24 @@ class SlurmSchedulerClient(SchedulerClient):
         lines += [f"#SBATCH {a}" for a in self.extra]
         for k, v in (env or {}).items():
             lines.append(f"export {k}={shlex.quote(str(v))}")
+        lines += [
+            "AREAL_JOBDIR=$(mktemp -d)",
+            "cat > $AREAL_JOBDIR/multiprog <<'AREAL_EOF'",
+            multiprog.rstrip("\n"),
+            "AREAL_EOF",
+        ]
         if hostfile:
-            lines.append(f"export SLURM_HOSTFILE={hostfile_path}")
+            lines += [
+                "cat > $AREAL_JOBDIR/hostfile <<'AREAL_EOF'",
+                hostfile.rstrip("\n"),
+                "AREAL_EOF",
+                f"export SLURM_HOSTFILE={hostfile_path}",
+            ]
         lines += [
             'echo "[areal] start: $(date -u) on $(hostname)"',
             srun,
             "RETCODE=$?",
+            "rm -rf $AREAL_JOBDIR",
             'echo "[areal] done: $(date -u) rc=$RETCODE"',
             "exit $RETCODE",
         ]
@@ -359,19 +376,15 @@ class SlurmSchedulerClient(SchedulerClient):
         self, worker_type: str, cmd: List[str], count: int, **kwargs
     ) -> List[str]:
         """One sbatch job with ``count`` jobsteps (NOT count separate
-        ``--wrap`` jobs): writes the batch/multiprog/hostfile trio and
-        submits the script. Tracked under ``worker_type``; ``srun -K``
-        makes any dead step fail the whole job, which ``wait()`` surfaces."""
+        ``--wrap`` jobs): writes the batch script (which self-materializes
+        its multiprog/hostfile on the batch node) and submits it. Tracked
+        under ``worker_type``; ``srun -K`` makes any dead step fail the
+        whole job, which ``wait()`` surfaces."""
         import os
 
         self._require_slurm()
         sub = self.build_array_submission(worker_type, cmd, count, **kwargs)
         os.makedirs(self.log_dir, exist_ok=True)
-        with open(sub.multiprog_path, "w") as f:
-            f.write(sub.multiprog_content)
-        if sub.hostfile_path:
-            with open(sub.hostfile_path, "w") as f:
-                f.write(sub.hostfile_content)
         with open(sub.script_path, "w") as f:
             f.write(sub.batch_script)
         job_id = subprocess.check_output(

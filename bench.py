@@ -378,6 +378,99 @@ def _bench_async_ppo(peak):
     }
 
 
+def _bench_system_ppo():
+    """The ASSEMBLED async-PPO system, not the in-process loop: gen server +
+    gserver manager + rollout workers + trainer as real processes over
+    HTTP/ZMQ via ``apps/launcher.py`` — the overheads the in-process ``ppo``
+    section hides (HTTP hops, staleness-gate polling, chunked re-scheduling)
+    are exactly what the reference's async design manages
+    (``realhf/system/gserver_manager.py:279-285``). Same model/workload as
+    ``ppo``; steady-state rate from trainer metrics timestamps (first step
+    carries every compile)."""
+    import json as _json
+    import shutil
+    import tempfile
+
+    from areal_tpu.apps import launcher
+    from areal_tpu.experiments import AsyncPPOExperiment, load_config
+
+    N_PROMPTS, GROUP, PLEN, MAX_NEW = 8, 4, 128, 256
+    STEPS = 4
+    tmp = tempfile.mkdtemp(prefix="areal_sysbench_")
+    try:
+        rng = np.random.default_rng(0)
+        data = os.path.join(tmp, "prompts.jsonl")
+        with open(data, "w") as f:
+            for i in range(N_PROMPTS):
+                f.write(_json.dumps({
+                    "query_id": f"q{i}",
+                    "prompt_ids": [int(x) for x in rng.integers(1, 30000, PLEN)],
+                    "task": "math",
+                    "solutions": ["\\boxed{7}"],
+                }) + "\n")
+        arch = dict(
+            n_layers=12, n_q_heads=12, n_kv_heads=4, head_dim=64,
+            hidden_dim=768, intermediate_dim=2048, vocab_size=32768,
+            use_attention_bias=True, dtype="bfloat16",
+        )
+        cfg = load_config(AsyncPPOExperiment, None, [
+            "experiment_name=sysbench",
+            "trial_name=t0",
+            f"fileroot={tmp}/root",
+            f"dataset.path={data}",
+            f"train_batch_size={N_PROMPTS * GROUP}",
+            "max_tokens_per_mb=16384",
+            f"control.total_train_steps={STEPS}",
+            "control.ckpt_freq_steps=null",
+            "control.ckpt_freq_secs=null",
+            f"actor.arch={_json.dumps(arch)}",
+            'actor.overrides={"remat_policy": "none", "layer_scan_unroll": 12}',
+            "actor.parallel=d1m1",
+            "actor.optimizer.lr=0.00001",
+            "actor.param_dtype=bfloat16",   # match the in-process ppo section
+            "use_ref_model=false",
+            "recover_mode=disabled",
+            "gen.n_servers=1",
+            f"gen.max_slots={N_PROMPTS * GROUP}",
+            f"gen.max_seqlen={PLEN + MAX_NEW}",
+            "gen.page_size=64",
+            "rollout.n_workers=1",
+            f"rollout.max_concurrent_tasks={N_PROMPTS * GROUP}",
+            f"rollout.new_tokens_per_chunk={MAX_NEW}",
+            "manager.max_head_offpolicyness=100",
+            f'gconfig={{"n": {GROUP}, "max_new_tokens": {MAX_NEW}}}',
+            'ppo={"ppo_n_minibatches": 1, "disable_value": true,'
+            ' "group_adv_norm": true, "adv_norm": false,'
+            f' "use_decoupled_loss": true, "group_size": {GROUP}}}',
+        ])
+        t0 = time.perf_counter()
+        rc = launcher.run_async_ppo(cfg)
+        wall = time.perf_counter() - t0
+        metrics = os.path.join(tmp, "root", "logs", "sysbench", "t0",
+                               "metrics.jsonl")
+        if rc != 0 or not os.path.exists(metrics):
+            return {"error": f"rc={rc}, metrics={os.path.exists(metrics)}"}
+        with open(metrics) as f:
+            lines = [_json.loads(l) for l in f]
+        if len(lines) < 3:
+            return {"error": f"rc={rc} steps={len(lines)}"}
+        # steady state: drop step 1 (compiles); timestamps bound steps 2..N
+        steady_s = lines[-1]["time"] - lines[0]["time"]
+        n_samples = sum(l["ppo/n_seqs_consumed"] for l in lines[1:])
+        gen_tokens = sum(l.get("ppo/n_tokens", 0) for l in lines[1:]) \
+            - PLEN * n_samples  # generated tokens only
+        return {
+            "reward_samples_per_sec": round(n_samples / steady_s, 3),
+            "steady_seconds": round(steady_s, 2),
+            "steps_timed": len(lines) - 1,
+            "gen_tokens_per_sec": round(max(gen_tokens, 0) / steady_s, 1),
+            "wall_seconds": round(wall, 2),
+            "world": "gen_server+manager+rollout+trainer (processes)",
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     import jax
 
@@ -437,6 +530,7 @@ def main():
         ("gen", lambda: _bench_gen(peak_bw)),
         ("gen32k", lambda: _bench_gen_32k(peak_bw)),
         ("ppo", lambda: _bench_async_ppo(peak)),
+        ("system_ppo", lambda: _bench_system_ppo()),
     ):
         if not want(name):
             continue

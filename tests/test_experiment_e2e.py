@@ -75,6 +75,9 @@ def test_sft_experiment(tmp_path):
     assert os.path.exists(metrics)
     lines = [json.loads(l) for l in open(metrics)]
     assert len(lines) == 3 and "sft/loss" in lines[0]
+    # the worker folds HBM gauges into per-step stats; on CPU (no
+    # memory_stats) that's the live-array fallback gauge
+    assert "sft/hbm_live_array_bytes" in lines[0]
 
 
 def test_sync_ppo_experiment(tmp_path):

@@ -109,9 +109,14 @@ class TestSlurmCommands:
         assert "#SBATCH --distribution=arbitrary" in script
         assert "export AREAL_NAME_RESOLVE=rpc://ctrl:2379" in script
         assert "export TPU_FLAG='a b'" in script            # quoted
-        assert "export SLURM_HOSTFILE=/logs/trainer.hostfile" in script
+        # multiprog/hostfile self-materialize ON THE BATCH NODE (a submit-
+        # host path would not exist there on node-local-/tmp clusters)
+        assert "export SLURM_HOSTFILE=$AREAL_JOBDIR/hostfile" in script
+        assert "cat > $AREAL_JOBDIR/multiprog <<'AREAL_EOF'" in script
+        assert sub.multiprog_content.rstrip("\n") in script
+        assert sub.hostfile_content.rstrip("\n") in script
         assert "srun -K -l --ntasks=16" in script
-        assert f"--multi-prog {sub.multiprog_path}" in script
+        assert "--multi-prog $AREAL_JOBDIR/multiprog" in script
         # multiprog: rank k runs the command with --worker-index=k
         lines = sub.multiprog_content.strip().splitlines()
         assert len(lines) == 16
@@ -148,10 +153,11 @@ class TestSlurmCommands:
         assert ids == ["4242"] and s._job_ids["rollout"] == "4242"
         assert calls[0][:2] == ["sbatch", "--parsable"]
         assert (tmp_path / "rollout.sbatch").exists()
-        assert (tmp_path / "rollout.multiprog").exists()
-        assert (tmp_path / "rollout.hostfile").exists()
         sp_script = (tmp_path / "rollout.sbatch").read_text()
         assert "srun -K -l --ntasks=4" in sp_script
+        # the script carries its own multiprog/hostfile payload
+        assert "cat > $AREAL_JOBDIR/multiprog" in sp_script
+        assert "--worker-index=3" in sp_script
 
 
 def test_eval_offline_harness(tmp_path):
