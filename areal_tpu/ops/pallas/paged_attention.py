@@ -50,12 +50,13 @@ def _decode_kernel(
     vs_ref,      # [SB, Hkv, D]
     kv_hbm,      # [L, P, 2, Hkv, page, D] whole pool, ANY/HBM
     o_ref,       # [SB, Hq, D]
-    kv_scr,      # [SB, 2, Hkv, KP*page, D] pool dtype — pages DMA straight
-                 # into the compute layout; no in-VMEM transpose
+    kv_scr,      # [2, SB, 2, Hkv, KP*page, D] DOUBLE-buffered page scratch
+                 # — pages DMA straight into the compute layout while the
+                 # previous grid step's buffer is being consumed
     m_scr,       # [SB, HqP, LANES] f32
     l_scr,       # [SB, HqP, LANES] f32
     acc_scr,     # [SB, HqP, Dp] f32
-    sems,        # DMA semaphores [SB, KP]
+    sems,        # DMA semaphores [2, SB, KP]
     *,
     scale: float,
     page: int,
@@ -69,6 +70,8 @@ def _decode_kernel(
     bb = pl.program_id(0)
     j = pl.program_id(1)
     nblk = pl.num_programs(1)
+    total = pl.num_programs(0) * nblk
+    g = bb * nblk + j         # linearized grid step
     Hq = q_ref.shape[1]
     D = q_ref.shape[2]
     layer = layer_ref[0]
@@ -79,34 +82,58 @@ def _decode_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # start every resident-page DMA of every slot in this step, then wait —
-    # the copies all overlap
-    for s in range(sb):
-        slot = bb * sb + s
-        n_used = pl.cdiv(lens_ref[slot], page)
-        for i in range(kp):
-            @pl.when(j * kp + i < n_used)
-            def _start(s=s, i=i, slot=slot):
-                pidx = table_ref[slot, j * kp + i]
-                # K and V are interleaved per page: ONE DMA per page,
-                # landing in the [2, Hkv, i*page:(i+1)*page, D] stripe of
-                # the compute-layout scratch
-                pltpu.make_async_copy(
-                    kv_hbm.at[layer, pidx],
-                    kv_scr.at[s, :, :, pl.ds(i * page, page), :],
-                    sems.at[s, i],
-                ).start()
+    def _issue(g_t, buf):
+        """Start every resident-page DMA (and zero un-DMA'd tail blocks the
+        body will read) for linear grid step ``g_t`` into buffer ``buf``."""
+        bb_t = g_t // nblk
+        j_t = g_t % nblk
+        # the batched body reads EVERY slot's stripe whenever any slot of
+        # the block is active, so un-DMA'd pages of shorter slots must be
+        # zeroed up to the block the longest slot reaches (masked
+        # probabilities are 0, but 0 * NaN = NaN in the PV dot)
+        max_lens_t = functools.reduce(
+            jnp.maximum, [lens_ref[bb_t * sb + s] for s in range(sb)]
+        )
+        for s in range(sb):
+            slot = bb_t * sb + s
+            n_used = pl.cdiv(lens_ref[slot], page)
+            for i in range(kp):
+                @pl.when(j_t * kp + i < n_used)
+                def _start(s=s, i=i, slot=slot, j_t=j_t):
+                    pidx = table_ref[slot, j_t * kp + i]
+                    # K and V are interleaved per page: ONE DMA per page,
+                    # landing in the [2, Hkv, i*page:(i+1)*page, D] stripe
+                    # of the compute-layout scratch
+                    pltpu.make_async_copy(
+                        kv_hbm.at[layer, pidx],
+                        kv_scr.at[buf, s, :, :, pl.ds(i * page, page), :],
+                        sems.at[buf, s, i],
+                    ).start()
 
-            # un-DMA'd tail pages must not be NaN/garbage (masked
-            # probabilities are 0 but 0 * NaN = NaN in the PV dot) — but
-            # only blocks the BODY actually reads need zeroing
-            @pl.when(
-                (j * kp + i >= n_used) & (j * kp * page < lens_ref[slot])
-            )
-            def _zero(s=s, i=i):
-                kv_scr[s, :, :, pl.ds(i * page, page), :] = jnp.zeros(
-                    (2, n_kv, page, D), kv_scr.dtype
+                @pl.when(
+                    (j_t * kp + i >= n_used)
+                    & (j_t * kp * page < max_lens_t)
                 )
+                def _zero(s=s, i=i, j_t=j_t):
+                    kv_scr[buf, s, :, :, pl.ds(i * page, page), :] = (
+                        jnp.zeros((2, n_kv, page, D), kv_scr.dtype)
+                    )
+
+    # Software pipeline over the (sequential) linearized grid: step g's
+    # pages were prefetched at step g-1; here we kick off g+1's DMAs BEFORE
+    # consuming g's, so the HBM reads for the next block overlap this
+    # block's dots. Un-overlapped DMA cost drops from every grid step to
+    # one per kernel call (measured r4: the serial issue->wait->compute
+    # loop held the kernel at ~0.42 of HBM bandwidth).
+    buf = jax.lax.rem(g, 2)
+
+    @pl.when(g == 0)
+    def _prologue():
+        _issue(0, 0)
+
+    @pl.when(g + 1 < total)
+    def _prefetch():
+        _issue(g + 1, jax.lax.rem(g + 1, 2))
 
     for s in range(sb):
         slot = bb * sb + s
@@ -117,79 +144,90 @@ def _decode_kernel(
                 pidx = table_ref[slot, j * kp + i]
                 pltpu.make_async_copy(
                     kv_hbm.at[layer, pidx],
-                    kv_scr.at[s, :, :, pl.ds(i * page, page), :],
-                    sems.at[s, i],
+                    kv_scr.at[buf, s, :, :, pl.ds(i * page, page), :],
+                    sems.at[buf, s, i],
                 ).wait()
 
     S = kp * page
-    for s in range(sb):
-        slot = bb * sb + s
-        lens_b = lens_ref[slot]
+    # per-slot resident lengths as an [SB, 1, S] operand built from stacked
+    # scalar SPLATS (Mosaic rejects 1D->3D vector reshapes); the whole
+    # block body is BATCHED over slots — one slot-folded-batch dot pair
+    # instead of SB sequential small-dot bodies, which left the MXU idle
+    # between per-slot dots and made the (now DMA-overlapped) kernel
+    # compute-bound
+    lens_v = jnp.stack(
+        [jnp.full((1, S), lens_ref[bb * sb + s], jnp.int32)
+         for s in range(sb)]
+    )                                                          # [SB, 1, S]
+    max_lens = functools.reduce(
+        jnp.maximum, [lens_ref[bb * sb + s] for s in range(sb)]
+    )
 
-        @pl.when((j * S < lens_b) & (lens_b > 0))
-        def _body(s=s, lens_b=lens_b):
-            q = q_ref[s].reshape(n_kv, n_rep, D)              # [Hkv, r, D]
-            k = kv_scr[s, 0]                                  # [Hkv, S, D]
-            v = kv_scr[s, 1]
-            sc = jax.lax.dot_general(
-                q, k, (((2,), (2,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32,
-            ) * scale                                         # [Hkv, r, S]
-            if soft_cap is not None:
-                sc = soft_cap * jnp.tanh(sc / soft_cap)
-            sc = sc.reshape(Hq, S)
-            kpos = j * S + jax.lax.broadcasted_iota(jnp.int32, (Hq, S), 1)
-            mask = kpos < lens_b
-            if sliding_window is not None:
-                # the query sits at position lens_b
-                mask &= kpos > lens_b - sliding_window
-            sc = jnp.where(mask, sc, NEG_INF)
+    @pl.when((j * S < max_lens) & (max_lens > 0))
+    def _body():
+        # (SB, Hkv) folds into ONE batch dim (Mosaic's tpu.matmul supports
+        # a single batch dim); the reshape is layout-free
+        q = q_ref[...].reshape(sb * n_kv, n_rep, D)
+        k = kv_scr[buf, :, 0].reshape(sb * n_kv, S, D)
+        v = kv_scr[buf, :, 1].reshape(sb * n_kv, S, D)
+        sc = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                                             # [SB*Hkv,r,S]
+        if soft_cap is not None:
+            sc = soft_cap * jnp.tanh(sc / soft_cap)
+        sc = sc.reshape(sb, Hq, S)
+        kpos = j * S + jax.lax.broadcasted_iota(jnp.int32, (sb, Hq, S), 2)
+        mask = kpos < lens_v
+        if sliding_window is not None:
+            # each query sits at position lens of its slot
+            mask &= kpos > lens_v - sliding_window
+        sc = jnp.where(mask, sc, NEG_INF)
 
-            m_prev = m_scr[s, :Hq, 0:1]
-            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
-            p = jnp.where(mask, jnp.exp(sc - m_new), 0.0)     # [Hq, S]
-            corr = jnp.exp(
-                jnp.where(m_prev > NEG_INF / 2, m_prev - m_new, 0.0)
-            )
-            l_new = corr * l_scr[s, :Hq, 0:1] + jnp.sum(
-                p, axis=1, keepdims=True
-            )
-            pv = jax.lax.dot_general(
-                p.reshape(n_kv, n_rep, S).astype(v.dtype), v,
-                (((2,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32,
-            ).reshape(Hq, D)
-            acc_scr[s, :Hq, :D] = acc_scr[s, :Hq, :D] * corr + pv
-            m_scr[s, :Hq] = jnp.broadcast_to(m_new, (Hq, LANES))
-            l_scr[s, :Hq] = jnp.broadcast_to(l_new, (Hq, LANES))
+        m_prev = m_scr[:, :Hq, 0:1]                           # [SB,Hq,1]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=2, keepdims=True))
+        p = jnp.where(mask, jnp.exp(sc - m_new), 0.0)         # [SB,Hq,S]
+        corr = jnp.exp(
+            jnp.where(m_prev > NEG_INF / 2, m_prev - m_new, 0.0)
+        )
+        l_new = corr * l_scr[:, :Hq, 0:1] + jnp.sum(
+            p, axis=2, keepdims=True
+        )
+        pv = jax.lax.dot_general(
+            p.reshape(sb * n_kv, n_rep, S).astype(v.dtype), v,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(sb, Hq, D)
+        acc_scr[:, :Hq, :D] = acc_scr[:, :Hq, :D] * corr + pv
+        m_scr[:, :Hq] = jnp.broadcast_to(m_new, (sb, Hq, LANES))
+        l_scr[:, :Hq] = jnp.broadcast_to(l_new, (sb, Hq, LANES))
 
     @pl.when(j == nblk - 1)
     def _done():
         # fold the current tokens' self-attention (always attended; their
         # KV is scattered into the pool by the caller AFTER the layer scan)
-        for s in range(sb):
-            q = q_ref[s].reshape(n_kv, n_rep, D)
-            ks = ks_ref[s]                                    # [Hkv, D]
-            vs = vs_ref[s]
-            s_self = jnp.sum(
-                q.astype(jnp.float32) * ks[:, None].astype(jnp.float32),
-                axis=2,
-            ) * scale                                         # [Hkv, r]
-            if soft_cap is not None:
-                s_self = soft_cap * jnp.tanh(s_self / soft_cap)
-            s_self = s_self.reshape(Hq, 1)
-            m_prev = m_scr[s, :Hq, 0:1]
-            m_new = jnp.maximum(m_prev, s_self)
-            corr = jnp.exp(
-                jnp.where(m_prev > NEG_INF / 2, m_prev - m_new, 0.0)
-            )
-            p_self = jnp.exp(s_self - m_new)                  # [Hq, 1]
-            l = corr * l_scr[s, :Hq, 0:1] + p_self
-            v_rep = jnp.broadcast_to(
-                vs[:, None].astype(jnp.float32), (n_kv, n_rep, D)
-            ).reshape(Hq, D)
-            acc = acc_scr[s, :Hq, :D] * corr + p_self * v_rep
-            o_ref[s] = (acc / l).astype(o_ref.dtype)
+        q = q_ref[...].reshape(sb, n_kv, n_rep, D)
+        ks = ks_ref[...]                                      # [SB,Hkv,D]
+        vs = vs_ref[...]
+        s_self = jnp.sum(
+            q.astype(jnp.float32) * ks[:, :, None].astype(jnp.float32),
+            axis=3,
+        ) * scale                                             # [SB,Hkv,r]
+        if soft_cap is not None:
+            s_self = soft_cap * jnp.tanh(s_self / soft_cap)
+        s_self = s_self.reshape(sb, Hq, 1)
+        m_prev = m_scr[:, :Hq, 0:1]
+        m_new = jnp.maximum(m_prev, s_self)
+        corr = jnp.exp(
+            jnp.where(m_prev > NEG_INF / 2, m_prev - m_new, 0.0)
+        )
+        p_self = jnp.exp(s_self - m_new)                      # [SB,Hq,1]
+        l = corr * l_scr[:, :Hq, 0:1] + p_self
+        v_rep = jnp.broadcast_to(
+            vs[:, :, None].astype(jnp.float32), (sb, n_kv, n_rep, D)
+        ).reshape(sb, Hq, D)
+        acc = acc_scr[:, :Hq, :D] * corr + p_self * v_rep
+        o_ref[...] = (acc / l).astype(o_ref.dtype)
 
 
 def decode(
@@ -227,9 +265,9 @@ def decode(
     sb = slots_per_step
     while B % sb:
         sb //= 2
-    # VMEM budget: keep the KV scratch under ~8 MB
-    while sb > 1 and 2 * sb * kp * page * Hkv * D * pages.dtype.itemsize \
-            > 8 * 1024 * 1024:
+    # VMEM budget: keep the (double-buffered) KV scratch under ~16 MB
+    while sb > 1 and 2 * 2 * sb * kp * page * Hkv * D * pages.dtype.itemsize \
+            > 16 * 1024 * 1024:
         sb //= 2
 
     kernel = functools.partial(
@@ -258,15 +296,24 @@ def decode(
                 (sb, Hq, D), lambda b, j, ly, t, l: (b, 0, 0)
             ),
             scratch_shapes=[
-                pltpu.VMEM((sb, 2, Hkv, kp * page, D), pages.dtype),
+                pltpu.VMEM((2, sb, 2, Hkv, kp * page, D), pages.dtype),
                 pltpu.VMEM((sb, hq_pad, LANES), jnp.float32),
                 pltpu.VMEM((sb, hq_pad, LANES), jnp.float32),
                 # lanes padded to a full tile; the kernel uses [:, :D]
                 pltpu.VMEM((sb, hq_pad, max(D, LANES)), jnp.float32),
-                pltpu.SemaphoreType.DMA((sb, kp)),
+                pltpu.SemaphoreType.DMA((2, sb, kp)),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        # the double-buffered page scratch alone can exceed the 16 MB
+        # default scoped-vmem budget; size the limit from the actual
+        # scratch + generous op margin (v5e VMEM is 128 MB)
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=(
+                2 * 2 * sb * Hkv * kp * page * D * pages.dtype.itemsize
+                + 32 * 2**20
+            ),
+        ),
         interpret=_interpret(),
     )(
         jnp.asarray(layer, jnp.int32).reshape(1), table, lens,

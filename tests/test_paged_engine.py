@@ -308,10 +308,16 @@ class TestPallasPagedDecode:
     current token's K/V as SEPARATE operands (the pool is read-only during
     the layer scan) and fold its self-attention into the online softmax."""
 
+    # (pages_per_step, slots_per_step): the default derives sb=4/kp=4 at
+    # this shape -> a (1, 1) grid that never runs the double-buffer
+    # prefetch pipeline; the (2, 2) and (1, 2) cases force multi-step
+    # linearized grids (buffer-parity alternation, next-step zero guard,
+    # cross-bb prefetch) — ADVICE r4: the pipeline must not be dead in CI.
+    @pytest.mark.parametrize("kp_sb", [(8, 8), (2, 2), (1, 2)])
     @pytest.mark.parametrize(
         "soft_cap,window", [(None, None), (5.0, None), (None, 6)]
     )
-    def test_parity_vs_xla_and_dense(self, soft_cap, window):
+    def test_parity_vs_xla_and_dense(self, soft_cap, window, kp_sb):
         from areal_tpu.ops import paged_attention as xla_paged
         from areal_tpu.ops.pallas import paged_attention as pl_paged
 
@@ -331,6 +337,7 @@ class TestPallasPagedDecode:
         got = pl_paged.decode(
             q, k_self, v_self, pool, jnp.int32(layer), table,
             lens, soft_cap=soft_cap, sliding_window=window,
+            pages_per_step=kp_sb[0], slots_per_step=kp_sb[1],
         )
         want = xla_paged.paged_decode_attention(
             q, k_self, v_self, pool, jnp.int32(layer), table,
