@@ -137,8 +137,13 @@ def gen_server_main(cfg, server_idx: int):
 
         port = network.find_free_port()
         host = "127.0.0.1"
+        from areal_tpu.base import constants as _constants
+
         runner = await serve(
-            engine, host, port, decode_steps=cfg.gen.decode_steps_per_chunk
+            engine, host, port, decode_steps=cfg.gen.decode_steps_per_chunk,
+            metrics_dump_path=os.path.join(
+                _constants.get_log_root(), f"gen_server_{server_idx}.json"
+            ),
         )
         name_resolve.add(
             names.gen_server(cfg.experiment_name, cfg.trial_name, server_idx),

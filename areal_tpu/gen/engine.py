@@ -366,15 +366,16 @@ class GenerationEngine:
             w *= 2
         return min(w, self.M)
 
-    def _extend_fn(self, n_rows: int, width: int):
-        key = (n_rows, width)
+    def _extend_fn(self, n_rows: int, width: int, skip_pool: bool = False):
+        key = (n_rows, width, skip_pool)
         if key in self._jit_extend:
             return self._jit_extend[key]
         cfg = self.cfg
 
         def extend(params, state: GenState, tokens, table_rows, start, n_new):
             cache = tfm.extend_paged(
-                params, cfg, state.cache, tokens, table_rows, start, n_new
+                params, cfg, state.cache, tokens, table_rows, start, n_new,
+                skip_pool=skip_pool,
             )
             return dataclasses.replace(state, cache=cache)
 
@@ -460,7 +461,13 @@ class GenerationEngine:
                     break
                 max_pos = int(np.max(starts0 + np.minimum(counts, (c + 1) * C)))
                 W = self._table_width(max_pos)
-                extend = self._extend_fn(n, W)
+                # cold-prompt first waves start every row at position 0:
+                # the pool prefix is empty, so the extend program can skip
+                # the page gather + pool scan entirely (STATIC flag — jit
+                # key includes it; at short-prompt admission the dead pool
+                # scan cost as much as the intra-chunk attention)
+                skip_pool = c == 0 and not starts0.any()
+                extend = self._extend_fn(n, W, skip_pool)
                 self.state = extend(
                     self.params, self.state,
                     jnp.asarray(all_tokens[:, c * C : (c + 1) * C]),

@@ -33,13 +33,27 @@ logger = logging.getLogger("areal_tpu.gen.server")
 
 
 class GenerationHTTPServer:
-    def __init__(self, engine: GenerationEngine, decode_steps: int = 16):
+    def __init__(
+        self,
+        engine: GenerationEngine,
+        decode_steps: int = 16,
+        metrics_dump_path: Optional[str] = None,
+    ):
         self.engine = engine
         self.decode_steps = decode_steps
+        self.metrics_dump_path = metrics_dump_path
         self._futures: Dict[str, asyncio.Future] = {}
         self._served = 0
         self._gen_tokens = 0
         self._start = time.time()
+        # phase accounting (where a serving round's wall time goes — the
+        # observable the reference logs continuously,
+        # realhf/system/gserver_manager.py:279-285): seconds inside engine
+        # step calls, seconds swapping weights, interrupts issued
+        self._t_step_busy = 0.0
+        self._t_weight = 0.0
+        self._n_weight_updates = 0
+        self._n_interrupted = 0
         self._hbm = hbm.HBMMonitor(tag="gen-server")
         self._lock = asyncio.Lock()
         self.app = web.Application()
@@ -62,9 +76,21 @@ class GenerationHTTPServer:
     async def _on_startup(self, app):
         self._loop_task = asyncio.get_event_loop().create_task(self._run())
 
+    def _dump_metrics(self):
+        """Phase accounting survives the process (the in-memory
+        /metrics_json gauges die with it) — how a bench or postmortem
+        attributes where the serving side's wall time went."""
+        try:
+            with open(self.metrics_dump_path, "w") as f:
+                json.dump(self._metrics_dict(), f)
+        except OSError:
+            logger.exception("could not dump gen-server metrics")
+
     async def _on_cleanup(self, app):
         if self._loop_task:
             self._loop_task.cancel()
+        if self.metrics_dump_path:
+            self._dump_metrics()
 
     def _resolve(self, outs):
         for o in outs:
@@ -82,7 +108,14 @@ class GenerationHTTPServer:
         # kill threshold, realhf/system/model_worker.py:1507-1512)
         hbm_period = float(os.environ.get("AREAL_HBM_CHECK_SECS", 30.0))
         next_hbm = time.time() + hbm_period
+        # metrics dump rides the same loop: PERIODIC, not only at cleanup —
+        # a SIGTERM'd worker (launcher straggler kill) must still leave its
+        # phase accounting behind
+        next_dump = time.time() + 10.0
         while True:
+            if self.metrics_dump_path and time.time() >= next_dump:
+                next_dump = time.time() + 10.0
+                self._dump_metrics()
             if time.time() >= next_hbm:
                 next_hbm = time.time() + hbm_period
                 try:
@@ -101,9 +134,11 @@ class GenerationHTTPServer:
                 await asyncio.sleep(0.005)
                 continue
             async with self._lock:
+                t0 = time.monotonic()
                 outs = await loop.run_in_executor(
                     None, self.engine.step, self.decode_steps
                 )
+                self._t_step_busy += time.monotonic() - t0
             self._resolve(outs)
 
     # ------------------------------------------------------------------ #
@@ -150,6 +185,10 @@ class GenerationHTTPServer:
         path = d["model_path"]
         allow_interrupt = bool(d.get("allow_interrupt", True))
         async with self._lock:
+            # timer starts INSIDE the lock: waiting out an in-flight decode
+            # chunk is step_busy time, not weight-swap time — double-booking
+            # would make the dumped phases sum past uptime
+            t_upd0 = time.monotonic()
             if allow_interrupt:
                 interrupted = self.engine.pause()
                 self._resolve(interrupted)
@@ -183,6 +222,9 @@ class GenerationHTTPServer:
                 msg = f"weight update failed: {e!r}"
                 logger.exception("weight update failed")
             self.engine.resume()
+        self._t_weight += time.monotonic() - t_upd0
+        self._n_weight_updates += 1
+        self._n_interrupted += num_paused
         return web.json_response(
             {"success": ok, "message": msg, "num_paused_requests": num_paused}
         )
@@ -207,6 +249,28 @@ class GenerationHTTPServer:
     async def _health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
 
+    def _metrics_dict(self) -> dict:
+        return {
+            "running": self.engine.n_running(),
+            "pending": len(self.engine._pending),
+            "served": self._served,
+            "gen_tokens": self._gen_tokens,
+            "gen_throughput": self._gen_tokens / max(time.time() - self._start, 1e-6),
+            "version": self.engine.version,
+            "max_slots": self.engine.B,
+            # paged KV pool + prefix cache observability
+            "pages_free": self.engine.pool.n_free,
+            "pages_total": self.engine.n_pages,
+            "prefix_pages": len(self.engine.prefix),
+            # phase accounting: where serving wall time went
+            "uptime_s": round(time.time() - self._start, 3),
+            "step_busy_s": round(self._t_step_busy, 3),
+            "weight_update_s": round(self._t_weight, 3),
+            "n_weight_updates": self._n_weight_updates,
+            "n_interrupted": self._n_interrupted,
+            **{f"engine_{k}": v for k, v in self.engine.stats.items()},
+        }
+
     async def _metrics(self, request: web.Request) -> web.Response:
         # HBM gauges off the event loop: memory_stats() can be a blocking
         # RPC on tunneled devices (and the live-array fallback walks every
@@ -214,24 +278,8 @@ class GenerationHTTPServer:
         hbm_gauges = await asyncio.get_event_loop().run_in_executor(
             None, lambda: self._hbm.check(kill=False)
         )
-        return web.json_response(
-            {
-                "running": self.engine.n_running(),
-                "pending": len(self.engine._pending),
-                "served": self._served,
-                "gen_tokens": self._gen_tokens,
-                "gen_throughput": self._gen_tokens / max(time.time() - self._start, 1e-6),
-                "version": self.engine.version,
-                "max_slots": self.engine.B,
-                # paged KV pool + prefix cache observability
-                "pages_free": self.engine.pool.n_free,
-                "pages_total": self.engine.n_pages,
-                "prefix_pages": len(self.engine.prefix),
-                **{f"engine_{k}": v for k, v in self.engine.stats.items()},
-                # gauges only on the pull path — a GET must never raise
-                **hbm_gauges,
-            }
-        )
+        # gauges only on the pull path — a GET must never raise
+        return web.json_response({**self._metrics_dict(), **hbm_gauges})
 
 
 async def serve(engine: GenerationEngine, host: str, port: int, **kw):

@@ -102,6 +102,9 @@ class ModelConfig:
     # else 512). Bigger score tiles amortize the kernels' VPU mask/softmax
     # passes at very long context; may need more VMEM.
     flash_block_size: Optional[int] = None
+    # Separate K-block size (None = same as flash_block_size). Rectangular
+    # tiles trade VPU-pass shape against MXU dot shapes at long context.
+    flash_block_size_k: Optional[int] = None
 
     # Cross-entropy in token blocks of this size (None = dense): the LM
     # head + log-softmax + label gather run per block under remat, so the

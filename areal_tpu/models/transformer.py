@@ -344,6 +344,7 @@ def forward_packed(
             sliding_window=cfg.sliding_window,
             use_flash=cfg.flash_enabled(),
             flash_block_size=cfg.flash_block_size,
+            flash_block_size_k=cfg.flash_block_size_k,
             max_seqlen=cfg.attn_max_seqlen,
         )
 
@@ -706,12 +707,14 @@ def extend_paged(
     table: jnp.ndarray,      # [B, M] page table
     start: jnp.ndarray,      # [B] tokens already resident per slot
     n_new: jnp.ndarray,      # [B] valid tokens in this chunk (<= C)
+    skip_pool: bool = False,
 ) -> PagedKVCache:
     """Chunked prefill: attend the chunk causally over everything resident
     (pool part + intra-chunk part, merged inside the op) and scatter the
     chunk's KV into the pages once after the layer scan. Logits are not
     computed — admission feeds the last prompt token to the first decode
-    step instead."""
+    step instead. ``skip_pool`` (STATIC): every row starts at position 0,
+    so the pool scan is dead weight (see ``paged_extend_attention``)."""
     from areal_tpu.ops import paged_attention as paged_ops
 
     B, C = tokens.shape
@@ -736,6 +739,7 @@ def extend_paged(
             softmax_scale=cfg.softmax_scale,
             soft_cap=cfg.attn_logits_soft_cap,
             sliding_window=cfg.sliding_window,
+            skip_pool=skip_pool,
         )
         x = x + _attn_out(lp["attn"], ctx.astype(x.dtype))
         h = _norm(cfg, lp["ln2"], x)

@@ -81,6 +81,7 @@ def packed_attention(
     sliding_window: Optional[int] = None,
     use_flash: bool = False,
     flash_block_size: Optional[int] = None,
+    flash_block_size_k: Optional[int] = None,
     max_seqlen: Optional[int] = None,
 ) -> jnp.ndarray:
     """Causal self-attention over a packed token axis.
@@ -118,6 +119,9 @@ def packed_attention(
             # an override that does not divide T would silently truncate
             # the kernel grid; fall back to the largest dividing block
             bs //= 2
+        bsk = flash_block_size_k or bs
+        while T % bsk:
+            bsk //= 2
         return _fa.packed_flash_attention(
             q,
             k,
@@ -127,6 +131,7 @@ def packed_attention(
             soft_cap=soft_cap,
             sliding_window=sliding_window,
             block_size=bs,
+            block_size_k=bsk,
             max_seqlen=max_seqlen,
         )
     return _attention_xla(

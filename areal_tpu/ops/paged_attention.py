@@ -137,11 +137,18 @@ def paged_extend_attention(
     soft_cap: Optional[float] = None,
     sliding_window: Optional[int] = None,
     kv_block: int = 1024,
+    skip_pool: bool = False,
 ) -> jnp.ndarray:
     """Chunked-prefill attention: chunk token i (global position start+i)
     attends every pool position < start plus chunk tokens <= i (intra-chunk
     causal). The chunk's K/V ride as operands — the caller scatters them
     into the pool after its layer scan. Returns ``[B, C, H, D]``.
+
+    ``skip_pool`` (STATIC): the caller knows every row starts at position 0
+    (cold-prompt first chunks), so the pool holds nothing visible — skip
+    the page gather + blockwise pool scan entirely. At short-prompt
+    admission the pool part costs as much as the intra-chunk part while
+    contributing only masked-out zeros.
 
     The pool part runs as a blockwise online softmax over KV blocks (a
     ``lax.scan``): the naive formulation materializes ``[B, H, C, S]`` f32
@@ -179,6 +186,13 @@ def paged_extend_attention(
         "bgrcs,bsgd->bgrcd", p_in.astype(v_chunk.dtype), v_chunk,
         preferred_element_type=jnp.float32,
     )
+
+    if skip_pool:
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        out = jnp.moveaxis(out, 3, 1).reshape(B, C, H, D)
+        return jnp.where(
+            valid_q[:, :, None, None], out, 0.0
+        ).astype(q.dtype)
 
     # ---- pool part: blockwise online softmax over resident KV ----------
     k, v = gather_pages(pages, table, layer)  # [B, S, Hkv, D]

@@ -171,29 +171,47 @@ def _block_needs_mask(segment_ids, block_q, block_k, sliding_window, T):
     return jnp.where(interior, 0, 1).astype(jnp.int32).reshape(-1)
 
 
-def _scores_log2(q_ref, k_ref, scale, soft_cap):
+def _scores_log2(q2d, k_ref, scale, soft_cap):
     """Block scores in the log2 domain: ``(q·kᵀ)·scale·log2e`` (soft-capped
-    in the natural domain first when requested). f32 [bq, bk]."""
+    in the natural domain first when requested). ``q2d`` is the (possibly
+    rep-folded) ``[rows, D]`` q block; result f32 [rows, bk].
+
+    Without a cap, the scale folds into the q BLOCK before the dot — a
+    [rows, D] multiply instead of a full [rows, bk] VPU pass over the
+    scores (D=64 models are VPU-bound at long context; one pass of ~5 is
+    free). The extra bf16 rounding on q is below the dot's own bf16
+    noise."""
+    if soft_cap is None:
+        qs = q2d * jnp.asarray(scale * LOG2E, q2d.dtype)
+        return jax.lax.dot_general(
+            qs, k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
     s = jax.lax.dot_general(
-        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        q2d, k_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    if soft_cap is None:
-        return s * (scale * LOG2E)
     s = soft_cap * jnp.tanh(s * (scale / soft_cap))
     return s * LOG2E
 
 
-def _token_mask(seg_q_ref, seg_k_ref, iq, ik, block_q, block_k, sliding_window):
+def _token_mask(seg_q_ref, seg_k_ref, iq, ik, block_q, block_k, sliding_window,
+                n_rep: int = 1):
     """Token-level mask for a boundary block (causal ∧ same segment ∧ not
-    pad ∧ window)."""
-    q_idx = iq * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
+    pad ∧ window). With ``n_rep > 1`` the q rows are ``n_rep`` grouped
+    heads' blocks stacked (GQA head folding): row r*block_q + t is token
+    ``iq*block_q + t`` of rep r, so positions repeat with period block_q."""
+    rows = n_rep * block_q
+    row = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 0)
+    q_idx = iq * block_q + (
+        jax.lax.rem(row, block_q) if n_rep > 1 else row
     )
     k_idx = ik * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1
+        jnp.int32, (rows, block_k), 1
     )
     seg_q = seg_q_ref[0][:, None]
+    if n_rep > 1:
+        seg_q = jnp.concatenate([seg_q] * n_rep, axis=0)
     seg_k = seg_k_ref[0][None, :]
     mask = (q_idx >= k_idx) & (seg_q == seg_k) & (seg_q > 0)
     if sliding_window is not None:
@@ -228,55 +246,47 @@ def _dispatch_masked(active, specialize, needs_scalar, body):
 # --------------------------------------------------------------------------- #
 
 
-def _fwd_kernel(
-    kstart_ref,  # [nq] int32 scalar-prefetch
-    needs_ref,   # [nq*nk] int32 scalar-prefetch (see _block_needs_mask)
-    seg_q_ref,   # [1, block_q] int32
-    seg_k_ref,   # [1, block_k] int32
-    q_ref,       # [1, block_q, D]
-    k_ref,       # [1, block_k, D]
-    v_ref,       # [1, block_k, D]
-    o_ref,       # [1, block_q, D]
-    lse_ref,     # [1, 1, block_q, 1] f32 (column layout; see _flash_forward)
-    m_scr,       # [block_q, LANES] f32 (running max, log2 domain)
-    l_scr,       # [block_q, LANES] f32
-    acc_scr,     # [block_q, D] f32
-    *,
-    scale: float,
-    block_q: int,
-    block_k: int,
-    nk_blocks: int,
-    soft_cap: Optional[float],
-    sliding_window: Optional[int],
-    specialize: bool,
+def _fwd_step(
+    iq, ik, is_first, is_last, active,
+    needs_ref, seg_q_ref, seg_k_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+    m_scr, l_scr, acc_scr,
+    *, scale, block_q, block_k, nk_blocks, soft_cap, sliding_window,
+    specialize, n_rep,
 ):
-    iq = pl.program_id(1)
-    j = pl.program_id(2)
-    nk = pl.num_programs(2)
-    ik = kstart_ref[iq] + j  # band-relative -> absolute k block
+    """One forward grid step (shared by the band and triangle kernels):
+    block indices + first/last/active arrive as traced values.
 
-    @pl.when(j == 0)
+    GQA head folding: the grid's head dim walks KV heads; the q/o blocks
+    carry ALL ``n_rep`` grouped q heads stacked ``[n_rep, block_q, D]``
+    and fold to ``[n_rep*block_q, D]`` rows for ONE score/PV dot pair per
+    step — n_rep x fewer grid steps, n_rep x fewer k/v block fetches, and
+    n_rep x taller dots (better MXU occupancy at D=64)."""
+    rows = n_rep * block_q
+
+    @pl.when(is_first)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     def _update(masked: bool):
-        s2 = _scores_log2(q_ref, k_ref, scale, soft_cap)  # [bq, bk] f32
+        q2d = q_ref[...].reshape(rows, q_ref.shape[-1])
+        s2 = _scores_log2(q2d, k_ref, scale, soft_cap)  # [rows, bk] f32
         if masked:
             mask = _token_mask(
-                seg_q_ref, seg_k_ref, iq, ik, block_q, block_k, sliding_window
+                seg_q_ref, seg_k_ref, iq, ik, block_q, block_k,
+                sliding_window, n_rep,
             )
             s2 = jnp.where(mask, s2, NEG_INF)
-        m_prev = m_scr[:, 0:1]                     # [bq, 1]
+        m_prev = m_scr[:, 0:1]                     # [rows, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s2, axis=1, keepdims=True))
-        p = jnp.exp2(s2 - m_new)                   # [bq, bk]
+        p = jnp.exp2(s2 - m_new)                   # [rows, bk]
         if masked:
             # NEG_INF is finite, so exp2(s2 - m_new) is 1 (not 0) on
             # fully-masked rows — zero masked entries explicitly so pad rows
             # keep l == 0 and output 0, matching the XLA path.
             p = jnp.where(mask, p, 0.0)
-        corr = jnp.exp2(m_prev - m_new)            # [bq, 1]
+        corr = jnp.exp2(m_prev - m_new)            # [rows, 1]
         l_new = corr * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
@@ -285,20 +295,113 @@ def _fwd_kernel(
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    active = ik <= _last_k(iq, block_q, block_k)
     needs = needs_ref[iq * nk_blocks + jnp.minimum(ik, nk_blocks - 1)]
     _dispatch_masked(active, specialize, needs, _update)
 
-    @pl.when(j == nk - 1)
+    @pl.when(is_last)
     def _done():
         l = l_scr[:, 0:1]
         safe_l = jnp.where(l > 0.0, l, 1.0)
-        o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+        D = o_ref.shape[-1]
+        o_ref[...] = (
+            (acc_scr[...] / safe_l).reshape(n_rep, block_q, D)
+        ).astype(o_ref.dtype)
         # natural-log logsumexp residual; NEG_INF on fully-masked (pad) rows
         lse = jnp.where(
             l > 0.0, m_scr[:, 0:1] * LN2 + jnp.log(safe_l), NEG_INF
-        )                                          # [bq, 1]
-        lse_ref[0, 0] = lse
+        )                                          # [rows, 1]
+        lse_ref[...] = lse.reshape(n_rep, 1, block_q, 1)
+
+
+def _fwd_kernel(
+    kstart_ref,  # [nq] int32 scalar-prefetch
+    needs_ref,   # [nq*nk] int32 scalar-prefetch (see _block_needs_mask)
+    seg_q_ref,   # [1, block_q] int32
+    seg_k_ref,   # [1, block_k] int32
+    q_ref,       # [n_rep, block_q, D] — the kv head's whole q group
+    k_ref,       # [1, block_k, D]
+    v_ref,       # [1, block_k, D]
+    o_ref,       # [n_rep, block_q, D]
+    lse_ref,     # [n_rep, 1, block_q, 1] f32 (column layout; see _flash_forward)
+    m_scr,       # [n_rep*block_q, LANES] f32 (running max, log2 domain)
+    l_scr,       # [n_rep*block_q, LANES] f32
+    acc_scr,     # [n_rep*block_q, D] f32
+    *,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    nk_blocks: int,
+    soft_cap: Optional[float],
+    sliding_window: Optional[int],
+    specialize: bool,
+    n_rep: int,
+):
+    iq = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+    ik = kstart_ref[iq] + j  # band-relative -> absolute k block
+    _fwd_step(
+        iq, ik, j == 0, j == nk - 1, ik <= _last_k(iq, block_q, block_k),
+        needs_ref, seg_q_ref, seg_k_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+        m_scr, l_scr, acc_scr,
+        scale=scale, block_q=block_q, block_k=block_k, nk_blocks=nk_blocks,
+        soft_cap=soft_cap, sliding_window=sliding_window,
+        specialize=specialize, n_rep=n_rep,
+    )
+
+
+def _fwd_kernel_tri(
+    kstart_ref,  # [nq] int32 scalar-prefetch (runtime segment/window start)
+    needs_ref,   # [nq*nk] int32 scalar-prefetch
+    iq_tab,      # [L] int32 STATIC: q-block of linear step l
+    ik_tab,      # [L] int32 STATIC: k-block of linear step l
+    first_tab,   # [L] int32 STATIC: 1 = first step of its q block
+    last_tab,    # [L] int32 STATIC: 1 = last step of its q block
+    seg_q_ref, seg_k_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+    m_scr, l_scr, acc_scr,
+    *,
+    scale, block_q, block_k, nk_blocks, soft_cap, sliding_window, specialize,
+    n_rep,
+):
+    """Triangle-enumerated forward: the grid's second dim walks ONLY the
+    causally-possible (iq, ik) block pairs (static tables), instead of the
+    nq x nk rectangle whose upper half is no-op steps at full-causal long
+    context (~half the grid at 32k single-sequence; each no-op still costs
+    a grid-step latency). Runtime segment starts prune further via
+    ``active = ik >= kstart[iq]``."""
+    l = pl.program_id(1)
+    iq = iq_tab[l]
+    ik = ik_tab[l]
+    _fwd_step(
+        iq, ik, first_tab[l] == 1, last_tab[l] == 1, ik >= kstart_ref[iq],
+        needs_ref, seg_q_ref, seg_k_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+        m_scr, l_scr, acc_scr,
+        scale=scale, block_q=block_q, block_k=block_k, nk_blocks=nk_blocks,
+        soft_cap=soft_cap, sliding_window=sliding_window,
+        specialize=specialize, n_rep=n_rep,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _tri_tables(nq, nk, block_q, block_k):
+    """Static (iq, ik) enumeration of the causal triangle's block pairs,
+    with first/last flags per q-block sweep. At full-causal context this
+    halves the grid vs the nq x nk rectangle (the pruned steps are
+    impossible under causality, not merely masked)."""
+    import numpy as np
+
+    iqs, iks, firsts, lasts = [], [], [], []
+    for iq in range(nq):
+        lk = min((iq * block_q + block_q - 1) // block_k, nk - 1)
+        for ik in range(lk + 1):
+            iqs.append(iq)
+            iks.append(ik)
+            firsts.append(1 if ik == 0 else 0)
+            lasts.append(1 if ik == lk else 0)
+    return (
+        np.asarray(iqs, np.int32), np.asarray(iks, np.int32),
+        np.asarray(firsts, np.int32), np.asarray(lasts, np.int32),
+    )
 
 
 def _flash_forward(
@@ -318,21 +421,18 @@ def _flash_forward(
     n_rep = H // Hkv
     block_q = min(block_q, T)
     block_k = min(block_k, T)
+    # rep folding multiplies the q-side tile rows by n_rep: halve block_q
+    # only when the folded [n_rep*block_q, block_k] f32 score tiles would
+    # overflow the maximum scoped-vmem budget (~114 MB) — big-tile configs
+    # like n_rep=8 x flash_block_size=2048 previously compiled unfolded
+    while 2 * n_rep * block_q * block_k * 4 > 90 * 2**20 and block_q > 512:
+        block_q //= 2
     assert T % block_q == 0 and T % block_k == 0, (T, block_q, block_k)
-    grid = (H, T // block_q, _k_band_blocks(block_q, block_k, max_seqlen, T))
     seg2d = segment_ids.reshape(1, T)
     kstart, _ = _band_bounds(segment_ids, block_q, block_k, sliding_window, T)
     needs = _block_needs_mask(segment_ids, block_q, block_k, sliding_window, T)
 
-    def kmap(h, i, j, ks, nm, r=n_rep):
-        return (
-            h // r,
-            jnp.minimum(ks[i] + j, _last_k(i, block_q, block_k)),
-            0,
-        )
-
-    kernel = functools.partial(
-        _fwd_kernel,
+    common = dict(
         scale=scale,
         block_q=block_q,
         block_k=block_k,
@@ -340,9 +440,91 @@ def _flash_forward(
         soft_cap=soft_cap,
         sliding_window=sliding_window,
         specialize=T >= SPECIALIZE_MIN_T,
+        n_rep=n_rep,
     )
+    # GQA head folding: the grid walks KV heads; each step carries the
+    # whole q-head group [n_rep, block_q, D]
+    scratch_shapes = [
+        pltpu.VMEM((n_rep * block_q, LANES), jnp.float32),
+        pltpu.VMEM((n_rep * block_q, LANES), jnp.float32),
+        pltpu.VMEM((n_rep * block_q, D), jnp.float32),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((H, T, D), q.dtype),
+        jax.ShapeDtypeStruct((H, T // block_q, block_q, 1), jnp.float32),
+    ]
+    # big score tiles ([n_rep*block_q, block_k] f32) can exceed the default
+    # scoped-vmem budget; raise it (v5e VMEM is 128 MB)
+    tile_bytes = (
+        2 * n_rep * block_q * block_k * 4
+        + sum(4 * s.shape[0] * s.shape[1] for s in scratch_shapes)
+    )
+    compiler_params = pltpu.CompilerParams(
+        **({"vmem_limit_bytes": min(tile_bytes + 48 * 2**20, 114 * 2**20)}
+           if tile_bytes > 24 * 2**20 or block_q >= 2048 else {})
+    )
+
+    if max_seqlen is None:
+        # no static band: enumerate the causal triangle's block pairs
+        # instead of the nq x nk rectangle (half of which is no-op steps at
+        # full-causal context)
+        iq_t, ik_t, first_t, last_t = _tri_tables(
+            T // block_q, T // block_k, block_q, block_k
+        )
+
+        def qmap(h, l, ks, nm, iqt, ikt, ft, lt):
+            return (h, iqt[l], 0)
+
+        def kvmap(h, l, ks, nm, iqt, ikt, ft, lt):
+            return (h, ikt[l], 0)
+
+        out, lse4 = pl.pallas_call(
+            functools.partial(_fwd_kernel_tri, **common),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=6,
+                grid=(Hkv, len(iq_t)),
+                in_specs=[
+                    pl.BlockSpec(
+                        (1, block_q),
+                        lambda h, l, ks, nm, iqt, ikt, ft, lt: (0, iqt[l]),
+                    ),
+                    pl.BlockSpec(
+                        (1, block_k),
+                        lambda h, l, ks, nm, iqt, ikt, ft, lt: (0, ikt[l]),
+                    ),
+                    pl.BlockSpec((n_rep, block_q, D), qmap),
+                    pl.BlockSpec((1, block_k, D), kvmap),
+                    pl.BlockSpec((1, block_k, D), kvmap),
+                ],
+                out_specs=[
+                    pl.BlockSpec((n_rep, block_q, D), qmap),
+                    pl.BlockSpec(
+                        (n_rep, 1, block_q, 1),
+                        lambda h, l, ks, nm, iqt, ikt, ft, lt: (h, iqt[l], 0, 0),
+                    ),
+                ],
+                scratch_shapes=scratch_shapes,
+            ),
+            out_shape=out_shape,
+            compiler_params=compiler_params,
+            interpret=_interpret(),
+        )(
+            kstart, needs, jnp.asarray(iq_t), jnp.asarray(ik_t),
+            jnp.asarray(first_t), jnp.asarray(last_t), seg2d, seg2d, q, k, v,
+        )
+        return out, lse4.reshape(H, T)
+
+    grid = (Hkv, T // block_q, _k_band_blocks(block_q, block_k, max_seqlen, T))
+
+    def kmap(h, i, j, ks, nm):
+        return (
+            h,
+            jnp.minimum(ks[i] + j, _last_k(i, block_q, block_k)),
+            0,
+        )
+
     out, lse4 = pl.pallas_call(
-        kernel,
+        functools.partial(_fwd_kernel, **common),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
@@ -355,31 +537,25 @@ def _flash_forward(
                         jnp.minimum(ks[i] + j, _last_k(i, block_q, block_k)),
                     ),
                 ),
-                pl.BlockSpec((1, block_q, D), lambda h, i, j, ks, nm: (h, i, 0)),
+                pl.BlockSpec(
+                    (n_rep, block_q, D), lambda h, i, j, ks, nm: (h, i, 0)
+                ),
                 pl.BlockSpec((1, block_k, D), kmap),
                 pl.BlockSpec((1, block_k, D), kmap),
             ],
             out_specs=[
-                pl.BlockSpec((1, block_q, D), lambda h, i, j, ks, nm: (h, i, 0)),
                 pl.BlockSpec(
-                    (1, 1, block_q, 1), lambda h, i, j, ks, nm: (h, i, 0, 0)
+                    (n_rep, block_q, D), lambda h, i, j, ks, nm: (h, i, 0)
+                ),
+                pl.BlockSpec(
+                    (n_rep, 1, block_q, 1),
+                    lambda h, i, j, ks, nm: (h, i, 0, 0),
                 ),
             ],
-            scratch_shapes=[
-                pltpu.VMEM((block_q, LANES), jnp.float32),
-                pltpu.VMEM((block_q, LANES), jnp.float32),
-                pltpu.VMEM((block_q, D), jnp.float32),
-            ],
+            scratch_shapes=scratch_shapes,
         ),
-        out_shape=[
-            jax.ShapeDtypeStruct((H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((H, T // block_q, block_q, 1), jnp.float32),
-        ],
-        # blocks >= 2048 carry a [block_q, block_k] f32 score tile past the
-        # default scoped-vmem budget; raise it (v5e VMEM is 128 MB)
-        compiler_params=pltpu.CompilerParams(
-            **({"vmem_limit_bytes": 100 * 2**20} if block_q >= 2048 else {})
-        ),
+        out_shape=out_shape,
+        compiler_params=compiler_params,
         interpret=_interpret(),
     )(kstart, needs, seg2d, seg2d, q, k, v)
     return out, lse4.reshape(H, T)
@@ -393,34 +569,43 @@ def _flash_forward(
 def _recompute_p_ds(
     q_ref, k_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, do_ref, v_ref,
     iq, ik, *, scale, block_q, block_k, soft_cap, sliding_window,
-    masked: bool,
+    masked: bool, n_rep: int = 1,
 ):
     """Shared block math for both backward kernels: returns (p, ds_raw) with
     ds_raw = dL/d(q·kᵀ) BEFORE the `scale` factor (folded in by callers).
-    ``masked=False`` is the interior fast path: no mask construction."""
+    ``masked=False`` is the interior fast path: no mask construction.
+    With ``n_rep > 1`` the q-side refs carry the whole grouped head stack
+    ``[n_rep, block_q, ...]`` and everything runs rep-folded ``[rows, bk]``
+    (see ``_fwd_step``)."""
+    rows = n_rep * block_q
+    D = q_ref.shape[-1]
+    q2d = q_ref[...].reshape(rows, D)
     if soft_cap is not None:
         s_dot = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            q2d, k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         t = jnp.tanh(s_dot * (scale / soft_cap))
         s2 = (soft_cap * LOG2E) * t                # log2 domain
     else:
-        s2 = _scores_log2(q_ref, k_ref, scale, None)
+        s2 = _scores_log2(q2d, k_ref, scale, None)
     # residual lse is natural-log; clamp the log2 conversion so pad rows
     # (lse == NEG_INF) don't overflow to -inf and feed exp2 an inf argument
-    lse2 = jnp.maximum(lse_ref[0, 0] * LOG2E, NEG_INF)  # [bq, 1]
-    p = jnp.exp2(s2 - lse2)                        # [bq, bk]
+    lse2 = jnp.maximum(
+        lse_ref[...].reshape(rows, 1) * LOG2E, NEG_INF
+    )                                              # [rows, 1]
+    p = jnp.exp2(s2 - lse2)                        # [rows, bk]
     if masked:
         mask = _token_mask(
-            seg_q_ref, seg_k_ref, iq, ik, block_q, block_k, sliding_window
+            seg_q_ref, seg_k_ref, iq, ik, block_q, block_k, sliding_window,
+            n_rep,
         )
         p = jnp.where(mask, p, 0.0)
     dp = jax.lax.dot_general(
-        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+        do_ref[...].reshape(rows, D), v_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    )                                              # [bq, bk] f32
-    delta = delta_ref[0, 0]                        # [bq, 1]
+    )                                              # [rows, bk] f32
+    delta = delta_ref[...].reshape(rows, 1)        # [rows, 1]
     ds = p * (dp - delta)                          # dL/ds
     if soft_cap is not None:
         ds = ds * (1.0 - t * t)                    # through the tanh cap
@@ -428,75 +613,152 @@ def _recompute_p_ds(
 
 
 def _bwd_kernel(
-    qlast_ref,
+    kstart_ref,  # [nq] int32 scalar-prefetch (runtime segment/window start)
     needs_ref,
     seg_q_ref, seg_k_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
     dk_ref, dv_ref,
-    dq_ref,     # [n_rep, T, D] — one q-head group, written once per kv head
-    dk_scr,     # [block_k, D] f32
-    dv_scr,     # [block_k, D] f32
-    dq_scr,     # [n_rep, T, D] f32 — whole-group dq accumulator
+    dq_ref,     # [n_rep, block_q, D] — one q-head group's block
+    dk_scr,     # [T, D] f32 — whole-T accumulator, flushed per kv head
+    dv_scr,     # [T, D] f32
+    dq_scr,     # [n_rep*block_q, D] f32 — one q sweep's accumulator
     *,
     scale, block_q, block_k, nk_blocks, nq_blocks, soft_cap, sliding_window,
     specialize, n_rep,
 ):
-    # Fused flash backward, kv-stationary: grid (Hkv, nk, n_rep, nq) with nq
-    # innermost. The (hkv, ik) dk/dv blocks accumulate in VMEM scratch across
-    # the inner (r, jq) sweep; dq accumulates across the OUTER ik sweep in a
-    # whole-group [n_rep, T, D] f32 scratch (HBM read-modify-write through
-    # output aliasing is undefined across non-consecutive revisits, so the
-    # running dq must live in VMEM), flushed once per kv head. One (p, ds)
-    # recompute feeds all three gradients: 5 dots + 1 exp per block pair,
-    # vs 7 dots + 2 exps when dq and dk/dv ran as separate sweeps.
-    ik = pl.program_id(1)
-    ir = pl.program_id(2)
-    jq = pl.program_id(3)
-    nq = pl.num_programs(3)
-    nk = pl.num_programs(1)
-    iq = _first_q(ik, block_q, block_k) + jq
+    # Fused flash backward, Q-STATIONARY + rep-folded: grid (Hkv, nq, nk)
+    # with nk innermost; every step carries the WHOLE q-head group
+    # [n_rep, block_q, ...] folded to [n_rep*block_q, bk] (one dot set per
+    # group — see _fwd_step). dq accumulates across the inner ik sweep in a
+    # [rows, D] scratch and flushes into its (consecutively-revisited)
+    # output window at the end of each q sweep; dk/dv accumulate into
+    # WHOLE-T [T, D] f32 scratches (16.8 MB at 32k/D=64 — independent of
+    # n_rep, unlike the previous kv-stationary whole-group dq scratch whose
+    # rep-folded tiles blew the 128 MB VMEM budget) and flush once per kv
+    # head. One (p, ds) recompute feeds all three gradients: 5 dots + 1
+    # exp per group-block pair.
+    iq = pl.program_id(1)
+    j = pl.program_id(2)
+    nq = pl.num_programs(1)
+    nkb = pl.num_programs(2)
+    ik = kstart_ref[iq] + j
+    _bwd_step(
+        ik, iq,
+        j == 0,
+        (iq == 0) & (j == 0),
+        j == nkb - 1,
+        (iq == nq - 1) & (j == nkb - 1),
+        ik <= _last_k(iq, block_q, block_k),
+        needs_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, q_ref, k_ref,
+        v_ref, do_ref, dk_ref, dv_ref, dq_ref, dk_scr, dv_scr, dq_scr,
+        scale=scale, block_q=block_q, block_k=block_k, nk_blocks=nk_blocks,
+        nq_blocks=nq_blocks, soft_cap=soft_cap, sliding_window=sliding_window,
+        specialize=specialize, n_rep=n_rep,
+    )
 
-    @pl.when((ir == 0) & (jq == 0))
+
+def _bwd_step(
+    ik, iq, init_dq, init_kv, done_dq, done_kv, active,
+    needs_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, q_ref, k_ref,
+    v_ref, do_ref, dk_ref, dv_ref, dq_ref, dk_scr, dv_scr, dq_scr,
+    *, scale, block_q, block_k, nk_blocks, nq_blocks, soft_cap,
+    sliding_window, specialize, n_rep,
+):
+    """One fused-backward grid step (shared by band and triangle kernels);
+    q-side refs carry the whole rep group ``[n_rep, block_q, ...]``.
+    ``init_dq``/``done_dq`` bound one q block's k sweep; ``init_kv``/
+    ``done_kv`` bound one kv head's whole traversal."""
+    rows = n_rep * block_q
+    D = q_ref.shape[-1]
+
+    @pl.when(init_dq)
+    def _init_dq():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when(init_kv)
     def _init_kv():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
-
-    @pl.when((ik == 0) & (ir == 0) & (jq == 0))
-    def _init_dq():
-        dq_scr[...] = jnp.zeros_like(dq_scr)
 
     def _accum(masked: bool):
         p, ds = _recompute_p_ds(
             q_ref, k_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, do_ref,
             v_ref, iq, ik, scale=scale, block_q=block_q, block_k=block_k,
             soft_cap=soft_cap, sliding_window=sliding_window, masked=masked,
+            n_rep=n_rep,
         )
-        # dv += pᵀ @ do ; dk += dsᵀ @ q  (bf16 operands, f32 accumulate)
-        dv_scr[...] += jax.lax.dot_general(
-            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+        # dv += pᵀ @ do ; dk += dsᵀ @ q over the FOLDED rows — summing the
+        # group's per-head contributions inside the dot itself
+        col = jnp.minimum(ik, nk_blocks - 1) * block_k
+        dv_scr[pl.ds(col, block_k), :] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[...].reshape(rows, D),
+            (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        dk_scr[...] += jax.lax.dot_general(
-            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+        dk_scr[pl.ds(col, block_k), :] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[...].reshape(rows, D),
+            (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        row = jnp.minimum(iq, nq_blocks - 1) * block_q
-        dq_scr[ir, pl.ds(row, block_q), :] += jax.lax.dot_general(
+        dq_scr[...] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    active = iq <= qlast_ref[ik]
-    needs = needs_ref[jnp.minimum(iq, nq_blocks - 1) * nk_blocks + ik]
+    # clamp BOTH indices: the band wrapper's ik = kstart[iq]+j can pass
+    # nk_blocks for all-pad q blocks (inactive, but the scalar read must
+    # stay in bounds)
+    needs = needs_ref[
+        jnp.minimum(iq, nq_blocks - 1) * nk_blocks
+        + jnp.minimum(ik, nk_blocks - 1)
+    ]
     _dispatch_masked(active, specialize, needs, _accum)
 
-    @pl.when((ir == pl.num_programs(2) - 1) & (jq == nq - 1))
+    @pl.when(done_dq)
+    def _done_dq():
+        dq_ref[...] = (
+            (dq_scr[...] * scale).reshape(n_rep, block_q, D)
+        ).astype(dq_ref.dtype)
+
+    @pl.when(done_kv)
     def _done_kv():
         dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
-    @pl.when((ik == nk - 1) & (ir == pl.num_programs(2) - 1) & (jq == nq - 1))
-    def _done_dq():
-        dq_ref[...] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+def _bwd_kernel_tri(
+    kstart_ref,  # [nq] int32 scalar-prefetch (runtime segment/window start)
+    needs_ref,   # [nq*nk] int32 scalar-prefetch
+    iq_tab,      # [L] int32 STATIC: q-block of linear step l
+    ik_tab,      # [L] int32 STATIC: k-block of linear step l
+    first_tab,   # [L] int32 STATIC: 1 = first step of its q block's sweep
+    last_tab,    # [L] int32 STATIC: 1 = last step of its q block's sweep
+    seg_q_ref, seg_k_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
+    dk_ref, dv_ref, dq_ref, dk_scr, dv_scr, dq_scr,
+    *,
+    scale, block_q, block_k, nk_blocks, nq_blocks, soft_cap, sliding_window,
+    specialize, n_rep,
+):
+    """Triangle-enumerated fused backward: the linear grid dim walks only
+    the causally-possible (iq, ik) group pairs (the forward's static
+    tables) instead of the nq x nk rectangle (~half no-op steps at
+    full-causal long context). Runtime segment starts prune via
+    ``ik >= kstart[iq]``."""
+    l = pl.program_id(1)
+    L = pl.num_programs(1)
+    iq = iq_tab[l]
+    _bwd_step(
+        ik_tab[l], iq,
+        first_tab[l] == 1,
+        l == 0,
+        last_tab[l] == 1,
+        l == L - 1,
+        ik_tab[l] >= kstart_ref[iq],
+        needs_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, q_ref, k_ref,
+        v_ref, do_ref, dk_ref, dv_ref, dq_ref, dk_scr, dv_scr, dq_scr,
+        scale=scale, block_q=block_q, block_k=block_k, nk_blocks=nk_blocks,
+        nq_blocks=nq_blocks, soft_cap=soft_cap, sliding_window=sliding_window,
+        specialize=specialize, n_rep=n_rep,
+    )
 
 
 def _dq_kernel(
@@ -504,10 +766,14 @@ def _dq_kernel(
     needs_ref,
     seg_q_ref, seg_k_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
     dq_ref,
-    dq_scr,     # [block_q, D] f32
+    dq_scr,     # [n_rep*block_q, D] f32
     *,
     scale, block_q, block_k, nk_blocks, soft_cap, sliding_window, specialize,
+    n_rep,
 ):
+    # grid (Hkv, nq, k_band): reps folded into the q block (see _fwd_step)
+    rows = n_rep * block_q
+    D = q_ref.shape[-1]
     iq = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -522,6 +788,7 @@ def _dq_kernel(
             q_ref, k_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, do_ref,
             v_ref, iq, ik, scale=scale, block_q=block_q, block_k=block_k,
             soft_cap=soft_cap, sliding_window=sliding_window, masked=masked,
+            n_rep=n_rep,
         )
         dq_scr[...] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
@@ -534,7 +801,9 @@ def _dq_kernel(
 
     @pl.when(j == nk - 1)
     def _done():
-        dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+        dq_ref[...] = (
+            (dq_scr[...] * scale).reshape(n_rep, block_q, D)
+        ).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(
@@ -548,15 +817,17 @@ def _dkv_kernel(
     scale, block_q, block_k, nk_blocks, nq_blocks, soft_cap, sliding_window,
     specialize, n_rep,
 ):
-    # grid: (Hkv, nk, n_rep, nq) — nq innermost; the (hkv, nk) output block
-    # stays resident while every grouped q head and q block accumulates.
+    # grid: (Hkv, nk, nq) — nq innermost, reps folded into the q block;
+    # the (hkv, nk) output block stays resident while every q block of the
+    # whole head group accumulates.
+    rows = n_rep * block_q
+    D = q_ref.shape[-1]
     ik = pl.program_id(1)
-    ir = pl.program_id(2)
-    jq = pl.program_id(3)
-    nq = pl.num_programs(3)
+    jq = pl.program_id(2)
+    nq = pl.num_programs(2)
     iq = _first_q(ik, block_q, block_k) + jq
 
-    @pl.when((ir == 0) & (jq == 0))
+    @pl.when(jq == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
@@ -566,14 +837,18 @@ def _dkv_kernel(
             q_ref, k_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, do_ref,
             v_ref, iq, ik, scale=scale, block_q=block_q, block_k=block_k,
             soft_cap=soft_cap, sliding_window=sliding_window, masked=masked,
+            n_rep=n_rep,
         )
-        # dv += pᵀ @ do ; dk += dsᵀ @ q  (bf16 operands, f32 accumulate)
+        # dv += pᵀ @ do ; dk += dsᵀ @ q over the folded rows (bf16
+        # operands, f32 accumulate) — the group's heads sum inside the dot
         dv_scr[...] += jax.lax.dot_general(
-            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            p.astype(do_ref.dtype), do_ref[...].reshape(rows, D),
+            (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dk_scr[...] += jax.lax.dot_general(
-            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[...].reshape(rows, D),
+            (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -581,7 +856,7 @@ def _dkv_kernel(
     needs = needs_ref[jnp.minimum(iq, nq_blocks - 1) * nk_blocks + ik]
     _dispatch_masked(active, specialize, needs, _accum)
 
-    @pl.when((ir == pl.num_programs(2) - 1) & (jq == nq - 1))
+    @pl.when(jq == nq - 1)
     def _done():
         dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
@@ -597,6 +872,12 @@ def _flash_backward(
     n_rep = H // Hkv
     block_q = min(block_q, T)
     block_k = min(block_k, T)
+    # rep folding multiplies the q-side tile rows by n_rep: cap the folded
+    # [n_rep*block_q, block_k] f32 score/ds tiles so the fused kernel's
+    # VMEM (tiles + whole-group dq scratch + double-buffered dq output
+    # window) stays inside the 128 MB budget at 32k context
+    while n_rep * block_q > 2048 and block_q > 512:
+        block_q //= 2
     seg2d = segment_ids.reshape(1, T)
     # delta_i = rowsum(do * out) — cheap elementwise reduce, stays in XLA
     delta = jnp.sum(
@@ -617,45 +898,110 @@ def _flash_backward(
         sliding_window=sliding_window, specialize=T >= SPECIALIZE_MIN_T,
     )
 
-    def dkv_qi(ql, j, i):
-        # clip: qlast can be -1 (all-pad k block); the step is inactive then
-        return jnp.clip(
-            _first_q(j, block_q, block_k) + i, 0, (T // block_q) - 1
+    # Fused q-stationary backward: dq flushes per q sweep into its
+    # (consecutively-revisited) output window; dk/dv accumulate in WHOLE-T
+    # [T, D] f32 scratches (n_rep-independent) flushed once per kv head.
+    # Fall back to separate dq/dkv sweeps only when the whole-T scratch
+    # itself won't fit VMEM (extreme context lengths).
+    dkv_scr_bytes = 2 * T * D * 4
+    if dkv_scr_bytes <= FUSED_BWD_MAX_DQ_BYTES:
+        # estimated scoped need: whole-T dk/dv scratch + the rep-folded
+        # f32 score/ds tiles (x4: s2, p, ds + slack). Leave the compiler's
+        # default budget alone for small shapes (raising it measurably
+        # hurt short-context throughput).
+        # raise only when the default 16 MB budget cannot fit (raising it
+        # when unnecessary measurably hurt short-context throughput —
+        # ~7% on the 1B/512-packed shape, chip-measured r3+r4)
+        est = dkv_scr_bytes + 4 * n_rep * block_q * block_k * 4
+        limit = est + 40 * 2**20 if est > 14 * 2**20 else None
+        out_shapes = [
+            jax.ShapeDtypeStruct((Hkv, T, D), k.dtype),
+            jax.ShapeDtypeStruct((Hkv, T, D), v.dtype),
+            jax.ShapeDtypeStruct((H, T, D), q.dtype),
+        ]
+        scratch_shapes = [
+            pltpu.VMEM((T, D), jnp.float32),
+            pltpu.VMEM((T, D), jnp.float32),
+            pltpu.VMEM((n_rep * block_q, D), jnp.float32),
+        ]
+        kv_whole = pl.BlockSpec(
+            (1, T, D), lambda *idx: (idx[0], 0, 0)
         )
+        if max_seqlen is None:
+            # no static band: walk only the causal triangle's (iq, ik)
+            # group pairs — the forward's own static tables
+            iq_t, ik_t, first_t, last_t = _tri_tables(
+                T // block_q, T // block_k, block_q, block_k
+            )
 
-    def qi3(h, j, r, i, ql, nm, nr=n_rep):
-        return (h * nr + r, dkv_qi(ql, j, i), 0)
+            def t_kv(h, l, ks, nm, iqt, ikt, ft, lt):
+                return (h, ikt[l], 0)
 
-    def qi4(h, j, r, i, ql, nm, nr=n_rep):
-        return (h * nr + r, dkv_qi(ql, j, i), 0, 0)
+            def t_q3(h, l, ks, nm, iqt, ikt, ft, lt):
+                return (h, iqt[l], 0)
 
-    kv_spec = pl.BlockSpec((1, block_k, D), lambda h, j, r, i, ql, nm: (h, j, 0))
-    group_in_specs = [
-        pl.BlockSpec(
-            (1, block_q),
-            lambda h, j, r, i, ql, nm: (0, dkv_qi(ql, j, i)),
-        ),
-        pl.BlockSpec((1, block_k), lambda h, j, r, i, ql, nm: (0, j)),
-        pl.BlockSpec((1, 1, block_q, 1), qi4),
-        pl.BlockSpec((1, 1, block_q, 1), qi4),
-        pl.BlockSpec((1, block_q, D), qi3),
-        kv_spec,
-        kv_spec,
-        pl.BlockSpec((1, block_q, D), qi3),
-    ]
+            def t_q4(h, l, ks, nm, iqt, ikt, ft, lt):
+                return (h, iqt[l], 0, 0)
 
+            dk, dv, dq = pl.pallas_call(
+                functools.partial(
+                    _bwd_kernel_tri, **common, nq_blocks=T // block_q,
+                    n_rep=n_rep,
+                ),
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=6,
+                    grid=(Hkv, len(iq_t)),
+                    in_specs=[
+                        pl.BlockSpec(
+                            (1, block_q),
+                            lambda h, l, ks, nm, iqt, ikt, ft, lt:
+                                (0, iqt[l]),
+                        ),
+                        pl.BlockSpec(
+                            (1, block_k),
+                            lambda h, l, ks, nm, iqt, ikt, ft, lt:
+                                (0, ikt[l]),
+                        ),
+                        pl.BlockSpec((n_rep, 1, block_q, 1), t_q4),
+                        pl.BlockSpec((n_rep, 1, block_q, 1), t_q4),
+                        pl.BlockSpec((n_rep, block_q, D), t_q3),
+                        pl.BlockSpec((1, block_k, D), t_kv),
+                        pl.BlockSpec((1, block_k, D), t_kv),
+                        pl.BlockSpec((n_rep, block_q, D), t_q3),
+                    ],
+                    out_specs=[
+                        kv_whole,
+                        kv_whole,
+                        pl.BlockSpec((n_rep, block_q, D), t_q3),
+                    ],
+                    scratch_shapes=scratch_shapes,
+                ),
+                out_shape=out_shapes,
+                compiler_params=pltpu.CompilerParams(
+                    dimension_semantics=("parallel", "arbitrary"),
+                    **({"vmem_limit_bytes": limit} if limit else {}),
+                ),
+                interpret=_interpret(),
+            )(
+                kstart, needs, jnp.asarray(iq_t), jnp.asarray(ik_t),
+                jnp.asarray(first_t), jnp.asarray(last_t),
+                seg2d, seg2d, lse4, delta4, q, k, v, do,
+            )
+            return dq, dk, dv
 
-    # Whole-group dq scratch [n_rep, T, D] f32 + its output block; fall back
-    # to separate dq/dkv sweeps when that won't fit VMEM (very long context
-    # or large head groups).
-    dq_scr_bytes = n_rep * T * D * 4
-    dq_out_bytes = n_rep * T * D * q.dtype.itemsize
-    if dq_scr_bytes + dq_out_bytes <= FUSED_BWD_MAX_DQ_BYTES:
-        limit = None
-        if dq_scr_bytes + dq_out_bytes > 8 * 2**20:
-            # leave the compiler's default scoped budget alone for small
-            # shapes (raising it measurably hurt short-context throughput)
-            limit = dq_scr_bytes + dq_out_bytes + 78 * 2**20
+        def b_kv(h, i, j, ks, nm):
+            return (
+                h,
+                jnp.minimum(ks[i] + j, _last_k(i, block_q, block_k)),
+                0,
+            )
+
+        def b_q3(h, i, j, ks, nm):
+            return (h, i, 0)
+
+        def b_q4(h, i, j, ks, nm):
+            return (h, i, 0, 0)
+
         dk, dv, dq = pl.pallas_call(
             functools.partial(
                 _bwd_kernel, **common, nq_blocks=T // block_q, n_rep=n_rep
@@ -663,51 +1009,56 @@ def _flash_backward(
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=2,
                 grid=(
-                    Hkv, T // block_k, n_rep,
-                    _q_band_blocks(block_q, block_k, max_seqlen, T),
+                    Hkv, T // block_q,
+                    _k_band_blocks(block_q, block_k, max_seqlen, T),
                 ),
-                in_specs=group_in_specs,
-                out_specs=[
-                    kv_spec,
-                    kv_spec,
+                in_specs=[
+                    pl.BlockSpec((1, block_q), lambda h, i, j, ks, nm: (0, i)),
                     pl.BlockSpec(
-                        (n_rep, T, D), lambda h, j, r, i, ql, nm: (h, 0, 0)
+                        (1, block_k),
+                        lambda h, i, j, ks, nm: (
+                            0,
+                            jnp.minimum(
+                                ks[i] + j, _last_k(i, block_q, block_k)
+                            ),
+                        ),
                     ),
+                    pl.BlockSpec((n_rep, 1, block_q, 1), b_q4),
+                    pl.BlockSpec((n_rep, 1, block_q, 1), b_q4),
+                    pl.BlockSpec((n_rep, block_q, D), b_q3),
+                    pl.BlockSpec((1, block_k, D), b_kv),
+                    pl.BlockSpec((1, block_k, D), b_kv),
+                    pl.BlockSpec((n_rep, block_q, D), b_q3),
                 ],
-                scratch_shapes=[
-                    pltpu.VMEM((block_k, D), jnp.float32),
-                    pltpu.VMEM((block_k, D), jnp.float32),
-                    pltpu.VMEM((n_rep, T, D), jnp.float32),
+                out_specs=[
+                    kv_whole,
+                    kv_whole,
+                    pl.BlockSpec((n_rep, block_q, D), b_q3),
                 ],
+                scratch_shapes=scratch_shapes,
             ),
-            out_shape=[
-                jax.ShapeDtypeStruct((Hkv, T, D), k.dtype),
-                jax.ShapeDtypeStruct((Hkv, T, D), v.dtype),
-                jax.ShapeDtypeStruct((H, T, D), q.dtype),
-            ],
+            out_shape=out_shapes,
             compiler_params=pltpu.CompilerParams(
-                dimension_semantics=(
-                    "parallel", "arbitrary", "arbitrary", "arbitrary"
-                ),
+                dimension_semantics=("parallel", "arbitrary", "arbitrary"),
                 **({"vmem_limit_bytes": limit} if limit else {}),
             ),
             interpret=_interpret(),
-        )(qlast, needs, seg2d, seg2d, lse4, delta4, q, k, v, do)
+        )(kstart, needs, seg2d, seg2d, lse4, delta4, q, k, v, do)
         return dq, dk, dv
 
-    def dq_kj(h, i, j, ks, nm, r=n_rep):
+    def dq_kj(h, i, j, ks, nm):
         return (
-            h // r,
+            h,
             jnp.minimum(ks[i] + j, _last_k(i, block_q, block_k)),
             0,
         )
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, **common),
+        functools.partial(_dq_kernel, **common, n_rep=n_rep),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(
-                H, T // block_q,
+                Hkv, T // block_q,
                 _k_band_blocks(block_q, block_k, max_seqlen, T),
             ),
             in_specs=[
@@ -720,30 +1071,63 @@ def _flash_backward(
                     ),
                 ),
                 pl.BlockSpec(
-                    (1, 1, block_q, 1), lambda h, i, j, ks, nm: (h, i, 0, 0)
+                    (n_rep, 1, block_q, 1), lambda h, i, j, ks, nm: (h, i, 0, 0)
                 ),
                 pl.BlockSpec(
-                    (1, 1, block_q, 1), lambda h, i, j, ks, nm: (h, i, 0, 0)
+                    (n_rep, 1, block_q, 1), lambda h, i, j, ks, nm: (h, i, 0, 0)
                 ),
-                pl.BlockSpec((1, block_q, D), lambda h, i, j, ks, nm: (h, i, 0)),
+                pl.BlockSpec(
+                    (n_rep, block_q, D), lambda h, i, j, ks, nm: (h, i, 0)
+                ),
                 pl.BlockSpec((1, block_k, D), dq_kj),
                 pl.BlockSpec((1, block_k, D), dq_kj),
-                pl.BlockSpec((1, block_q, D), lambda h, i, j, ks, nm: (h, i, 0)),
+                pl.BlockSpec(
+                    (n_rep, block_q, D), lambda h, i, j, ks, nm: (h, i, 0)
+                ),
             ],
             out_specs=pl.BlockSpec(
-                (1, block_q, D), lambda h, i, j, ks, nm: (h, i, 0)
+                (n_rep, block_q, D), lambda h, i, j, ks, nm: (h, i, 0)
             ),
-            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+            scratch_shapes=[
+                pltpu.VMEM((n_rep * block_q, D), jnp.float32)
+            ],
         ),
         out_shape=jax.ShapeDtypeStruct((H, T, D), q.dtype),
         # split-backward p/ds tiles need the same scoped-vmem raise as the
-        # forward at block sizes >= 2048
+        # forward at big (rep-folded) blocks
         compiler_params=pltpu.CompilerParams(
-            **({"vmem_limit_bytes": 100 * 2**20} if block_q >= 2048 else {})
+            **({"vmem_limit_bytes": 100 * 2**20}
+               if n_rep * block_q >= 2048 else {})
         ),
         interpret=_interpret(),
     )(kstart, needs, seg2d, seg2d, lse4, delta4, q, k, v, do)
 
+    def dkv_qi(ql, j, i):
+        # clip: qlast can be -1 (all-pad k block); the step is inactive then
+        return jnp.clip(
+            _first_q(j, block_q, block_k) + i, 0, (T // block_q) - 1
+        )
+
+    def qi3(h, j, i, ql, nm):
+        return (h, dkv_qi(ql, j, i), 0)
+
+    def qi4(h, j, i, ql, nm):
+        return (h, dkv_qi(ql, j, i), 0, 0)
+
+    kv_spec = pl.BlockSpec((1, block_k, D), lambda h, j, i, ql, nm: (h, j, 0))
+    group_in_specs = [
+        pl.BlockSpec(
+            (1, block_q),
+            lambda h, j, i, ql, nm: (0, dkv_qi(ql, j, i)),
+        ),
+        pl.BlockSpec((1, block_k), lambda h, j, i, ql, nm: (0, j)),
+        pl.BlockSpec((n_rep, 1, block_q, 1), qi4),
+        pl.BlockSpec((n_rep, 1, block_q, 1), qi4),
+        pl.BlockSpec((n_rep, block_q, D), qi3),
+        kv_spec,
+        kv_spec,
+        pl.BlockSpec((n_rep, block_q, D), qi3),
+    ]
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, **common, nq_blocks=T // block_q, n_rep=n_rep
@@ -751,7 +1135,7 @@ def _flash_backward(
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(
-                Hkv, T // block_k, n_rep,
+                Hkv, T // block_k,
                 _q_band_blocks(block_q, block_k, max_seqlen, T),
             ),
             in_specs=group_in_specs,
@@ -766,7 +1150,8 @@ def _flash_backward(
             jax.ShapeDtypeStruct((Hkv, T, D), v.dtype),
         ],
         compiler_params=pltpu.CompilerParams(
-            **({"vmem_limit_bytes": 100 * 2**20} if block_k >= 2048 else {})
+            **({"vmem_limit_bytes": 100 * 2**20}
+               if block_k >= 2048 or n_rep * block_q >= 2048 else {})
         ),
         interpret=_interpret(),
     )(qlast, needs, seg2d, seg2d, lse4, delta4, q, k, v, do)
@@ -830,6 +1215,7 @@ def packed_flash_attention(
     soft_cap: Optional[float] = None,
     sliding_window: Optional[int] = None,
     block_size: int = 512,
+    block_size_k: Optional[int] = None,
     max_seqlen: Optional[int] = None,
 ) -> jnp.ndarray:
     """Causal packed-varlen flash attention. q ``[T, H, D]``, k/v
@@ -864,5 +1250,5 @@ def packed_flash_attention(
         jax.debug.callback(_check, seg_max)
     return _flash_thd(
         q, k, v, segment_ids.astype(jnp.int32), softmax_scale, soft_cap,
-        sliding_window, block_size, block_size, max_seqlen,
+        sliding_window, block_size, block_size_k or block_size, max_seqlen,
     )

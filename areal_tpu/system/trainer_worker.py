@@ -211,6 +211,12 @@ class AsyncPPOTrainerWorker:
                 self.train_batch_size, current_version=self.actor_engine.version
             )
             if multihost.allreduce_min(np.int64(bool(batch))):
+                # groups consumed this step — the staleness gate's unit
+                # (the manager's running/trained counters are per rollout
+                # TASK, i.e. per prompt group, not per sequence; bumping
+                # with sequence counts made expected_version advance
+                # group_size x too fast and over-tightened the gate)
+                self._last_batch_groups = len(batch)
                 break
             # some host's queue was entirely over-stale: put ours back
             # (re-checked against the window) and refill together
@@ -259,7 +265,9 @@ class AsyncPPOTrainerWorker:
             len(inner) for inner in sample.seqlens[sample.main_key()]
         )
         stats.update(self._hbm.check())
-        self._bump_training_samples(int(stats["n_seqs_consumed"]))
+        self._bump_training_samples(
+            int(getattr(self, "_last_batch_groups", 0))
+        )
         self.step += 1
 
         if self.step % self.control.weight_sync_freq_steps == 0:

@@ -220,12 +220,23 @@ def _bench_gen_32k(peak_bw: float):
         page_size=128, enable_prefix_cache=False, admit_chunk_tokens=2048,
     )
     rng = np.random.default_rng(0)
-    for i in range(B):
-        eng.submit(GenRequest(
-            rid=f"r{i}",
-            input_ids=[int(x) for x in rng.integers(1, 50000, PLEN)],
-            max_new_tokens=1024, temperature=1.0,
-        ))
+
+    def submit_all(r):
+        for i in range(B):
+            eng.submit(GenRequest(
+                rid=f"{r}_{i}",
+                input_ids=[int(x) for x in rng.integers(1, 50000, PLEN)],
+                max_new_tokens=1024, temperature=1.0,
+            ))
+
+    # warm the admission programs (one extend per width bucket + the
+    # skip-pool first-wave variant compile in ~a minute at this depth;
+    # timing them as "prefill" would report compile time as throughput)
+    submit_all(0)
+    eng.step(decode_steps=1)
+    eng.pause(); eng.resume()           # release pages, keep programs
+
+    submit_all(1)
     t0 = time.perf_counter()
     eng.step(decode_steps=1)            # chunked prefill of 4 x 31.5k
     t_prefill = time.perf_counter() - t0
@@ -437,7 +448,11 @@ def _bench_system_ppo():
             "rollout.n_workers=1",
             f"rollout.max_concurrent_tasks={N_PROMPTS * GROUP}",
             f"rollout.new_tokens_per_chunk={MAX_NEW}",
-            "manager.max_head_offpolicyness=100",
+            # a REALISTIC staleness budget: with the gate wide open the
+            # fleet burns its capacity generating samples whole versions
+            # ahead that the buffer then drops as stale (measured: a tiny
+            # smoke world served 398x what training consumed)
+            "manager.max_head_offpolicyness=4",
             f'gconfig={{"n": {GROUP}, "max_new_tokens": {MAX_NEW}}}',
             'ppo={"ppo_n_minibatches": 1, "disable_value": true,'
             ' "group_adv_norm": true, "adv_norm": false,'
@@ -459,7 +474,7 @@ def _bench_system_ppo():
         n_samples = sum(l["ppo/n_seqs_consumed"] for l in lines[1:])
         gen_tokens = sum(l.get("ppo/n_tokens", 0) for l in lines[1:]) \
             - PLEN * n_samples  # generated tokens only
-        return {
+        out = {
             "reward_samples_per_sec": round(n_samples / steady_s, 3),
             "steady_seconds": round(steady_s, 2),
             "steps_timed": len(lines) - 1,
@@ -467,6 +482,22 @@ def _bench_system_ppo():
             "wall_seconds": round(wall, 2),
             "world": "gen_server+manager+rollout+trainer (processes)",
         }
+        # the gen server dumps its phase accounting at shutdown — where the
+        # serving side's wall time went (step-loop busy vs weight swaps vs
+        # idle) and how many in-flight rollouts the weight syncs interrupted
+        gsm = os.path.join(tmp, "root", "logs", "sysbench", "t0",
+                           "gen_server_0.json")
+        if os.path.exists(gsm):
+            with open(gsm) as f:
+                g = _json.load(f)
+            out["gen_server"] = {
+                k: g[k] for k in (
+                    "uptime_s", "step_busy_s", "weight_update_s",
+                    "n_weight_updates", "n_interrupted", "served",
+                    "gen_tokens", "engine_prefill_tokens",
+                ) if k in g
+            }
+        return out
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -512,13 +543,15 @@ def main():
     peak_bw = float(os.environ.get("BENCH_PEAK_BW", 819e9))  # v5e HBM B/s
     cfg_8k = dataclasses.replace(cfg_small, attn_max_seqlen=None)
     # ctx32k = the 32k-context protocol shape (benchmark README): one long
-    # sequence through the flash kernels; matmul-saving remat + unrolled
-    # layers (the scan's carry bookkeeping costs ~4% at 32k). Chunked
-    # cross-entropy (cfg.loss_chunk_size) is available for models whose
-    # [T, vocab] logits don't fit — speed-neutral at this size, so the
-    # bench keeps the dense loss.
+    # sequence through the flash kernels; unrolled layers (the scan's carry
+    # bookkeeping costs ~4% at 32k). This 125M shape FITS without remat at
+    # 32k (chip-measured r4: none=0.435 vs dots_attn=0.420 MFU — the
+    # dots_attn recompute of projections/MLP costs ~1 fwd of matmuls);
+    # bigger models keep remat_policy="dots_attn". Chunked cross-entropy
+    # (cfg.loss_chunk_size) is available for models whose [T, vocab]
+    # logits don't fit — measured slightly slower here, so dense loss.
     cfg_32k = dataclasses.replace(
-        cfg_small, remat_policy="dots_attn", layer_scan_unroll=12,
+        cfg_small, remat_policy="none", layer_scan_unroll=12,
         attn_max_seqlen=None,
     )
     for name, fn in (
