@@ -874,10 +874,13 @@ def _flash_backward(
     block_k = min(block_k, T)
     # rep folding multiplies the q-side tile rows by n_rep: cap the folded
     # [n_rep*block_q, block_k] f32 score/ds tiles so the fused kernel's
-    # VMEM (tiles + whole-group dq scratch + double-buffered dq output
-    # window) stays inside the 128 MB budget at 32k context
+    # VMEM (tiles + whole-T dk/dv scratch) stays inside the 128 MB budget
+    # (block_k-aware like the forward's cap: n_rep=8 x 2048 blocks would
+    # otherwise request ~190 MB)
     while n_rep * block_q > 2048 and block_q > 512:
         block_q //= 2
+    while 2 * n_rep * block_q * block_k * 4 > 64 * 2**20 and block_k > 512:
+        block_k //= 2
     seg2d = segment_ids.reshape(1, T)
     # delta_i = rowsum(do * out) — cheap elementwise reduce, stays in XLA
     delta = jnp.sum(
@@ -913,7 +916,10 @@ def _flash_backward(
         # when unnecessary measurably hurt short-context throughput —
         # ~7% on the 1B/512-packed shape, chip-measured r3+r4)
         est = dkv_scr_bytes + 4 * n_rep * block_q * block_k * 4
-        limit = est + 40 * 2**20 if est > 14 * 2**20 else None
+        limit = (
+            min(est + 40 * 2**20, 114 * 2**20)  # 114 MB = max scoped limit
+            if est > 14 * 2**20 else None
+        )
         out_shapes = [
             jax.ShapeDtypeStruct((Hkv, T, D), k.dtype),
             jax.ShapeDtypeStruct((Hkv, T, D), v.dtype),

@@ -29,8 +29,11 @@ Deliberate divergences (documented; see tests/data/math_parity.json):
 - ``{a, b}`` set answers compare UNORDERED here (mathematically correct;
   the reference's brace-stripped string/symbolic path is order-sensitive
   except when sympify happens to build a set).
-- latex2sympy's full grammar (integrals, sums, \\operatorname) is out of
-  scope — the remote sandbox verifier covers those in production.
+- latex2sympy grammar coverage (r4): \\operatorname, named trig/log/exp
+  functions, \\log bases, \\binom, \\left/\\right + styling macros,
+  single-pair |x|, and answer-position \\sum/\\int forms translate;
+  exotic constructs beyond that still fall to the remote sandbox
+  verifier in production.
 """
 
 import re
@@ -72,6 +75,12 @@ def extract_answer(text: str, use_last_number: bool = True) -> Optional[str]:
     ``use_last_number=False`` (``process_results`` semantics)."""
     if "final answer is $" in text and "$. I hope" in text:
         ans = text.split("final answer is $", 1)[1].split("$. I hope", 1)[0]
+        # models often box the answer INSIDE the hope-pattern span; unwrap
+        # so downstream equality sees the payload, not the \boxed marker
+        if "\\boxed" in ans:
+            boxed = extract_boxed(ans)
+            if boxed is not None:
+                ans = boxed
         return _strip_answer_token(ans.strip())
     boxed = extract_boxed(text)
     if boxed is not None:
@@ -221,8 +230,19 @@ def _normalize(s: str) -> str:
 
 def _latex_to_expr(s: str) -> str:
     """Targeted LaTeX -> python-expression rewrites (the working set of
-    ``math_parser.py``'s latex2sympy usage, without the vendored parser)."""
+    ``math_parser.py``'s latex2sympy usage, without the vendored parser;
+    extended r4 toward latex2sympy's grammar: \\operatorname, named
+    functions, \\log bases, \\binom, |x|, \\sum and \\int forms)."""
     s = _normalize(s)
+    # delimiter/styling macros latex2sympy ignores
+    s = (
+        s.replace("\\left", "").replace("\\right", "")
+        .replace("\\dfrac", "\\frac").replace("\\tfrac", "\\frac")
+        .replace("\\limits", "").replace("\\displaystyle", "")
+        .replace("\\,", "").replace("\\!", "").replace("\\;", "")
+    )
+    # \operatorname{f} -> f (latex2sympy treats it as a plain function name)
+    s = re.sub(r"\\operatorname\*?\{([A-Za-z]+)\}", r"\1", s)
     # mixed numbers: 1\frac{1}{2} -> (1+(1)/(2))
     s = re.sub(
         r"(?<![\w}])(\d+)\\frac\{([^{}]+)\}\{([^{}]+)\}",
@@ -248,6 +268,59 @@ def _latex_to_expr(s: str) -> str:
         .replace("\\div", "/")
         .replace("\\infty", "oo")
     )
+    # \binom{n}{k} -> binomial(n, k)
+    s = re.sub(r"\\binom\{([^{}]*)\}\{([^{}]*)\}", r"binomial(\1, \2)", s)
+    # logs: \log_{b} x / \log_b x -> base-b; \log -> base 10 (latex2sympy's
+    # convention); \ln -> natural
+    s = re.sub(
+        r"\\log_\{?(\w+)\}?\s*\(?\{?([\w.]+)\}?\)?",
+        r"(log(\2)/log(\1))", s,
+    )
+    s = s.replace("\\ln", "log")
+    s = re.sub(r"\\log\b", "log10", s)
+    # named functions: \sin x -> sin(x) handled by implicit application
+    s = re.sub(
+        r"\\(sin|cos|tan|cot|sec|csc|arcsin|arccos|arctan|sinh|cosh|tanh|"
+        r"exp|min|max|gcd|lcm)\b",
+        r"\1", s,
+    )
+    # sums / integrals as ANSWERS (rare but latex2sympy-grammar): the rest
+    # of the string is the summand/integrand. LITERAL bounds only, sum span
+    # capped — a model-controlled \sum_{i=1}^{10^9} (or symbolic bounds)
+    # must not hand sympy unbounded work inside the reward worker (the
+    # same DoS class _degenerate guards for powers).
+    def _sum_repl(m):
+        var, lo, hi, body = m.groups()
+        try:
+            span = float(hi) - float(lo)
+        except ValueError:
+            return m.group(0)  # non-literal bounds: leave untranslated
+        if not 0 <= span <= 500:
+            return m.group(0)
+        return f"Sum({body}, ({var}, {lo}, {hi}))"
+
+    s = re.sub(
+        r"\\sum_\{(\w+)=([^{}]+)\}\^\{([^{}]+)\}\s*(.+)", _sum_repl, s
+    )
+
+    def _int_repl(m):
+        lo, hi, body, var = m.groups()
+        for b in (lo, hi):
+            if not re.fullmatch(r"-?\d+(\.\d+)?|-?\\?pi|oo", b.strip()):
+                return m.group(0)  # non-literal bounds: leave untranslated
+        return f"Integral({body}, ({var}, {lo}, {hi}))"
+
+    s = re.sub(
+        r"\\int_\{?([^{}^]+)\}?\^\{?([^{}]+)\}?\s*(.+?)\\?d([a-z])\s*$",
+        _int_repl, s,
+    )
+    # |x| -> Abs(x) when exactly one pair (brace-stripped: `|{-3}|`)
+    if s.count("|") == 2:
+        s = re.sub(
+            r"\|([^|]*)\|",
+            lambda m: f"Abs({m.group(1).replace('{', '(').replace('}', ')')})",
+            s,
+        )
     # exponents: ^{...} -> **(...); ^x -> **x
     s = re.sub(r"\^\{([^{}]*)\}", r"**(\1)", s)
     s = s.replace("^", "**")
@@ -378,8 +451,39 @@ def _sympy_equal(a: str, b: str) -> bool:
         if _degenerate(xa) or _degenerate(xb):
             return False
         tf = standard_transformations + (implicit_multiplication_application,)
-        ea = parse_expr(xa, transformations=tf)
-        eb = parse_expr(xb, transformations=tf)
+        env = {
+            "log10": sympy.Lambda(
+                sympy.Symbol("_x"), sympy.log(sympy.Symbol("_x"), 10)
+            ),
+            "Sum": sympy.Sum, "Integral": sympy.Integral,
+            "Abs": sympy.Abs, "binomial": sympy.binomial,
+            # latex2sympy maps a bare `e` to Euler's number
+            "e": sympy.E,
+        }
+        ea = parse_expr(xa, transformations=tf, local_dict=env)
+        eb = parse_expr(xb, transformations=tf, local_dict=env)
+        if ea.has(sympy.Sum, sympy.Integral) or eb.has(
+            sympy.Sum, sympy.Integral
+        ):
+            # NUMERIC-only for Sum/Integral: symbolic simplify/doit — and
+            # even Sum.evalf — on a model-controlled summand can run
+            # unboundedly (measured: 200 terms of \sin(i^2) stall >140 s).
+            # Sums expand by explicit term loop (bounded by the literal-
+            # span cap in _latex_to_expr); integrals get quadrature.
+            def _num(e):
+                for s_ in list(e.atoms(sympy.Sum)):
+                    f = s_.function
+                    v, lo, hi = s_.limits[0]
+                    tot = sum(
+                        complex(f.subs(v, i).evalf())
+                        for i in range(int(lo), int(hi) + 1)
+                    )
+                    e = e.subs(s_, sympy.sympify(tot))
+                return e.evalf()
+
+            diff = _num(ea) - _num(eb)
+            diff = diff.evalf() if hasattr(diff, "evalf") else diff
+            return abs(complex(diff)) < 1e-6
         if bool(sympy.simplify(ea - eb) == 0):
             return True
         # numeric fallback: symbolic simplify can miss radical identities

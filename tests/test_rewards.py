@@ -105,6 +105,28 @@ def test_answers_equal_review_regressions(a, b, eq):
     assert math_verify.answers_equal(a, b) == eq
 
 
+@pytest.mark.parametrize("a,b,eq", [
+    # latex2sympy-grammar extensions (VERDICT r3 missing #4): functions,
+    # \operatorname, log bases, \binom, delimiters, sums/integrals, |x|
+    (r"\sin(\pi/6)", "1/2", True),
+    (r"\cos(\pi)", "-1", True),
+    (r"\operatorname{lcm}(4,6)", "12", True),
+    (r"\log_2 8", "3", True),
+    (r"\ln(e^2)", "2", True),
+    (r"\binom{5}{2}", "10", True),
+    (r"\left(\frac{1}{2}\right)", "0.5", True),
+    (r"\dfrac{3}{4}", "0.75", True),
+    (r"\sum_{i=1}^{10} i", "55", True),
+    (r"\int_{0}^{1} 2x dx", "1", True),
+    (r"|{-3}|", "3", True),
+    (r"\sin(\pi/6)", "1/3", False),
+    (r"\log_2 8", "4", False),
+    (r"\sum_{i=1}^{10} i", "54", False),
+])
+def test_answers_equal_latex2sympy_grammar(a, b, eq):
+    assert math_verify.answers_equal(a, b) == eq, (a, b)
+
+
 def test_degenerate_power_is_fast():
     """Model-controlled giant exponents must not stall the reward worker."""
     import time
