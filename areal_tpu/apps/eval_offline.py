@@ -67,21 +67,72 @@ def majority_score(answers: List[str], scores: List[float], k: int) -> float:
     return float(scores[best[1][0]] > 0)
 
 
-def grade_answers(qid: str, answers: List[str], metadata: dict) -> List[float]:
-    """Task-dispatching grader: math via the parity verifier, code via the
-    subprocess test runner (the reference's functioncall/code path)."""
+def grade_answers(
+    qid: str, answers: List[str], metadata: dict, pool=None
+) -> List[float]:
+    """Task-dispatching grader: math via the parity verifier, gpqa via
+    boxed-choice-letter equality, code via the subprocess test runner (the
+    reference's functioncall/code path). With ``pool`` (a
+    ``evaluation.grading.PoolGrader``) each comparison runs in a killable
+    worker process under a deadline — the reference's pebble-pool protocol
+    (``evaluation/evaluate.py:44-60``)."""
     task = metadata.get("task", "math")
-    if task == "code":
-        from areal_tpu.rewards.code_verify import verify_code_solution
+    gold = (
+        metadata.get("input_output", {}) if task == "code"
+        else metadata.get("solutions", [])
+    )
+    items = [(task, a, gold) for a in answers]
+    if pool is not None:
+        return pool.grade(items)
+    from areal_tpu.evaluation.grading import _default_grade_one
 
-        return [
-            1.0 if verify_code_solution(a, metadata.get("input_output", {}))
-            else -1.0
-            for a in answers
-        ]
-    from areal_tpu.rewards.math_verify import grade_math_answers
+    return [_default_grade_one(*item) for item in items]
 
-    return grade_math_answers(answers, metadata.get("solutions", []))
+
+def aggregate_from_records(
+    per_prompt: List[dict], n_sampling: int, path: str = ""
+) -> dict:
+    """Metric table from per-prompt sample records — the schema of the
+    reference's aggregate (``eval_and_aggregate.py:163-189``:
+    num_questions / greedy_length / sample_length / greedy_acc /
+    sample_pass@1 / pass@k / maj@k). Shared by the live harness and the
+    ``--from-generated`` re-aggregation path
+    (``aggregate_acc_from_generated.py``)."""
+    import numpy as np
+
+    ks = [1] + [k for k in (2, 4, 8, 16, 32) if k <= n_sampling]
+    agg: dict = {
+        "dataset": path,
+        "n_prompts": len(per_prompt),
+        "num_questions": len(per_prompt),
+        "n_sampling": n_sampling,
+        "sample_length": float(np.mean(
+            [l for r in per_prompt for l in r["gen_lens"]]
+        )) if per_prompt else 0.0,
+        "reward_mean": float(np.mean(
+            [x for r in per_prompt for x in r["rewards"]]
+        )) if per_prompt else 0.0,
+    }
+    for k in ks:
+        agg[f"pass@{k}"] = float(np.mean([
+            unbiased_pass_at_k(
+                len(r["rewards"]), sum(x > 0 for x in r["rewards"]), k
+            )
+            for r in per_prompt
+        ])) if per_prompt else 0.0
+    agg["sample_pass@1"] = agg.get("pass@1", 0.0)
+    for k in (k for k in (8, 16, 32) if k <= n_sampling):
+        agg[f"maj@{k}"] = float(np.mean([
+            majority_score(r["answers"], r["rewards"], k) for r in per_prompt
+        ])) if per_prompt else 0.0
+    if per_prompt and "greedy_reward" in per_prompt[0]:
+        agg["greedy_acc"] = float(np.mean(
+            [r["greedy_reward"] > 0 for r in per_prompt]
+        ))
+        agg["greedy_length"] = float(np.mean(
+            [r["greedy_len"] for r in per_prompt]
+        ))
+    return agg
 
 
 def _parse_datasets(specs: List[str]) -> Dict[str, str]:
@@ -119,6 +170,7 @@ def evaluate_benchmark(
     cf_cache_dir: Optional[str],
     cf_ratings: Optional[str],
     cf_pass_n: Optional[int],
+    grader_pool=None,
 ) -> dict:
     import dataclasses
 
@@ -141,6 +193,7 @@ def evaluate_benchmark(
     per_prompt: List[dict] = []
     cf_submissions = {}
     t0 = time.time()
+    timeouts0 = grader_pool.timeout_cnt if grader_pool else 0
     with open(os.path.join(out_dir, "samples.jsonl"), "w") as f:
         for lo in range(0, n, batch_prompts):
             samples = [
@@ -162,7 +215,9 @@ def evaluate_benchmark(
                 answers = [
                     decode(o.tokens[len(prompt):].tolist()) for o in group
                 ]
-                rws = grade_answers(qid, answers, metadata.get(qid, {}))
+                rws = grade_answers(
+                    qid, answers, metadata.get(qid, {}), pool=grader_pool
+                )
                 rec = {
                     "qid": qid,
                     "answers": answers,
@@ -172,7 +227,9 @@ def evaluate_benchmark(
                 }
                 if ggroup is not None:
                     g_ans = decode(ggroup[0].tokens[len(prompt):].tolist())
-                    g_rw = grade_answers(qid, [g_ans], metadata.get(qid, {}))
+                    g_rw = grade_answers(
+                        qid, [g_ans], metadata.get(qid, {}), pool=grader_pool
+                    )
                     rec["greedy_answer"] = g_ans
                     rec["greedy_reward"] = g_rw[0]
                     rec["greedy_len"] = len(ggroup[0].gen_logprobs)
@@ -185,37 +242,10 @@ def evaluate_benchmark(
                 name, min(lo + batch_prompts, n), n,
             )
 
-    ks = [1] + [k for k in (2, 4, 8, 16, 32) if k <= n_sampling]
-    agg: dict = {
-        "dataset": path,
-        "n_prompts": len(per_prompt),
-        "n_sampling": n_sampling,
-        "sample_length": float(np.mean(
-            [l for r in per_prompt for l in r["gen_lens"]]
-        )) if per_prompt else 0.0,
-        "reward_mean": float(np.mean(
-            [x for r in per_prompt for x in r["rewards"]]
-        )) if per_prompt else 0.0,
-        "wall_s": time.time() - t0,
-    }
-    for k in ks:
-        agg[f"pass@{k}"] = float(np.mean([
-            unbiased_pass_at_k(
-                len(r["rewards"]), sum(x > 0 for x in r["rewards"]), k
-            )
-            for r in per_prompt
-        ])) if per_prompt else 0.0
-    for k in (k for k in (8, 16, 32) if k <= n_sampling):
-        agg[f"maj@{k}"] = float(np.mean([
-            majority_score(r["answers"], r["rewards"], k) for r in per_prompt
-        ])) if per_prompt else 0.0
-    if with_greedy and per_prompt and "greedy_reward" in per_prompt[0]:
-        agg["greedy_acc"] = float(np.mean(
-            [r["greedy_reward"] > 0 for r in per_prompt]
-        ))
-        agg["greedy_length"] = float(np.mean(
-            [r["greedy_len"] for r in per_prompt]
-        ))
+    agg = aggregate_from_records(per_prompt, n_sampling, path)
+    agg["wall_s"] = time.time() - t0
+    if grader_pool is not None:  # the reference's ``timeout_samples`` count
+        agg["timeout_samples"] = grader_pool.timeout_cnt - timeouts0
     if cf_cache_dir:
         from areal_tpu.apps import cf_elo
 
@@ -230,9 +260,21 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--model-path", required=True, help="HF checkpoint dir")
     ap.add_argument(
-        "--dataset", action="append", required=True,
+        "--dataset", action="append", default=[],
         help="benchmark jsonl, repeatable; 'name=path' or bare path "
              "(name defaults to the file stem)",
+    )
+    ap.add_argument(
+        "--benchmark", action="append", default=[],
+        help="bundled benchmark name, repeatable (or 'all'): "
+             "aime24, aime25, amc23, gpqa_diamond, math_500 — data + prompt "
+             "template + grading ship with the package "
+             "(areal_tpu/evaluation/data)",
+    )
+    ap.add_argument(
+        "--prompt-template", default=None,
+        help="override the bundled benchmarks' prompt template "
+             "(r1-distilled-qwen, qwen25-math-cot, ...)",
     )
     ap.add_argument("--output-dir", required=True)
     ap.add_argument("--tokenizer", default=None, help="tokenizer path (defaults to model)")
@@ -271,6 +313,22 @@ def main(argv=None):
              "n_sampling generations count as ordered submissions)",
     )
     ap.add_argument(
+        "--grade-workers", type=int, default=8,
+        help="grading worker processes (0 = grade in-process, no timeouts)",
+    )
+    ap.add_argument(
+        "--grade-timeout", type=float, default=3.0,
+        help="per-comparison deadline in seconds (the reference's pebble "
+             "pool timeout); a wedged check scores as a wrong answer. "
+             "Code items get a larger budget (subprocess test cases).",
+    )
+    ap.add_argument(
+        "--from-generated", action="store_true",
+        help="skip generation: re-grade + re-aggregate existing "
+             "<output-dir>/<name>/samples.jsonl (the reference's "
+             "aggregate_acc_from_generated.py)",
+    )
+    ap.add_argument(
         "--allow-token-id-answers", action="store_true",
         help="debug only: grade space-joined token-id strings when no "
              "tokenizer is available (real grading needs one)",
@@ -278,7 +336,10 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     out_agg = os.path.join(args.output_dir, "aggregate.json")
-    if os.path.exists(out_agg) and not args.overwrite:
+    # --from-generated EXISTS to rewrite the aggregate of a finished sweep,
+    # so the idempotence guard must not apply to it
+    if os.path.exists(out_agg) and not args.overwrite \
+            and not args.from_generated:
         logger.info("aggregate exists (%s); pass --overwrite to redo", out_agg)
         return 0
     os.makedirs(args.output_dir, exist_ok=True)
@@ -288,6 +349,94 @@ def main(argv=None):
     if args.sampling_config:
         with open(args.sampling_config) as f:
             overrides = json.load(f)
+
+    # bundled benchmarks: materialize data + prompt template into the
+    # output dir, then treat like any --dataset entry
+    from areal_tpu.evaluation import benchmarks as bench_mod
+
+    bench_names = list(args.benchmark)
+    if "all" in bench_names:
+        bench_names = bench_mod.benchmark_names()
+    for bname in bench_names:
+        if bname not in bench_mod.BENCHMARKS:
+            raise SystemExit(
+                f"unknown benchmark {bname!r}; bundled: "
+                f"{', '.join(bench_mod.benchmark_names())}"
+            )
+        if bname in datasets:
+            raise ValueError(f"benchmark {bname!r} also given as --dataset")
+        datasets[bname] = bench_mod.write_benchmark_jsonl(
+            bname,
+            os.path.join(args.output_dir, bname, "prompts.jsonl"),
+            template=args.prompt_template,
+            max_items=args.max_prompts,
+        )
+    if not datasets:
+        raise SystemExit("nothing to evaluate: pass --dataset or --benchmark")
+
+    if args.from_generated:
+        # re-grade + re-aggregate existing samples.jsonl without a model
+        # (the reference's aggregate_acc_from_generated.py): answers are
+        # re-run through the CURRENT graders, so verifier fixes retro-
+        # actively correct old sweeps
+        from areal_tpu.datasets.prompt import metadata_from_records
+
+        grader_pool = None
+        if args.grade_workers > 0:
+            from areal_tpu.evaluation.grading import PoolGrader
+
+            grader_pool = PoolGrader(
+                n_workers=args.grade_workers, timeout_s=args.grade_timeout
+            )
+        aggregate = {"model": args.model_path, "benchmarks": {}}
+        try:
+            for name, path in datasets.items():
+                samples = os.path.join(
+                    args.output_dir, name, "samples.jsonl"
+                )
+                if not os.path.exists(samples):
+                    raise SystemExit(f"--from-generated: {samples} missing")
+                with open(samples) as f:
+                    per_prompt = [json.loads(line) for line in f]
+                with open(path) as f:
+                    meta = metadata_from_records(
+                        json.loads(line) for line in f
+                    )
+                missing = [
+                    r["qid"] for r in per_prompt if r["qid"] not in meta
+                ]
+                if missing:
+                    # re-grading against empty metadata would silently
+                    # score every such record wrong (e.g. a --max-prompts
+                    # smaller than the original sweep)
+                    raise SystemExit(
+                        f"--from-generated: {len(missing)} sample qids "
+                        f"missing from {path} (first: {missing[:3]}); "
+                        "regenerate with the original dataset/--max-prompts"
+                    )
+                for r in per_prompt:
+                    m = meta.get(r["qid"], {})
+                    r["rewards"] = grade_answers(
+                        r["qid"], r["answers"], m, pool=grader_pool
+                    )
+                    if "greedy_answer" in r:
+                        r["greedy_reward"] = grade_answers(
+                            r["qid"], [r["greedy_answer"]], m,
+                            pool=grader_pool,
+                        )[0]
+                n_sampling = max(
+                    (len(r["rewards"]) for r in per_prompt), default=0
+                )
+                aggregate["benchmarks"][name] = aggregate_from_records(
+                    per_prompt, n_sampling, path
+                )
+        finally:
+            if grader_pool is not None:
+                grader_pool.close()
+        with open(out_agg, "w") as f:
+            json.dump(aggregate, f, indent=2)
+        logger.info("aggregate: %s", json.dumps(aggregate, indent=2))
+        return 0
 
     from areal_tpu.api.model import GenerationHyperparameters
     from areal_tpu.experiments.config import ModelSpec
@@ -320,30 +469,47 @@ def main(argv=None):
     eng.load_hf(args.model_path)
     gen = SyncGenerator(eng)
 
+    grader_pool = None
+    if args.grade_workers > 0:
+        from areal_tpu.evaluation.grading import PoolGrader
+
+        grader_pool = PoolGrader(
+            n_workers=args.grade_workers, timeout_s=args.grade_timeout
+        )
+
     aggregate = {"model": args.model_path, "benchmarks": {}}
-    for name, path in datasets.items():
-        ov = overrides.get(name, {})
-        n_sampling = int(ov.get("n_sampling", args.n_sampling))
-        ghp = GenerationHyperparameters(
-            n=1 if args.greedy else n_sampling,
-            max_new_tokens=int(ov.get("max_gen_tokens", args.max_gen_tokens)),
-            greedy=args.greedy,
-            temperature=float(ov.get("temperature", args.temperature)),
-            top_p=float(ov.get("top_p", args.top_p)),
-            stop_token_ids=(
-                [tokenizer.eos_token_id]
-                if tokenizer is not None and tokenizer.eos_token_id is not None
-                else []
-            ),
-        )
-        aggregate["benchmarks"][name] = evaluate_benchmark(
-            gen, name, path, os.path.join(args.output_dir, name), ghp, decode,
-            tokenizer=tokenizer,
-            n_sampling=ghp.n, batch_prompts=args.batch_prompts,
-            max_prompts=args.max_prompts, seed=args.seed,
-            with_greedy=args.with_greedy, cf_cache_dir=args.cf_cache_dir,
-            cf_ratings=args.cf_ratings, cf_pass_n=args.cf_pass_n,
-        )
+    try:
+        for name, path in datasets.items():
+            ov = overrides.get(name, {})
+            n_sampling = int(ov.get("n_sampling", args.n_sampling))
+            ghp = GenerationHyperparameters(
+                n=1 if args.greedy else n_sampling,
+                max_new_tokens=int(
+                    ov.get("max_gen_tokens", args.max_gen_tokens)
+                ),
+                greedy=args.greedy,
+                temperature=float(ov.get("temperature", args.temperature)),
+                top_p=float(ov.get("top_p", args.top_p)),
+                stop_token_ids=(
+                    [tokenizer.eos_token_id]
+                    if tokenizer is not None
+                    and tokenizer.eos_token_id is not None
+                    else []
+                ),
+            )
+            aggregate["benchmarks"][name] = evaluate_benchmark(
+                gen, name, path, os.path.join(args.output_dir, name), ghp,
+                decode,
+                tokenizer=tokenizer,
+                n_sampling=ghp.n, batch_prompts=args.batch_prompts,
+                max_prompts=args.max_prompts, seed=args.seed,
+                with_greedy=args.with_greedy, cf_cache_dir=args.cf_cache_dir,
+                cf_ratings=args.cf_ratings, cf_pass_n=args.cf_pass_n,
+                grader_pool=grader_pool,
+            )
+    finally:
+        if grader_pool is not None:
+            grader_pool.close()
     with open(out_agg, "w") as f:
         json.dump(aggregate, f, indent=2)
     logger.info("aggregate: %s", json.dumps(aggregate, indent=2))

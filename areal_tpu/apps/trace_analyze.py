@@ -1,0 +1,49 @@
+"""CLI for the xplane trace analyzer.
+
+::
+
+    python -m areal_tpu.apps.trace_analyze /tmp/areal_trace [--top 20] \
+        [--json]
+
+Prints the per-plane device-time breakdown (compute / p2p_comm /
+coll_comm / memoryIO / idle / misc) the reference derives from chrome
+traces (``realhf/base/monitor.py:404-610``) — one command instead of the
+by-hand accounting earlier rounds used.
+"""
+
+import argparse
+import json
+import sys
+
+from areal_tpu.base.trace_analyzer import analyze_xspace, find_xplane_files
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir", help="dir passed to jax.profiler.trace "
+                    "(or a .xplane.pb file)")
+    ap.add_argument("--top", type=int, default=15)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    if args.trace_dir.endswith(".xplane.pb"):
+        files = [args.trace_dir]
+    else:
+        files = find_xplane_files(args.trace_dir)
+    if not files:
+        print(f"no .xplane.pb under {args.trace_dir}", file=sys.stderr)
+        return 1
+    summaries = []
+    for f in files:
+        summaries.extend(analyze_xspace(f))
+    if args.as_json:
+        print(json.dumps([s.as_dict() for s in summaries], indent=2))
+    else:
+        for s in summaries:
+            print(s.format_table(args.top))
+            print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

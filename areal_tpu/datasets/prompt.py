@@ -71,31 +71,38 @@ class PromptOnlyDataset:
         logger.info("dataset filter: %d -> %d", before, len(self.records))
 
 
+def metadata_from_records(records) -> Dict[str, dict]:
+    """qid -> grading metadata, shared by the live dataset and offline
+    re-grading paths (eval_offline --from-generated reads the raw jsonl
+    without tokenizing)."""
+    meta: Dict[str, dict] = {}
+    for i, r in enumerate(records):
+        qid = str(r.get("query_id", r.get("qid", i)))
+        task = r.get("task", "math")
+        if task in ("math", "gpqa"):  # gpqa: gold is the choice letter
+            meta[qid] = {"task": task, "solutions": r.get("solutions", [])}
+        elif task == "tool_use":
+            meta[qid] = {
+                "task": "tool_use",
+                "answer": str(
+                    r.get("answer", r.get("target", r.get("ground_truth", "")))
+                ),
+                **(
+                    {"scoring_method": r["scoring_method"]}
+                    if "scoring_method" in r
+                    else {}
+                ),
+            }
+        else:
+            meta[qid] = {
+                "task": "code",
+                "input_output": r.get("input_output", {}),
+            }
+    return meta
+
+
 class MathCodePromptDataset(PromptOnlyDataset):
     """Adds per-qid task metadata (solutions / test cases)."""
 
     def load_metadata(self) -> Dict[str, dict]:
-        meta: Dict[str, dict] = {}
-        for i, r in enumerate(self.records):
-            qid = str(r.get("query_id", r.get("qid", i)))
-            task = r.get("task", "math")
-            if task == "math":
-                meta[qid] = {"task": "math", "solutions": r.get("solutions", [])}
-            elif task == "tool_use":
-                meta[qid] = {
-                    "task": "tool_use",
-                    "answer": str(
-                        r.get("answer", r.get("target", r.get("ground_truth", "")))
-                    ),
-                    **(
-                        {"scoring_method": r["scoring_method"]}
-                        if "scoring_method" in r
-                        else {}
-                    ),
-                }
-            else:
-                meta[qid] = {
-                    "task": "code",
-                    "input_output": r.get("input_output", {}),
-                }
-        return meta
+        return metadata_from_records(self.records)

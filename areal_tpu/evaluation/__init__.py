@@ -1,0 +1,16 @@
+"""Offline evaluation assets: bundled benchmark data, prompt templates,
+per-benchmark loaders, and the process-pool grader.
+
+Counterpart of the reference's ``evaluation/`` harness
+(``evaluation/eval_and_aggregate.py``, ``evaluation/data_loader.py``,
+``evaluation/utils.py``): the five headline benchmarks ship with the
+package so ``eval_offline --benchmark aime24`` works standalone.
+"""
+
+from areal_tpu.evaluation.benchmarks import (  # noqa: F401
+    BENCHMARKS,
+    benchmark_names,
+    load_benchmark,
+    write_benchmark_jsonl,
+)
+from areal_tpu.evaluation.grading import PoolGrader  # noqa: F401

@@ -1,0 +1,135 @@
+"""Bundled benchmark registry: data files, prompt templates, gold answers.
+
+The five headline benchmarks the reference evaluates
+(``evaluation/eval_and_aggregate.py``, data under ``evaluation/data/``):
+aime24, aime25, amc23, gpqa_diamond, math_500. The raw ``test.jsonl``
+files are public benchmark data vendored unchanged under
+``areal_tpu/evaluation/data/<name>/test.jsonl``.
+
+Each loader normalizes a heterogeneous record schema to::
+
+    {"query_id": str, "prompt": str,      # templated, ready to tokenize
+     "task": "math" | "gpqa",
+     "solutions": [gold answer string]}
+
+which is exactly the shape ``datasets/prompt.py`` (MathCodePromptDataset)
+and the offline harness consume.
+
+Prompt templates are the reference's fixed evaluation prompts
+(``evaluation/utils.py:170-191``, keys ``r1-distilled-qwen`` and
+``r1-distilled-qwen-gpqa``) — a fixed external protocol string, kept
+byte-identical so scores are comparable.
+"""
+
+import json
+import os
+from typing import Dict, List, Optional
+
+_DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+
+# evaluation/utils.py:170 ("r1-distilled-qwen"): reason step by step,
+# boxed final answer, assistant turn pre-opened with <think>.
+R1_DISTILL_MATH = (
+    "<｜User｜>{input}\nPlease reason step by step, and put your final "
+    "answer within \\boxed{{}}.<｜Assistant｜><think>\n"
+)
+# evaluation/utils.py:187 ("r1-distilled-qwen-gpqa"): boxed choice letter.
+R1_DISTILL_GPQA = (
+    "<｜User｜>{input}\nPlease reason step-by-step and put your choice "
+    "letter without any other text with \\boxed{{}} in the end."
+    "<｜Assistant｜><think>\n"
+)
+# evaluation/utils.py ("qwen25-math-cot" family): a chat-format variant for
+# Qwen-instruct checkpoints evaluated without the R1 distill markers.
+QWEN_CHAT_MATH = (
+    "<|im_start|>system\nPlease reason step by step, and put your final "
+    "answer within \\boxed{{}}.<|im_end|>\n<|im_start|>user\n{input}"
+    "<|im_end|>\n<|im_start|>assistant\n"
+)
+
+TEMPLATES = {
+    "r1-distilled-qwen": R1_DISTILL_MATH,
+    "r1-distilled-qwen-gpqa": R1_DISTILL_GPQA,
+    "qwen25-math-cot": QWEN_CHAT_MATH,
+}
+
+
+class BenchmarkSpec:
+    """One bundled benchmark: where its data lives and how to present it."""
+
+    def __init__(self, name, n_items, task="math",
+                 template="r1-distilled-qwen", question_keys=("question",
+                 "problem"), answer_key="answer", default_max_gen=32768):
+        self.name = name
+        self.n_items = n_items          # sanity check against the data file
+        self.task = task
+        self.template = template
+        self.question_keys = question_keys
+        self.answer_key = answer_key
+        # eval_and_aggregate.py defaults --max_gen_tokens 32768
+        self.default_max_gen = default_max_gen
+
+    def path(self) -> str:
+        return os.path.join(_DATA_DIR, self.name, "test.jsonl")
+
+
+BENCHMARKS: Dict[str, BenchmarkSpec] = {
+    "aime24": BenchmarkSpec("aime24", 30),
+    "aime25": BenchmarkSpec("aime25", 30),
+    "amc23": BenchmarkSpec("amc23", 40),
+    # gpqa "question" already carries the A-D labeled options; gold is the
+    # choice letter (``answer``), graded by boxed-letter equality
+    "gpqa_diamond": BenchmarkSpec(
+        "gpqa_diamond", 198, task="gpqa", template="r1-distilled-qwen-gpqa"
+    ),
+    "math_500": BenchmarkSpec("math_500", 500),
+}
+
+
+def benchmark_names() -> List[str]:
+    return list(BENCHMARKS)
+
+
+def load_benchmark(
+    name: str, template: Optional[str] = None, max_items: Optional[int] = None
+) -> List[dict]:
+    """Read the bundled data, apply the prompt template, normalize."""
+    spec = BENCHMARKS[name]
+    tmpl = TEMPLATES[template] if template else TEMPLATES[spec.template]
+    out = []
+    with open(spec.path()) as f:
+        for i, line in enumerate(f):
+            if max_items is not None and i >= max_items:
+                break
+            rec = json.loads(line)
+            q = next(
+                (rec[k] for k in spec.question_keys if rec.get(k)), None
+            )
+            if q is None:
+                raise ValueError(f"{name} record {i}: no question field")
+            gold = str(rec[spec.answer_key])
+            out.append({
+                "query_id": f"{name}-{rec.get('id', rec.get('unique_id', i))}",
+                "prompt": tmpl.format(input=str(q).strip()),
+                "task": spec.task,
+                "solutions": [gold],
+            })
+    if max_items is None and len(out) != spec.n_items:
+        raise ValueError(
+            f"{name}: expected {spec.n_items} items, found {len(out)} — "
+            "bundled data file corrupted?"
+        )
+    return out
+
+
+def write_benchmark_jsonl(
+    name: str, out_path: str, template: Optional[str] = None,
+    max_items: Optional[int] = None,
+) -> str:
+    """Materialize a benchmark as a prompt-dataset jsonl for the harness."""
+    records = load_benchmark(name, template=template, max_items=max_items)
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r, ensure_ascii=False) + "\n")
+    return out_path

@@ -1,0 +1,210 @@
+"""Process-pool grading with per-item timeouts.
+
+Counterpart of the reference's pebble ``ProcessPool(max_workers=...).map(
+math_equal_process, params, timeout=3)`` loop
+(``evaluation/evaluate.py:44-86``): sympy equivalence checks can hang on
+adversarial model output, so each (answer, gold) comparison runs in a
+worker PROCESS that can be killed on deadline — a thread pool or in-process
+grading cannot be interrupted mid-sympy. pebble isn't in this image, so the
+pool is built directly on ``multiprocessing``: N persistent workers pull
+items from a queue; a worker that blows its deadline is terminated and
+respawned, and the item scores as a WRONG answer for its task
+(``failure_score``: -1 for math/code, 0 for gpqa — matching the
+in-process graders' conventions), counted in ``timeout_cnt`` (the
+reference's ``timeout_samples``).
+"""
+
+import logging
+import multiprocessing as mp
+import os
+import queue as queue_mod
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger("areal_tpu.evaluation.grading")
+
+
+def _default_grade_one(task: str, answer: str, gold_or_meta) -> float:
+    if task == "code":
+        from areal_tpu.rewards.code_verify import verify_code_solution
+
+        return 1.0 if verify_code_solution(answer, gold_or_meta or {}) else -1.0
+    if task == "gpqa":
+        from areal_tpu.evaluation.mcq import grade_choice
+
+        gold = gold_or_meta
+        if isinstance(gold, list):
+            gold = gold[0] if gold else ""
+        return grade_choice(answer, str(gold))
+    from areal_tpu.rewards.math_verify import grade_math_answers
+
+    golds = gold_or_meta if isinstance(gold_or_meta, list) else [gold_or_meta]
+    return grade_math_answers([answer], [str(g) for g in golds])[0]
+
+
+def failure_score(task: str) -> float:
+    """Score for a timed-out or crashed comparison — must match the
+    wrong-answer convention of that task's grader (math/code grade wrong
+    answers -1.0, gpqa 0.0), or pooled and in-process runs of the same
+    samples report different reward_mean."""
+    return 0.0 if task == "gpqa" else -1.0
+
+
+def _worker(inq, outq, grade_one):
+    # one item per message; the parent enforces the deadline and kills us if
+    # sympy wedges, so no in-worker alarm machinery is needed. Warm the
+    # heavy grader imports BEFORE taking items so the first item's deadline
+    # measures grading, not ~1s of sympy import.
+    try:
+        import areal_tpu.rewards.math_verify  # noqa: F401
+    except Exception:
+        pass
+    while True:
+        msg = inq.get()
+        if msg is None:
+            return
+        idx, task, answer, gold = msg
+        # deadline starts when work starts, not when the item was queued —
+        # spawn-context worker startup must not count against it
+        outq.put(("start", idx))
+        try:
+            score = float(grade_one(task, answer, gold))
+        except Exception as e:  # grader crash = wrong answer, not a crash
+            logger.debug("grader error on item %d: %r", idx, e)
+            score = failure_score(task)
+        outq.put(("done", idx, score))
+
+
+class PoolGrader:
+    """Grade (task, answer, gold) triples in worker processes.
+
+    ``grade(items)`` preserves input order; timed-out or crashed items
+    score ``failure_score(task)`` (the task's wrong-answer value). Workers
+    are persistent across calls (sympy import is ~1s); a killed worker is
+    respawned lazily.
+    """
+
+    def __init__(
+        self,
+        n_workers: Optional[int] = None,
+        timeout_s: float = 3.0,  # the reference's per-item deadline
+        grade_one: Callable = _default_grade_one,
+        code_timeout_s: float = 70.0,
+    ):
+        self.n_workers = n_workers or min(8, os.cpu_count() or 1)
+        self.timeout_s = timeout_s
+        # code verification legitimately runs several subprocess test cases
+        # (up to ~8 x 8 s in code_verify.py) — the sympy deadline would
+        # kill CORRECT solutions, so code items get their own budget
+        self.code_timeout_s = max(code_timeout_s, timeout_s)
+        self.grade_one = grade_one
+        self.timeout_cnt = 0
+        self._ctx = mp.get_context("spawn")  # never fork a JAX parent
+        self._procs: List = []
+        self._chans: List[Tuple] = []  # (inq, outq) per worker
+
+    def _spawn(self, i):
+        inq = self._ctx.Queue()
+        outq = self._ctx.Queue()
+        p = self._ctx.Process(
+            target=_worker, args=(inq, outq, self.grade_one), daemon=True
+        )
+        p.start()
+        self._procs[i] = p
+        self._chans[i] = (inq, outq)
+
+    def _ensure_workers(self, n):
+        while len(self._procs) < n:
+            self._procs.append(None)
+            self._chans.append(None)
+        for i in range(n):
+            if self._procs[i] is None or not self._procs[i].is_alive():
+                self._spawn(i)
+
+    def grade(self, items: Sequence[Tuple[str, str, object]]) -> List[float]:
+        n_workers = min(self.n_workers, max(len(items), 1))
+        self._ensure_workers(n_workers)
+        scores = [0.0] * len(items)
+        pending = list(enumerate(items))  # (idx, item), FIFO
+        busy = {}  # worker i -> (idx, deadline)
+
+        SPAWN_ALLOWANCE = 120.0  # worker cold-start (interpreter + imports)
+
+        def item_timeout(idx):
+            task = items[idx][0]
+            return self.code_timeout_s if task == "code" else self.timeout_s
+
+        def dispatch(i):
+            if not pending:
+                return
+            idx, (task, answer, gold) = pending.pop(0)
+            self._chans[i][0].put((idx, task, answer, gold))
+            # provisional deadline covers spawn; tightens to the item's
+            # budget once the worker reports it has BEGUN this item
+            busy[i] = (idx, time.monotonic() + item_timeout(idx)
+                       + SPAWN_ALLOWANCE)
+
+        for i in range(n_workers):
+            dispatch(i)
+        while busy:
+            now = time.monotonic()
+            progressed = False
+            for i in list(busy):
+                idx, deadline = busy[i]
+                try:
+                    msg = self._chans[i][1].get_nowait()
+                except queue_mod.Empty:
+                    if now > deadline:
+                        # sympy wedged: kill, score as a wrong answer,
+                        # respawn lazily
+                        scores[idx] = failure_score(items[idx][0])
+                        self.timeout_cnt += 1
+                        logger.warning(
+                            "grading item %d timed out after %.1fs", idx,
+                            item_timeout(idx),
+                        )
+                        self._procs[i].terminate()
+                        self._procs[i].join(1.0)
+                        self._procs[i] = None
+                        del busy[i]
+                        self._ensure_workers(n_workers)
+                        dispatch(i)
+                        progressed = True
+                    continue
+                if msg[0] == "start":
+                    if msg[1] == idx:
+                        busy[i] = (
+                            idx, time.monotonic() + item_timeout(idx)
+                        )
+                    progressed = True
+                    continue
+                _, ridx, score = msg
+                if ridx == idx:
+                    scores[ridx] = score
+                del busy[i]
+                dispatch(i)
+                progressed = True
+            if not progressed:
+                time.sleep(0.005)
+        return scores
+
+    def close(self):
+        for i, p in enumerate(self._procs):
+            if p is not None and p.is_alive():
+                try:
+                    self._chans[i][0].put(None)
+                except Exception:
+                    pass
+        deadline = time.monotonic() + 2.0
+        for p in self._procs:
+            if p is not None:
+                p.join(max(deadline - time.monotonic(), 0.1))
+                if p.is_alive():
+                    p.terminate()
+        self._procs, self._chans = [], []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,37 @@
+"""Multiple-choice grading (gpqa_diamond).
+
+The gpqa prompt (``evaluation/utils.py:187``) asks for the bare choice
+letter in ``\\boxed{}``; gold is the letter (``answer`` field of
+``evaluation/data/gpqa_diamond/test.jsonl``). Models still emit variants —
+``\\boxed{(D)}``, ``\\boxed{D. 10^-4 ev}``, a trailing "D" with no box — so
+extraction mirrors the reference's choice-parsing tail
+(``evaluation/parser.py:630-660``): prefer the boxed payload, fall back to
+the last standalone choice letter in the text.
+"""
+
+import re
+
+_CHOICE = re.compile(r"\b([A-E])\b")
+
+
+def extract_choice(text: str) -> str:
+    """Best-effort choice letter from a model answer ('' if none)."""
+    from areal_tpu.rewards.math_verify import extract_answer
+
+    boxed = extract_answer(text, use_last_number=False)
+    if boxed:
+        m = _CHOICE.search(boxed.strip().strip("()."))
+        if m:
+            return m.group(1)
+        # boxed but no letter inside (e.g. the option text itself): keep
+        # searching the payload for a leading "A." style label
+        m = re.match(r"\s*\(?([A-E])\)?[.:\s]", boxed)
+        if m:
+            return m.group(1)
+    matches = _CHOICE.findall(text)
+    return matches[-1] if matches else ""
+
+
+def grade_choice(answer: str, gold: str) -> float:
+    got = extract_choice(answer)
+    return 1.0 if got and got.upper() == gold.strip().upper() else 0.0

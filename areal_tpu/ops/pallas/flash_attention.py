@@ -76,6 +76,17 @@ SPECIALIZE_MIN_T = 8192
 FUSED_BWD_MAX_DQ_BYTES = 48 * 2**20
 
 
+def _bwd_pipeline() -> bool:
+    # cross-block software pipelining in the fused backward (VERDICT r4
+    # #4): park (p, ds) one step and issue their gradient dots alongside
+    # the next block's VPU work. Numerics identical (parking dtype = the
+    # dots' operand dtype). Default OFF until chip-measured — the bench
+    # A/Bs both settings and the winner becomes the default.
+    return os.environ.get("AREAL_FLASH_BWD_PIPELINE", "0") not in (
+        "0", "false", ""
+    )
+
+
 def _interpret() -> bool:
     # off-TPU (CPU tests) the kernels run in the pallas interpreter
     return jax.devices()[0].platform != "tpu"
@@ -621,7 +632,7 @@ def _bwd_kernel(
     dk_scr,     # [T, D] f32 — whole-T accumulator, flushed per kv head
     dv_scr,     # [T, D] f32
     dq_scr,     # [n_rep*block_q, D] f32 — one q sweep's accumulator
-    *,
+    *pipe,      # optional (p, ds, kprev, meta) parking scratch (pipelined)
     scale, block_q, block_k, nk_blocks, nq_blocks, soft_cap, sliding_window,
     specialize, n_rep,
 ):
@@ -650,6 +661,7 @@ def _bwd_kernel(
         ik <= _last_k(iq, block_q, block_k),
         needs_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, q_ref, k_ref,
         v_ref, do_ref, dk_ref, dv_ref, dq_ref, dk_scr, dv_scr, dq_scr,
+        tuple(pipe) if pipe else None,
         scale=scale, block_q=block_q, block_k=block_k, nk_blocks=nk_blocks,
         nq_blocks=nq_blocks, soft_cap=soft_cap, sliding_window=sliding_window,
         specialize=specialize, n_rep=n_rep,
@@ -660,15 +672,30 @@ def _bwd_step(
     ik, iq, init_dq, init_kv, done_dq, done_kv, active,
     needs_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, q_ref, k_ref,
     v_ref, do_ref, dk_ref, dv_ref, dq_ref, dk_scr, dv_scr, dq_scr,
+    pipe_scr,
     *, scale, block_q, block_k, nk_blocks, nq_blocks, soft_cap,
     sliding_window, specialize, n_rep,
 ):
     """One fused-backward grid step (shared by band and triangle kernels);
     q-side refs carry the whole rep group ``[n_rep, block_q, ...]``.
     ``init_dq``/``done_dq`` bound one q block's k sweep; ``init_kv``/
-    ``done_kv`` bound one kv head's whole traversal."""
+    ``done_kv`` bound one kv head's whole traversal.
+
+    With ``pipe_scr`` (cross-block software pipelining, VERDICT r4 #4):
+    the three gradient dots consuming (p, ds) are DEFERRED one grid step —
+    step j issues step j-1's ``dv += pᵀdo``, ``dk += dsᵀq``, ``dq += ds·k``
+    from VMEM scratch between j's score/dp dots and j's exp/mask VPU work,
+    so the MXU chews the previous block's gradients while the VPU builds
+    the current block's probabilities instead of serializing p→dv, ds→dk/dq
+    every step (~7.7 µs/step vs ~4.4 ideal, the round-4 limiter). do/q/
+    delta/lse are q-stationary across the inner k sweep, so only the k
+    block (for dq) and the dv/dk column offset need carrying in scratch;
+    the deferred dots flush inside ``done_dq`` before q/do move on."""
     rows = n_rep * block_q
     D = q_ref.shape[-1]
+    pipeline = pipe_scr is not None
+    if pipeline:
+        p_scr, ds_scr, kprev_scr, meta_scr = pipe_scr
 
     @pl.when(init_dq)
     def _init_dq():
@@ -678,6 +705,35 @@ def _bwd_step(
     def _init_kv():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
+        if pipeline:
+            meta_scr[1] = 0  # no pending block
+
+    def _grad_dots(p, ds, col, kblk):
+        # dv += pᵀ @ do ; dk += dsᵀ @ q over the FOLDED rows — summing the
+        # group's per-head contributions inside the dot itself
+        dv_scr[pl.ds(col, block_k), :] += jax.lax.dot_general(
+            p, do_ref[...].reshape(rows, D),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_scr[pl.ds(col, block_k), :] += jax.lax.dot_general(
+            ds, q_ref[...].reshape(rows, D),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dq_scr[...] += jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    def _issue_pending():
+        @pl.when(meta_scr[1] == 1)
+        def _():
+            _grad_dots(
+                p_scr[...], ds_scr[...],
+                meta_scr[0], kprev_scr[...],
+            )
+        meta_scr[1] = 0
 
     def _accum(masked: bool):
         p, ds = _recompute_p_ds(
@@ -686,23 +742,21 @@ def _bwd_step(
             soft_cap=soft_cap, sliding_window=sliding_window, masked=masked,
             n_rep=n_rep,
         )
-        # dv += pᵀ @ do ; dk += dsᵀ @ q over the FOLDED rows — summing the
-        # group's per-head contributions inside the dot itself
         col = jnp.minimum(ik, nk_blocks - 1) * block_k
-        dv_scr[pl.ds(col, block_k), :] += jax.lax.dot_general(
-            p.astype(do_ref.dtype), do_ref[...].reshape(rows, D),
-            (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dk_scr[pl.ds(col, block_k), :] += jax.lax.dot_general(
-            ds.astype(q_ref.dtype), q_ref[...].reshape(rows, D),
-            (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dq_scr[...] += jax.lax.dot_general(
-            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        if pipeline:
+            # park this block's (p, ds, k, col); consumed next step (or in
+            # the done_dq flush below). bf16 parking matches the dots'
+            # operand dtype, so numerics are unchanged.
+            p_scr[...] = p.astype(do_ref.dtype)
+            ds_scr[...] = ds.astype(q_ref.dtype)
+            kprev_scr[...] = k_ref[0]
+            meta_scr[0] = col
+            meta_scr[1] = 1
+        else:
+            _grad_dots(
+                p.astype(do_ref.dtype), ds.astype(q_ref.dtype), col,
+                k_ref[0],
+            )
 
     # clamp BOTH indices: the band wrapper's ik = kstart[iq]+j can pass
     # nk_blocks for all-pad q blocks (inactive, but the scalar read must
@@ -711,10 +765,17 @@ def _bwd_step(
         jnp.minimum(iq, nq_blocks - 1) * nk_blocks
         + jnp.minimum(ik, nk_blocks - 1)
     ]
+    if pipeline:
+        # previous block's gradient dots FIRST: no data dependency on this
+        # step's VPU work, so Mosaic can overlap them with _accum's
+        # exp/mask while this step's own dots queue behind
+        _issue_pending()
     _dispatch_masked(active, specialize, needs, _accum)
 
     @pl.when(done_dq)
     def _done_dq():
+        if pipeline:
+            _issue_pending()  # the sweep's last block, parked just above
         dq_ref[...] = (
             (dq_scr[...] * scale).reshape(n_rep, block_q, D)
         ).astype(dq_ref.dtype)
@@ -734,7 +795,7 @@ def _bwd_kernel_tri(
     last_tab,    # [L] int32 STATIC: 1 = last step of its q block's sweep
     seg_q_ref, seg_k_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref, do_ref,
     dk_ref, dv_ref, dq_ref, dk_scr, dv_scr, dq_scr,
-    *,
+    *pipe,
     scale, block_q, block_k, nk_blocks, nq_blocks, soft_cap, sliding_window,
     specialize, n_rep,
 ):
@@ -755,6 +816,7 @@ def _bwd_kernel_tri(
         ik_tab[l] >= kstart_ref[iq],
         needs_ref, seg_q_ref, seg_k_ref, lse_ref, delta_ref, q_ref, k_ref,
         v_ref, do_ref, dk_ref, dv_ref, dq_ref, dk_scr, dv_scr, dq_scr,
+        tuple(pipe) if pipe else None,
         scale=scale, block_q=block_q, block_k=block_k, nk_blocks=nk_blocks,
         nq_blocks=nq_blocks, soft_cap=soft_cap, sliding_window=sliding_window,
         specialize=specialize, n_rep=n_rep,
@@ -915,7 +977,13 @@ def _flash_backward(
         # raise only when the default 16 MB budget cannot fit (raising it
         # when unnecessary measurably hurt short-context throughput —
         # ~7% on the 1B/512-packed shape, chip-measured r3+r4)
+        pipeline = _bwd_pipeline()
+        rows = n_rep * block_q
         est = dkv_scr_bytes + 4 * n_rep * block_q * block_k * 4
+        if pipeline:  # parked p/ds tiles + k block copy
+            est += rows * block_k * (
+                do.dtype.itemsize + q.dtype.itemsize
+            ) + block_k * D * k.dtype.itemsize
         limit = (
             min(est + 40 * 2**20, 114 * 2**20)  # 114 MB = max scoped limit
             if est > 14 * 2**20 else None
@@ -930,6 +998,13 @@ def _flash_backward(
             pltpu.VMEM((T, D), jnp.float32),
             pltpu.VMEM((n_rep * block_q, D), jnp.float32),
         ]
+        if pipeline:
+            scratch_shapes += [
+                pltpu.VMEM((rows, block_k), do.dtype),   # parked p
+                pltpu.VMEM((rows, block_k), q.dtype),    # parked ds
+                pltpu.VMEM((block_k, D), k.dtype),       # parked k block
+                pltpu.SMEM((2,), jnp.int32),             # [col, valid]
+            ]
         kv_whole = pl.BlockSpec(
             (1, T, D), lambda *idx: (idx[0], 0, 0)
         )

@@ -270,6 +270,16 @@ def _latex_to_expr(s: str) -> str:
     )
     # \binom{n}{k} -> binomial(n, k)
     s = re.sub(r"\\binom\{([^{}]*)\}\{([^{}]*)\}", r"binomial(\1, \2)", s)
+    # floor/ceiling delimiters (latex2sympy floor_test/ceil_test grammar)
+    s = re.sub(r"\\lfloor([^\\]*)\\rfloor", r"floor(\1)", s)
+    s = re.sub(r"\\lceil([^\\]*)\\rceil", r"ceiling(\1)", s)
+    # a \mod b / a \pmod{b} (mod_test grammar): unbrace the \pmod argument,
+    # then rewrite to python's %, whose MULTIPLICATIVE precedence matches
+    # latex2sympy's mp-level mod rule ('3 + 7 \mod 4' == 3 + Mod(7,4), not
+    # Mod(10, 4)). Unambiguous: _normalize already stripped literal '%'
+    # (percent signs) from the answer text.
+    s = re.sub(r"\\([pb]?)mod\{([^{}]*)\}", r"\\\1mod(\2)", s)
+    s = re.sub(r"\\[pb]?mod(?![A-Za-z])", "%", s)
     # logs: \log_{b} x / \log_b x -> base-b; \log -> base 10 (latex2sympy's
     # convention); \ln -> natural
     s = re.sub(

@@ -292,6 +292,14 @@ class SlurmSchedulerClient(SchedulerClient):
                 f"{count} tasks need {-(-count // tasks_per_host)} hosts "
                 f"x {tasks_per_host}, got {len(hosts)}"
             )
+        if any("\n" in a for a in cmd):
+            # srun's multi-prog file is line-oriented: an embedded newline
+            # (even shlex-quoted) splits one rank's entry across lines and
+            # the whole array dies with a quoting error at RUN time
+            raise ValueError(
+                "array command args must not contain newlines "
+                "(srun --multi-prog is line-oriented)"
+            )
         name = f"{self.run_name}:{worker_type}"
         tag = worker_type.replace("/", "_")
         multiprog = "\n".join(

