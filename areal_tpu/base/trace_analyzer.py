@@ -113,10 +113,18 @@ def _is_device_plane(name: str) -> bool:
 
 
 def _op_lines(plane):
-    """XLA-op event lines. TPU planes carry 'XLA Ops' / per-core lines;
-    the CPU PJRT plane nests ops in its client thread lines."""
-    for line in plane.lines:
-        yield line
+    """XLA-op event lines ONLY. A real TPU device plane carries 'XLA Ops'
+    plus 'XLA Modules' / 'Steps' lines whose spans COVER the same wall
+    time — summing every line would double/triple-count device_total_s.
+    When an op line exists, everything else on the plane is dropped; the
+    CPU PJRT plane (no such line) falls through to all lines, with op
+    events identified by their ``hlo_op`` stat instead."""
+    lines = list(plane.lines)
+    ops = [
+        ln for ln in lines
+        if "xla ops" in ln.name.lower() or "xla op" == ln.name.lower()
+    ]
+    return ops if ops else lines
 
 
 def analyze_xspace(path: str) -> List[TraceSummary]:
@@ -124,7 +132,10 @@ def analyze_xspace(path: str) -> List[TraceSummary]:
     PJRT client plane stands in for the device)."""
     import jax.profiler as jp
 
-    pd = jp.ProfileData.from_file(path)
+    return analyze_profile_data(jp.ProfileData.from_file(path))
+
+
+def analyze_profile_data(pd) -> List[TraceSummary]:
     planes = list(pd.planes)
     device_planes = [p for p in planes if _is_device_plane(p.name)]
     if not device_planes:

@@ -154,17 +154,24 @@ class PoolGrader:
                 try:
                     msg = self._chans[i][1].get_nowait()
                 except queue_mod.Empty:
-                    if now > deadline:
-                        # sympy wedged: kill, score as a wrong answer,
+                    proc = self._procs[i]
+                    died = proc is not None and not proc.is_alive()
+                    if now > deadline or died:
+                        # wedged (deadline) or CRASHED (segfault/OOM-kill —
+                        # detected immediately, not after the provisional
+                        # spawn allowance): score as a wrong answer,
                         # respawn lazily
                         scores[idx] = failure_score(items[idx][0])
                         self.timeout_cnt += 1
                         logger.warning(
-                            "grading item %d timed out after %.1fs", idx,
-                            item_timeout(idx),
+                            "grading item %d %s", idx,
+                            "worker died" if died else
+                            f"timed out after {item_timeout(idx):.1f}s",
                         )
-                        self._procs[i].terminate()
-                        self._procs[i].join(1.0)
+                        if proc is not None and proc.is_alive():
+                            proc.terminate()
+                        if proc is not None:
+                            proc.join(1.0)
                         self._procs[i] = None
                         del busy[i]
                         self._ensure_workers(n_workers)

@@ -140,13 +140,10 @@ class GenerationEngine:
                     f"generation mesh needs a 'model' axis, got {mesh.axis_names}"
                 )
             tp = mesh.shape["model"]
-            # pallas_call has no GSPMD partitioning rule: with the KV pool
-            # sharded on its kv-head axis, the Pallas decode kernel under a
-            # >1-way 'model' axis would all-gather the whole pool per layer
-            # (or fail to lower). TP serving pins the XLA gather path, which
-            # GSPMD partitions per head group (ADVICE r3, medium).
-            if tp > 1:
-                self._decode_use_pallas = False
+            # bare pallas_call has no GSPMD partitioning rule, so >1-way
+            # 'model' serving routes the decode kernel through shard_map
+            # over the kv-head axis (ops/paged_attention.py) — r5, replaces
+            # the r3 XLA-gather pin; _decode_use_pallas stays None (auto)
             for dim, name in (
                 (cfg.n_kv_heads, "n_kv_heads"),
                 (cfg.n_q_heads, "n_q_heads"),
@@ -616,6 +613,7 @@ class GenerationEngine:
                 params, cfg, state.cache, state.last_tokens, table,
                 state.lens, state.active,
                 use_pallas=self._decode_use_pallas,
+                mesh=self.mesh,
             )
             if self.mesh is not None:
                 # one explicit all-gather of the [B, V] logits: sampling

@@ -761,17 +761,18 @@ def decode_step_paged(
     lens: jnp.ndarray,         # [B] resident tokens (write position)
     active: jnp.ndarray,       # [B] bool
     use_pallas: Optional[bool] = None,
+    mesh=None,
 ) -> Tuple[jnp.ndarray, PagedKVCache, jnp.ndarray]:
     """One decode step over the page pool. Returns (fp32 logits ``[B, V]``,
     cache, new lens — incremented where active). The pool is read-only in
     the layer scan; each layer's fresh K/V merges into attention as the
     self token and lands in the pool via one post-scan scatter.
 
-    ``use_pallas`` threads through to the attention dispatch; TP-sharded
-    serving passes False — ``pallas_call`` has no GSPMD partitioning rule,
-    so with the pool sharded on its kv-head axis the kernel would force a
-    full-pool all-gather (or fail to lower), while the XLA gather path
-    partitions cleanly per head group."""
+    ``use_pallas`` threads through to the attention dispatch. ``mesh``
+    (TP serving) routes the kernel through ``shard_map`` over the kv-head
+    axis — each model shard runs Pallas on its local pool slice —
+    because bare ``pallas_call`` has no GSPMD partitioning rule and would
+    otherwise force a full-pool all-gather."""
     from areal_tpu.ops import paged_attention as paged_ops
 
     positions = lens
@@ -796,6 +797,7 @@ def decode_step_paged(
             soft_cap=cfg.attn_logits_soft_cap,
             sliding_window=cfg.sliding_window,
             use_pallas=use_pallas,
+            mesh=mesh,
         )
         x = x + _attn_out(lp["attn"], ctx.astype(x.dtype))
         h = _norm(cfg, lp["ln2"], x)

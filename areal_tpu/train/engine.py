@@ -253,13 +253,48 @@ class TrainEngine:
             self.params = jax.device_put(host_params, self._param_shardings)
         return self
 
-    def save_hf(self, path: str, family: str):
+    def save_hf(self, path: str, family: str, async_write: bool = False,
+                post_write=None):
+        """HF checkpoint export. The param gather is collective (every host
+        calls in) and must finish before the next donated train step; the
+        file write is pure host IO. ``async_write=True`` returns a daemon
+        ``threading.Thread`` (main host; None elsewhere) doing the write +
+        ``post_write()`` in the background — the weight-publish fast path
+        (r5, VERDICT r4 #3). A failure inside the thread is stored on
+        ``thread._areal_exc``; the joiner must check and re-raise so a
+        disk-full does not silently freeze the fleet's weight version."""
+        import threading
+
         from areal_tpu.models import hf as hf_conv
 
         host_params = multihost.gather_params_to_host(self.params)
-        if multihost.is_main():
+
+        def _write():
             hf_conv.save_hf_checkpoint(host_params, self.cfg, family, path)
-        multihost.barrier("save_hf")
+            if post_write is not None:
+                post_write()
+
+        if async_write:
+            multihost.barrier("save_hf")  # collectives done; IO floats free
+            if not multihost.is_main():
+                return None
+
+            def _guarded():
+                try:
+                    _write()
+                except BaseException as e:  # surfaced by the joiner
+                    t._areal_exc = e
+
+            t = threading.Thread(
+                target=_guarded, name=f"save_hf:{path}", daemon=True
+            )
+            t._areal_exc = None
+            t.start()
+            return t
+        if multihost.is_main():
+            _write()
+        multihost.barrier("save_hf")  # sync: file exists for every host
+        return None
 
     # ------------------------------------------------------------------ #
     # Optimizer

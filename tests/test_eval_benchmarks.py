@@ -271,3 +271,28 @@ def test_from_generated_regrades_with_current_verifier(tmp_path):
     b = agg["benchmarks"]["bench"]
     assert b["pass@1"] == 0.5  # 128 mod 3 == 2 now grades correct
     assert b["pass@2"] == 1.0
+
+
+def _crash_grader(task, answer, gold):
+    if answer == "die":
+        os._exit(17)  # simulate a segfault/OOM kill
+    return 1.0
+
+
+def test_pool_grader_detects_dead_worker_fast():
+    """Review finding r5: a CRASHED worker (not a wedge) must be detected
+    by liveness, not by waiting out the deadline + spawn allowance."""
+    pool = PoolGrader(n_workers=1, timeout_s=30.0, grade_one=_crash_grader)
+    try:
+        t0 = time.monotonic()
+        scores = pool.grade([
+            ("math", "ok", ["1"]),
+            ("math", "die", ["1"]),
+            ("math", "ok", ["1"]),
+        ])
+        # far below timeout_s (30) + SPAWN_ALLOWANCE (120)
+        assert time.monotonic() - t0 < 25
+        assert scores == [1.0, -1.0, 1.0]
+        assert pool.timeout_cnt == 1
+    finally:
+        pool.close()

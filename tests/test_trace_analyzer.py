@@ -80,3 +80,43 @@ def test_cli_no_trace(tmp_path, capsys):
     from areal_tpu.apps.trace_analyze import main
 
     assert main([str(tmp_path)]) == 1
+
+
+def test_tpu_plane_counts_only_op_lines():
+    """Review finding r5: a real TPU device plane carries 'XLA Modules' /
+    'Steps' lines spanning the SAME wall time as the op line — only the op
+    line may contribute to device_total_s."""
+    import jax.profiler as jp
+
+    from areal_tpu.base.trace_analyzer import analyze_profile_data
+
+    txt = """
+planes {
+  name: "/device:TPU:0"
+  lines {
+    id: 1 name: "XLA Ops"
+    events { metadata_id: 1 offset_ps: 0 duration_ps: 1000000 }
+    events { metadata_id: 2 offset_ps: 1000000 duration_ps: 500000 }
+  }
+  lines {
+    id: 2 name: "XLA Modules"
+    events { metadata_id: 3 offset_ps: 0 duration_ps: 1500000 }
+  }
+  lines {
+    id: 3 name: "Steps"
+    events { metadata_id: 4 offset_ps: 0 duration_ps: 1500000 }
+  }
+  event_metadata { key: 1 value { id: 1 name: "fusion.1" } }
+  event_metadata { key: 2 value { id: 2 name: "all-reduce.2" } }
+  event_metadata { key: 3 value { id: 3 name: "jit_train_step" } }
+  event_metadata { key: 4 value { id: 4 name: "train_step" } }
+}
+"""
+    (s,) = analyze_profile_data(jp.ProfileData.from_text_proto(txt))
+    # 1.0 us fusion + 0.5 us all-reduce; module/step spans NOT re-counted
+    assert abs(s.device_total_s - 1.5e-6) < 1e-12
+    assert abs(s.buckets_s["compute"] - 1.0e-6) < 1e-12
+    assert abs(s.buckets_s["coll_comm"] - 0.5e-6) < 1e-12
+    assert s.n_events == 2
+    names = [n for n, *_ in s.top_ops]
+    assert "jit_train_step" not in names and "train_step" not in names

@@ -288,3 +288,35 @@ def test_chunked_loss_matches_dense(rng):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=1e-5
             )
+
+
+class TestAsyncSaveHF:
+    def test_async_write_lands_and_runs_post_write(self, engine, tmp_path):
+        import os
+
+        path = str(tmp_path / "ckpt_async")
+        flag = []
+        t = engine.save_hf(
+            path, "qwen2", async_write=True,
+            post_write=lambda: flag.append(1),
+        )
+        assert t is not None
+        t.join()
+        assert t._areal_exc is None
+        assert flag == [1]
+        assert os.path.exists(os.path.join(path, "model.safetensors"))
+
+    def test_async_write_failure_is_stored_not_swallowed(
+        self, engine, monkeypatch
+    ):
+        """Review finding r5: a failed background write must surface to
+        the joiner (trainer's _join_publish raises), not die silently."""
+        from areal_tpu.models import hf as hf_conv
+
+        def boom(*a, **k):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(hf_conv, "save_hf_checkpoint", boom)
+        t = engine.save_hf("/tmp/nowhere_ckpt", "qwen2", async_write=True)
+        t.join()
+        assert isinstance(t._areal_exc, OSError)
