@@ -27,7 +27,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_world(num_processes, local_devices, outs, n_mbs=1, timeout=240):
+def _run_world(num_processes, local_devices, outs, n_mbs=1, timeout=240,
+               extra=()):
     """Launch an N-process training world; returns parsed rank-0 output."""
     port = _free_port()
     env = dict(os.environ)
@@ -46,6 +47,7 @@ def _run_world(num_processes, local_devices, outs, n_mbs=1, timeout=240):
             "--n-mbs", str(n_mbs),
             "--out", outs[pid],
         ]
+        cmd += list(extra)
         if num_processes > 1:
             cmd += ["--coordinator", f"localhost:{port}"]
         procs.append(
@@ -53,7 +55,14 @@ def _run_world(num_processes, local_devices, outs, n_mbs=1, timeout=240):
                 cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT
             )
         )
-    logs = [p.communicate(timeout=timeout)[0].decode() for p in procs]
+    try:
+        logs = [p.communicate(timeout=timeout)[0].decode() for p in procs]
+    finally:
+        # a hung world (collective straddle) must not leak live ranks into
+        # the rest of the session
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for p, log in zip(procs, logs):
         assert p.returncode == 0, f"rank {procs.index(p)} failed:\n{log[-3000:]}"
     with open(outs[0]) as f:
@@ -90,3 +99,43 @@ def test_two_process_grad_accumulation(tmp_path):
     )
     for a, b in zip(single["losses"], dist["losses"]):
         assert a == pytest.approx(b, rel=2e-4)
+
+
+@pytest.mark.slow
+def test_four_process_uneven_hosts_with_straggler(tmp_path):
+    """VERDICT r4 weak #6: N>2 world with UNEVEN per-host batches (10 items
+    over 4 hosts -> 3/3/2/2), an injected straggler rank, and per-host
+    control-state divergence — the trajectory must match the single-process
+    baseline and every rank must take process 0's control branch."""
+    outs = [str(tmp_path / f"r{i}.json") for i in range(4)]
+    single = _run_world(
+        1, 8, [str(tmp_path / "single.json")],
+        extra=["--n-items", "10"],
+    )
+    dist = _run_world(
+        4, 2, outs, timeout=420,
+        extra=["--n-items", "10", "--slow-rank", "2", "--slow-secs", "0.3",
+               "--out-all-ranks"],
+    )
+    assert dist["process_count"] == 4 and dist["device_count"] == 8
+    ranks = [json.load(open(o)) for o in outs]
+    # uneven feeding: strided split of 10 items over 4 hosts
+    assert [r["n_local_items"] for r in ranks] == [3, 3, 2, 2]
+    # same global batch => same trajectory as the single-process world,
+    # straggler or not (collectives synchronize; only wall time differs)
+    for a, b in zip(single["losses"], dist["losses"]):
+        assert a == pytest.approx(b, rel=2e-4)
+    # every rank observed the SAME decision sequence — process 0's local
+    # flags — even though local flags diverged across ranks every step
+    decided = [[d for _, d in r["decisions"]] for r in ranks]
+    assert all(seq == decided[0] for seq in decided[1:])
+    local0 = [l for l, _ in ranks[0]["decisions"]]
+    assert decided[0] == local0
+    diverged = any(
+        l != local0[i]
+        for r in ranks[1:]
+        for i, (l, _) in enumerate(r["decisions"])
+    )
+    assert diverged  # the predicate really did differ across ranks
+    # cross-host stats reduction over 4 ranks: mean(0,1,2,3)
+    assert dist["rank_sum"] == pytest.approx(1.5)
