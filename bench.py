@@ -605,6 +605,7 @@ def main():
 
     from areal_tpu.models.config import ModelConfig
 
+    t_bench0 = time.perf_counter()  # deadline clock covers probe + primary
     peak = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))  # v5e bf16
     # BENCH_SECTIONS=gen,ppo runs a subset (fast iteration); default: all
     sections = os.environ.get("BENCH_SECTIONS", "").split(",")
@@ -692,20 +693,33 @@ def main():
         cfg_small, remat_policy="none", layer_scan_unroll=12,
         attn_max_seqlen=None,
     )
-    for name, fn in (
-        ("ctx8k", lambda: _bench_shape(cfg_8k, [8192], n_steps=8, peak=peak)),
-        ("ctx32k", lambda: _bench_shape(cfg_32k, [32768], n_steps=4, peak=peak)),
+    # soft deadline: if the driver caps bench wall time, a section that
+    # would start too late is skipped (recorded as such) rather than
+    # risking the whole run being killed before the JSON line prints
+    deadline = float(os.environ.get("BENCH_DEADLINE_S", 2700))
+    for name, fn, optional in (
+        ("ctx8k",
+         lambda: _bench_shape(cfg_8k, [8192], n_steps=8, peak=peak), False),
+        ("ctx32k",
+         lambda: _bench_shape(cfg_32k, [32768], n_steps=4, peak=peak), False),
         ("b1", lambda: _bench_shape(
             cfg_1b, [512] * 8, n_steps=8, peak=peak, param_dtype="bfloat16"
-        )),
-        ("gen", lambda: _bench_gen(peak_bw, peak)),
-        ("gen32k", lambda: _bench_gen_32k(peak_bw, peak)),
-        ("bwd_pipe", lambda: _bench_bwd_pipe(cfg_small, cfg_32k, peak)),
-        ("ppo", lambda: _bench_async_ppo(peak)),
-        ("ppo_1p5b", lambda: _bench_async_ppo_1p5b(peak)),
-        ("system_ppo", lambda: _bench_system_ppo()),
+        ), False),
+        ("gen", lambda: _bench_gen(peak_bw, peak), False),
+        ("gen32k", lambda: _bench_gen_32k(peak_bw, peak), False),
+        ("ppo", lambda: _bench_async_ppo(peak), False),
+        ("ppo_1p5b", lambda: _bench_async_ppo_1p5b(peak), False),
+        ("system_ppo", lambda: _bench_system_ppo(), False),
+        # pure A/B diagnostics go LAST: if the deadline trips, the
+        # pipeline flag simply stays at its measured-default setting
+        ("bwd_pipe",
+         lambda: _bench_bwd_pipe(cfg_small, cfg_32k, peak), True),
     ):
         if not want(name):
+            continue
+        elapsed = time.perf_counter() - t_bench0
+        if optional and elapsed > deadline:
+            detail[name] = {"skipped": f"deadline ({elapsed:.0f}s elapsed)"}
             continue
         try:  # keep the primary metric even if a shape OOMs
             detail[name] = fn()
