@@ -134,7 +134,9 @@ class GenerationHTTPServer:
                     )
                     os._exit(1)
             if self.engine.paused or (
-                not self.engine._pending and self.engine.n_running() == 0
+                not self.engine._pending
+                and self.engine.n_running() == 0
+                and not self.engine.has_inflight
             ):
                 await asyncio.sleep(0.005)
                 continue

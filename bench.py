@@ -158,9 +158,12 @@ def _kv_bytes_per_token(cfg) -> float:
     return 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * 2  # k+v, bf16
 
 
-def _bench_gen(peak_bw: float, peak: float):
+def _bench_gen(peak_bw: float, peak: float, pipelined: bool = False):
     """Prefill + decode tokens/s at realistic occupancy: 64 slots, 1k
-    prompts, 512 generated tokens each."""
+    prompts, 512 generated tokens each. ``pipelined=True`` A/Bs the
+    chunk-pipelined engine (harvest one chunk late so the per-chunk host
+    sync overlaps compute); its decode window is drain-bounded so both
+    modes time exactly N_CHUNKS of device work."""
     import jax
 
     from areal_tpu.base import flops as flops_mod
@@ -174,6 +177,7 @@ def _bench_gen(peak_bw: float, peak: float):
         max_slots=B, max_seqlen=2048,
         max_new_tokens_cap=64 + D_STEPS * (N_CHUNKS + 1),
         page_size=128, enable_prefix_cache=False, admit_chunk_tokens=1024,
+        pipeline_chunks=pipelined,
     )
     rng = np.random.default_rng(0)
 
@@ -202,12 +206,26 @@ def _bench_gen(peak_bw: float, peak: float):
     submit_all()
     t0 = time.perf_counter()
     eng.step(decode_steps=1)           # admission: all 64 prefills + 1 decode
+    if pipelined:
+        # the pipelined step returns at dispatch; drain so t_prefill
+        # covers the actual prefill work like the unpipelined path
+        jax.device_get(eng.state.lens)
     t_prefill = time.perf_counter() - t0
     eng.step(decode_steps=D_STEPS)     # throwaway: first post-admission
-    t0 = time.perf_counter()           # chunk carries one-time re-layout
-    for _ in range(N_CHUNKS):
-        eng.step(decode_steps=D_STEPS)
-    t_decode = time.perf_counter() - t0
+    if pipelined:                      # chunk carries one-time re-layout
+        # steps return at dispatch here: bound the window with drains so
+        # exactly N_CHUNKS of device work is inside it
+        jax.device_get(eng.state.lens)
+        t0 = time.perf_counter()
+        for _ in range(N_CHUNKS):
+            eng.step(decode_steps=D_STEPS)
+        jax.device_get(eng.state.lens)
+        t_decode = time.perf_counter() - t0
+    else:
+        t0 = time.perf_counter()
+        for _ in range(N_CHUNKS):
+            eng.step(decode_steps=D_STEPS)
+        t_decode = time.perf_counter() - t0
     eng.pause()
 
     prefill_tok_s = B * (PLEN - 1) / t_prefill
@@ -711,7 +729,8 @@ def main():
         ("ppo_1p5b", lambda: _bench_async_ppo_1p5b(peak), False),
         ("system_ppo", lambda: _bench_system_ppo(), False),
         # pure A/B diagnostics go LAST: if the deadline trips, the
-        # pipeline flag simply stays at its measured-default setting
+        # pipeline flags simply stay at their measured-default settings
+        ("gen_pipe", lambda: _bench_gen(peak_bw, peak, pipelined=True), True),
         ("bwd_pipe",
          lambda: _bench_bwd_pipe(cfg_small, cfg_32k, peak), True),
     ):
