@@ -1,0 +1,122 @@
+"""Minimal in-process stand-in for the ``ray`` package (test asset).
+
+Implements exactly the surface ``RaySchedulerClient`` consumes —
+``init``/``is_initialized``, the ``@ray.remote`` decorator with
+``.options(...).remote(...)``, ``wait``/``get``/``cancel``, and
+``exceptions.TaskCancelledError`` — executing each remote task in a
+forked daemon process. ``cancel`` delivers SIGINT so the task's
+``finally`` block runs (the client relies on it to SIGTERM the worker's
+process group), like Ray's non-force cancel raising inside the task.
+"""
+
+import multiprocessing as mp
+import os
+import signal
+import time
+
+_CTX = mp.get_context("fork")  # remote fns are closures: not picklable
+_inited = False
+
+
+class TaskCancelledError(Exception):
+    pass
+
+
+class exceptions:  # noqa: N801 - mirrors ray.exceptions
+    TaskCancelledError = TaskCancelledError
+
+
+def init(address=None, runtime_env=None, ignore_reinit_error=True, **kw):
+    global _inited
+    _inited = True
+
+
+def is_initialized():
+    return _inited
+
+
+class _Ref:
+    def __init__(self, fn, args, name):
+        self.name = name
+        self._q = _CTX.Queue()
+        self._result = None     # ("ok", rc) | ("err", msg) | ("cancelled",)
+        self._proc = _CTX.Process(
+            target=self._entry, args=(fn, args), daemon=True
+        )
+        self._proc.start()
+
+    def _entry(self, fn, args):
+        try:
+            rc = fn(*args)
+            self._q.put(("ok", rc))
+        except KeyboardInterrupt:
+            self._q.put(("cancelled", None))
+        except BaseException as e:  # noqa: BLE001
+            self._q.put(("err", repr(e)))
+        finally:
+            # flush the queue's feeder thread BEFORE the hard exit (which
+            # skips the parent's jax-laden atexit machinery)
+            self._q.close()
+            self._q.join_thread()
+            os._exit(0)
+
+    def _poll(self):
+        if self._result is None:
+            try:
+                self._result = self._q.get_nowait()
+            except Exception:
+                if not self._proc.is_alive():
+                    # died without reporting (SIGKILL): a moment for a
+                    # late queue flush, then record the crash
+                    time.sleep(0.05)
+                    try:
+                        self._result = self._q.get_nowait()
+                    except Exception:
+                        self._result = ("err", "task process died")
+        return self._result is not None
+
+
+class _RemoteFunction:
+    def __init__(self, fn, opts=None):
+        self._fn = fn
+        self._opts = opts or {}
+
+    def options(self, **kw):
+        return _RemoteFunction(self._fn, {**self._opts, **kw})
+
+    def remote(self, *args):
+        return _Ref(self._fn, args, self._opts.get("name", "task"))
+
+
+def remote(fn):
+    return _RemoteFunction(fn)
+
+
+def wait(refs, timeout=None):
+    t0 = time.monotonic()
+    while True:
+        ready = [r for r in refs if r._poll()]
+        if ready or timeout is not None and time.monotonic() - t0 >= timeout:
+            return ready, [r for r in refs if r not in ready]
+        time.sleep(0.02)
+
+
+def get(ref):
+    while not ref._poll():
+        time.sleep(0.02)
+    kind, val = ref._result
+    if kind == "ok":
+        return val
+    if kind == "cancelled":
+        raise TaskCancelledError(ref.name)
+    raise RuntimeError(val)
+
+
+def cancel(ref, force=False):
+    if ref._proc.is_alive():
+        # non-force: SIGINT -> KeyboardInterrupt inside the task, its
+        # finally runs (the scheduler client kills the worker's pgroup)
+        try:
+            os.kill(ref._proc.pid, signal.SIGKILL if force else signal.SIGINT)
+        except ProcessLookupError:
+            pass  # exited between is_alive() and the kill

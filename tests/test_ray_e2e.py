@@ -1,0 +1,113 @@
+"""Fake-ray e2e: ``RaySchedulerClient`` driven against an in-process
+``ray`` stand-in (tests/fake_ray) — submit → RUNNING → COMPLETED /
+FAILED, and cancel semantics including the client's process-group kill
+(``scheduler/client.py`` RaySchedulerClient; counterpart of the
+reference's Ray actor fleet, ``training/utils.py:119-254``)."""
+
+import os
+import sys
+import time
+
+import pytest
+
+FAKE_RAY = os.path.join(os.path.dirname(__file__), "fake_ray")
+
+
+def _purge_ray_modules():
+    for m in [m for m in sys.modules if m == "ray" or m.startswith("ray.")]:
+        sys.modules.pop(m)
+
+
+@pytest.fixture
+def ray_client(monkeypatch):
+    # the fake must win the import BEFORE the client imports ray; purge any
+    # previously imported copy so tests are order-independent — and purge
+    # again on teardown so later tests never silently get the stand-in
+    monkeypatch.syspath_prepend(FAKE_RAY)
+    _purge_ray_modules()
+    from areal_tpu.scheduler.client import RaySchedulerClient
+
+    cli = RaySchedulerClient("raye2e", "t0")
+    assert cli._ray.__file__.startswith(FAKE_RAY), "real ray imported?"
+    yield cli
+    _purge_ray_modules()
+
+
+def test_ray_job_lifecycle(ray_client, tmp_path):
+    from areal_tpu.scheduler.client import JobState
+
+    out = tmp_path / "done.txt"
+    ray_client.submit(
+        "writer",
+        [sys.executable, "-S", "-c",
+         f"import time; time.sleep(0.8); open({str(out)!r}, 'w').write('ok')"],
+    )
+    states = set()
+    for _ in range(200):
+        st = ray_client.find("writer").state
+        states.add(st)
+        if st == JobState.COMPLETED:
+            break
+        time.sleep(0.05)
+    assert JobState.RUNNING in states and JobState.COMPLETED in states
+    assert out.read_text() == "ok"
+
+
+def test_ray_failure_and_env(ray_client, tmp_path):
+    from areal_tpu.scheduler.client import JobException, JobState
+
+    out = tmp_path / "env.txt"
+    ray_client.submit(
+        "envw",
+        [sys.executable, "-S", "-c",
+         f"import os; open({str(out)!r}, 'w').write(os.environ['AREAL_X'])"],
+        env={"AREAL_X": "42"},
+    )
+    # envw must land BEFORE the failure: wait()'s failure path stop_all()s
+    # everything still running, which would race envw's file write
+    for _ in range(200):
+        if out.exists() and out.read_text():
+            break
+        time.sleep(0.05)
+    ray_client.submit(
+        "dier", [sys.executable, "-S", "-c", "import sys; sys.exit(3)"],
+    )
+    with pytest.raises(JobException) as ei:
+        ray_client.wait(timeout=30, poll=0.05)
+    assert ei.value.reason == JobState.FAILED
+    assert out.read_text() == "42"
+
+
+def test_ray_stop_kills_worker_process_group(ray_client, tmp_path):
+    """stop() must cancel the task AND take the worker subprocess down
+    with it (the client's finally/killpg contract — orphaned workers
+    would keep holding TPU devices across a restart)."""
+    from areal_tpu.scheduler.client import JobState
+
+    pidfile = tmp_path / "pid"
+    ray_client.submit(
+        "sleeper",
+        [sys.executable, "-S", "-c",
+         "import os, time; open(%r, 'w').write(str(os.getpid())); "
+         "time.sleep(120)" % str(pidfile)],
+    )
+    for _ in range(100):
+        if pidfile.exists() and pidfile.read_text():
+            break
+        time.sleep(0.05)
+    pid = int(pidfile.read_text())
+    ray_client.stop("sleeper")
+    for _ in range(100):
+        if ray_client.find("sleeper").state == JobState.CANCELLED:
+            break
+        time.sleep(0.1)
+    assert ray_client.find("sleeper").state == JobState.CANCELLED
+    # the worker process itself is gone (SIGTERM via the task's finally)
+    for _ in range(100):
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail(f"worker pid {pid} still alive after stop()")
