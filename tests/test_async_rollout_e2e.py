@@ -66,12 +66,19 @@ def _write_dataset(path, rng, n=6, plen=8):
             )
 
 
-async def test_async_rollout_end_to_end(tmp_path, rng):
+@pytest.mark.parametrize("pipelined", [False, True],
+                         ids=["plain", "pipelined"])
+async def test_async_rollout_end_to_end(tmp_path, rng, pipelined):
+    """Full async rollout loop; parametrized over the chunk-pipelined
+    decode mode (r5) so the deferred-harvest engine is exercised through
+    the REAL server + manager + partial-rollout world, not just unit
+    tests."""
     name_resolve.reset()
 
     # --- generation server (tiny model) --------------------------------
     params = tfm.init_params(CFG, jax.random.key(0))
-    eng = GenerationEngine(CFG, params, max_slots=4, max_seqlen=256, seed=0)
+    eng = GenerationEngine(CFG, params, max_slots=4, max_seqlen=256, seed=0,
+                           pipeline_chunks=pipelined)
     gen_port = network.find_free_port()
     gen_runner = await serve(eng, "127.0.0.1", gen_port, decode_steps=4)
     gen_url = f"http://127.0.0.1:{gen_port}"
