@@ -397,13 +397,18 @@ def _run_ppo_round_bench(
     from areal_tpu.train.engine import OptimizerConfig, TrainEngine
 
     N_PROMPTS, GROUP, PLEN, MAX_NEW = n_prompts, group, plen, max_new
+    # HBM at the 1.5B profile: params+grads+adam ~13.2 GiB bf16 leaves
+    # ~2.3 GiB for the gen engine + transients on a 16 GiB v5e — cap the
+    # slot count (requests queue through extra waves) so the KV pool
+    # stays inside it
+    max_slots = min(N_PROMPTS * GROUP, 16 if model != "125M" else 64)
     eng = TrainEngine(
         cfg, ParallelConfig(), OptimizerConfig(lr=1e-5), param_dtype="bfloat16"
     )
     eng.init_random(0)
     eng.setup_optimizer(100)
     gen = GenerationEngine(
-        cfg, eng.params, max_slots=N_PROMPTS * GROUP, max_seqlen=PLEN + MAX_NEW,
+        cfg, eng.params, max_slots=max_slots, max_seqlen=PLEN + MAX_NEW,
         max_new_tokens_cap=MAX_NEW, page_size=page_size, seed=0,
     )
     actor = make_interface("ppo_actor", hp=PPOHyperparameters(
