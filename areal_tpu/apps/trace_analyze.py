@@ -15,7 +15,56 @@ import argparse
 import json
 import sys
 
-from areal_tpu.base.trace_analyzer import analyze_xspace, find_xplane_files
+from areal_tpu.base.trace_analyzer import (
+    BUCKETS,
+    analyze_xspace,
+    find_xplane_files,
+)
+
+
+def _load(path):
+    if path.endswith(".xplane.pb"):
+        files = [path]
+    else:
+        files = find_xplane_files(path)
+    if not files:
+        print(f"no .xplane.pb under {path}", file=sys.stderr)
+        return None
+    summaries = []
+    for f in files:
+        summaries.extend(analyze_xspace(f))
+    if not summaries:
+        print(
+            f"{path}: xplane files parsed but no device/op plane found",
+            file=sys.stderr,
+        )
+    return summaries
+
+
+def _compare(a, b, top):
+    """Side-by-side bucket + top-op deltas of two runs' first planes —
+    the A/B reading (e.g. a kernel flag on vs off) rounds used to do by
+    hand across two analyzer dumps."""
+    sa, sb = a[0], b[0]
+    print(f"{'':<12} {'A (s)':>12} {'B (s)':>12} {'B/A':>7}")
+    ta, tb = sa.device_total_s, sb.device_total_s
+    rt = f"{tb / ta:7.3f}" if ta > 1e-12 else "      -"
+    print(f"{'device':<12} {ta:>12.6f} {tb:>12.6f} {rt}")
+    for k in BUCKETS:
+        va = sa.buckets_s.get(k, 0.0)
+        vb = sb.buckets_s.get(k, 0.0)
+        ratio = f"{vb / va:7.3f}" if va > 1e-12 else "      -"
+        print(f"{k:<12} {va:>12.6f} {vb:>12.6f} {ratio}")
+    ops_a = {n: s for n, s, _, _ in sa.top_ops}
+    ops_b = {n: s for n, s, _, _ in sb.top_ops}
+    print(f"\n{'top op':<48} {'A (s)':>10} {'B (s)':>10}")
+    seen = sorted(
+        set(list(ops_a)[:top]) | set(list(ops_b)[:top]),
+        key=lambda n: -(ops_a.get(n, 0.0) + ops_b.get(n, 0.0)),
+    )
+    for n in seen[:top]:
+        print(f"{n[:48]:<48} {ops_a.get(n, 0.0):>10.6f} "
+              f"{ops_b.get(n, 0.0):>10.6f}")
 
 
 def main(argv=None):
@@ -24,18 +73,23 @@ def main(argv=None):
                     "(or a .xplane.pb file)")
     ap.add_argument("--top", type=int, default=15)
     ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument(
+        "--compare", metavar="TRACE_B", default=None,
+        help="second trace: print bucket + top-op deltas (A/B a flag)",
+    )
     args = ap.parse_args(argv)
 
-    if args.trace_dir.endswith(".xplane.pb"):
-        files = [args.trace_dir]
-    else:
-        files = find_xplane_files(args.trace_dir)
-    if not files:
-        print(f"no .xplane.pb under {args.trace_dir}", file=sys.stderr)
+    summaries = _load(args.trace_dir)
+    if not summaries:
         return 1
-    summaries = []
-    for f in files:
-        summaries.extend(analyze_xspace(f))
+    if args.compare:
+        if args.as_json:
+            ap.error("--json is not supported with --compare")
+        other = _load(args.compare)
+        if not other:
+            return 1
+        _compare(summaries, other, args.top)
+        return 0
     if args.as_json:
         print(json.dumps([s.as_dict() for s in summaries], indent=2))
     else:

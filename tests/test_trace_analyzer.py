@@ -120,3 +120,13 @@ planes {
     assert s.n_events == 2
     names = [n for n, *_ in s.top_ops]
     assert "jit_train_step" not in names and "train_step" not in names
+
+
+def test_cli_compare(trace_dir, capsys):
+    from areal_tpu.apps.trace_analyze import main
+
+    assert main([trace_dir, "--compare", trace_dir, "--top", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "B/A" in out and "device" in out
+    # identical traces compare at ratio 1.000
+    assert "  1.000" in out
