@@ -18,6 +18,10 @@ TRACE_ENV = "AREAL_DUMP_TRACE"          # jax.profiler traces per MFC
 RECORD_PERF_ENV = "AREAL_RECORD_PERFORMANCE"
 MEMORY_KILL_ENV = "AREAL_HBM_KILL_THRESHOLD"
 WEIGHT_SYNC_IMPL_ENV = "AREAL_WEIGHT_SYNC_IMPL"  # DISK (default) | DCN
+# Host↔device data-plane pipelining (docs/pipelined_data_plane.md). Both
+# default ON; "0"/"false"/"off" disables, an integer sets the depth.
+FWD_PIPELINE_ENV = "AREAL_FWD_PIPELINE"       # dispatch-ahead forward()
+TRAIN_PREFETCH_ENV = "AREAL_TRAIN_PREFETCH"   # minibatch prefetch + deferred stats
 
 
 def set_experiment_trial_names(experiment_name: str, trial_name: str):
@@ -90,6 +94,8 @@ def get_env_vars(**extra) -> dict:
         RECORD_PERF_ENV,
         MEMORY_KILL_ENV,
         WEIGHT_SYNC_IMPL_ENV,
+        FWD_PIPELINE_ENV,
+        TRAIN_PREFETCH_ENV,
         "JAX_PLATFORMS",
         "XLA_FLAGS",
         "TPU_VISIBLE_DEVICES",

@@ -1,19 +1,84 @@
 """Multi-sink metric logging (≈ ``logging.log_swanlab_wandb_tensorboard`` in
-the reference, ``realhf/base/logging.py``).
+the reference, ``realhf/base/logging.py``) plus process-global pipeline
+counters.
 
 Sinks: stdout (always), tensorboardX (if importable), jsonl file (always —
 the judge/bench harness reads it). wandb/swanlab are not available in this
 image; the API accepts and ignores their configs.
+
+``counters`` instruments the host↔device data plane (dispatch-ahead
+forward, prefetched train minibatches, deferred stats fetches): cheap
+monotonic host counters the bench/tests read to PROVE overlap happened
+(e.g. ``fwd_pipe/max_in_flight`` ≥ 2) instead of inferring it from wall
+time alone.
 """
 
 import json
 import os
+import threading
 import time
 from typing import Dict, Optional
 
 from areal_tpu.base import logging
 
 logger = logging.getLogger("metrics")
+
+
+class CounterRegistry:
+    """Process-global named counters/gauges for data-plane observability.
+
+    Thread-safe (the train prefetcher packs on a background thread).
+    ``add`` accumulates, ``peak`` keeps a running maximum (pipeline depth),
+    ``snapshot``/``delta`` give dict views the trainer folds into its
+    per-step stats under ``pipe/``.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._vals: Dict[str, float] = {}
+
+    def add(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._vals[name] = self._vals.get(name, 0.0) + float(value)
+
+    def peak(self, name: str, value: float) -> None:
+        with self._lock:
+            if float(value) > self._vals.get(name, float("-inf")):
+                self._vals[name] = float(value)
+
+    def get(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._vals.get(name, default)
+
+    def clear(self, name: str) -> None:
+        """Drop one counter. Peaks (``peak``) are process-lifetime maxima —
+        a measurement that wants the peak OF ITS OWN interval must clear
+        the key at the interval start; snapshot-and-subtract is meaningless
+        for a maximum."""
+        with self._lock:
+            self._vals.pop(name, None)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._vals)
+
+    def delta(self, before: Dict[str, float]) -> Dict[str, float]:
+        """Per-interval view: current snapshot minus ``before`` (peaks are
+        reported as-is — a maximum has no meaningful difference)."""
+        now = self.snapshot()
+        return {
+            k: (v if k.endswith("max_in_flight") else v - before.get(k, 0.0))
+            for k, v in now.items()
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._vals.clear()
+
+
+# The process-global registry (≈ the reference's monotonic perf counters in
+# ``realhf/base/monitor.py``). Engines/trainers import this single instance.
+counters = CounterRegistry()
 
 
 class MetricLogger:
@@ -31,18 +96,35 @@ class MetricLogger:
             except ImportError:
                 pass
 
-    def log(self, data: Dict[str, float], step: int, prefix: Optional[str] = None):
+    def log(
+        self,
+        data: Dict[str, float],
+        step: int,
+        prefix: Optional[str] = None,
+        wall_time: Optional[float] = None,
+    ):
+        """``wall_time`` lets deferred-stats flushes stamp each step with the
+        time the step actually RAN (captured at step time), not the flush
+        time — steady-state rates derived from jsonl timestamps stay valid
+        when the trainer batches several steps into one device pull."""
         if prefix:
             data = {f"{prefix}/{k}": v for k, v in data.items()}
         if self._jsonl:
             self._jsonl.write(
-                json.dumps(dict(step=step, time=time.time(), **data)) + "\n"
+                json.dumps(
+                    dict(
+                        step=step,
+                        time=time.time() if wall_time is None else wall_time,
+                        **data,
+                    )
+                )
+                + "\n"
             )
             self._jsonl.flush()
         if self._tb:
             for k, v in data.items():
                 try:
-                    self._tb.add_scalar(k, v, step)
+                    self._tb.add_scalar(k, v, step, walltime=wall_time)
                 except Exception:
                     pass
 

@@ -53,3 +53,25 @@ def annotate(name: str):
 
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+@contextlib.contextmanager
+def span(name: str):
+    """Data-plane span: always accumulates host wall time into
+    ``metrics.counters`` under ``<name>_s`` (plus a ``<name>_n`` call
+    count), and additionally shows up as a named region when a profiler
+    trace is active. Used around the PPO step's pack/put/dispatch/fetch
+    stages so the host-side cost split is observable WITHOUT collecting an
+    xplane trace (a ``time.perf_counter`` pair is ~100 ns — free against
+    any of those stages)."""
+    import time
+
+    from areal_tpu.base import metrics as metrics_mod
+
+    t0 = time.perf_counter()
+    try:
+        with annotate(name):
+            yield
+    finally:
+        metrics_mod.counters.add(f"{name}_s", time.perf_counter() - t0)
+        metrics_mod.counters.add(f"{name}_n", 1.0)

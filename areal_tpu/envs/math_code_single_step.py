@@ -48,6 +48,15 @@ class MathCodeSingleStepEnv(EnvironmentService):
                 for a in answers
             ]
             return None, scores, True, False, {}
+        if task == "gpqa":
+            # multiple-choice grading is pure host string matching — never
+            # remoted, and it must not fall through to the code branch
+            # (which KeyErrors on meta['input_output'] for gpqa rows)
+            from areal_tpu.evaluation.grading import grade_gpqa_answer
+
+            golds = meta.get("solutions") or []
+            scores = [grade_gpqa_answer(a, golds) for a in answers]
+            return None, scores, True, False, {}
         if remote.ENABLED and remote.service_domain():
             if task == "math":
                 success = await remote.math_verify_remote(

@@ -24,18 +24,25 @@ from typing import Callable, List, Optional, Sequence, Tuple
 logger = logging.getLogger("areal_tpu.evaluation.grading")
 
 
+def grade_gpqa_answer(answer: str, gold_or_meta) -> float:
+    """The gpqa grading convention in ONE place (first gold of a solutions
+    list, stringified, through ``mcq.grade_choice``) — the env and the pool
+    must agree or the same samples report different reward_mean."""
+    from areal_tpu.evaluation.mcq import grade_choice
+
+    gold = gold_or_meta
+    if isinstance(gold, list):
+        gold = gold[0] if gold else ""
+    return grade_choice(answer, str(gold))
+
+
 def _default_grade_one(task: str, answer: str, gold_or_meta) -> float:
     if task == "code":
         from areal_tpu.rewards.code_verify import verify_code_solution
 
         return 1.0 if verify_code_solution(answer, gold_or_meta or {}) else -1.0
     if task == "gpqa":
-        from areal_tpu.evaluation.mcq import grade_choice
-
-        gold = gold_or_meta
-        if isinstance(gold, list):
-            gold = gold[0] if gold else ""
-        return grade_choice(answer, str(gold))
+        return grade_gpqa_answer(answer, gold_or_meta)
     from areal_tpu.rewards.math_verify import grade_math_answers
 
     golds = gold_or_meta if isinstance(gold_or_meta, list) else [gold_or_meta]
@@ -186,8 +193,19 @@ class PoolGrader:
                     progressed = True
                     continue
                 _, ridx, score = msg
-                if ridx == idx:
-                    scores[ridx] = score
+                if ridx != idx:
+                    # stale 'done' (an item already scored as timed out on
+                    # this channel): the worker is STILL grading `idx` —
+                    # freeing it here would strand `idx` at its 0.0
+                    # placeholder forever instead of letting the deadline
+                    # path record failure_score(task) for it
+                    logger.warning(
+                        "grading: dropped stale result for item %d "
+                        "(worker %d is grading item %d)", ridx, i, idx,
+                    )
+                    progressed = True
+                    continue
+                scores[ridx] = score
                 del busy[i]
                 dispatch(i)
                 progressed = True

@@ -25,6 +25,7 @@ from areal_tpu.api.model import ModelInterface, PPOHyperparameters
 from areal_tpu.ops import ppo as ppo_ops
 from areal_tpu.parallel import multihost
 from areal_tpu.train import batching
+from areal_tpu.train import engine as engine_mod
 from areal_tpu.train.engine import vmapped_forward, vmapped_next_token_logprobs
 
 
@@ -234,16 +235,17 @@ class PPOActorInterface(ModelInterface):
             multihost.allreduce_min(np.int64(min(hp.ppo_n_minibatches, sample.bs)))
         )
         mbs = sample.split(max(n_mb, 1))
-        all_stats = []
-        for mb in mbs:
-            stats = engine.train_batch(
-                mb, mb_spec, self._actor_loss_fn, fetch_stats=False
-            )
-            all_stats.append(stats)
+        # pipelined minibatch loop: pack+put of minibatch n+1 overlaps the
+        # in-flight jitted step for minibatch n (serial loop when
+        # AREAL_TRAIN_PREFETCH is off). No host collectives may run between
+        # these dispatches — ours (the kl_ctl allreduce) sit after the loop.
+        all_stats = engine.train_batches_pipelined(
+            mbs, mb_spec, self._actor_loss_fn, fetch_stats=False
+        )
         engine.version += 1
-        # one host pull for every minibatch's device scalars
-        all_stats = jax.device_get(all_stats)
-        out = {k: float(np.mean([s[k] for s in all_stats])) for k in all_stats[0]}
+        # minibatch-mean WITHOUT a device pull (deferred-stats path: the
+        # trainer fetches once per logging interval, not per step)
+        out = engine_mod.mean_stats_dicts(all_stats)
         # Adaptive KL control tracks policy-vs-reference divergence (the
         # signed masked mean over action tokens), like the reference
         # (ppo_interface.py:973-978) — NOT the PPO update KL. The update is
@@ -256,6 +258,9 @@ class PPOActorInterface(ModelInterface):
         out["kl_ctl"] = self.kl_ctl.value
         out["ref_kl"] = ref_kl_global
         out["n_seqs"] = sample.bs
+        if not engine_mod.train_prefetch_enabled():
+            # legacy per-step blocking behavior for callers that asked for it
+            out = engine_mod.fetch_stats_dict(out)
         return out
 
 
@@ -330,10 +335,11 @@ class PPOCriticInterface(ModelInterface):
             multihost.allreduce_min(np.int64(min(hp.ppo_n_minibatches, sample.bs)))
         )
         mbs = sample.split(max(n_mb, 1))
-        all_stats = [
-            engine.train_batch(mb, mb_spec, self._critic_loss_fn, fetch_stats=False)
-            for mb in mbs
-        ]
+        all_stats = engine.train_batches_pipelined(
+            mbs, mb_spec, self._critic_loss_fn, fetch_stats=False
+        )
         engine.version += 1
-        all_stats = jax.device_get(all_stats)
-        return {k: float(np.mean([s[k] for s in all_stats])) for k in all_stats[0]}
+        out = engine_mod.mean_stats_dicts(all_stats)
+        if not engine_mod.train_prefetch_enabled():
+            out = engine_mod.fetch_stats_dict(out)
+        return out

@@ -92,6 +92,18 @@ def paged_decode_attention(
             and pages.shape[4] % 8 == 0
             and Hkv % tp == 0
         )
+    elif use_pallas and tp > 1 and Hkv % tp != 0:
+        # explicit use_pallas=True with an incompatible mesh: the shard_map
+        # below splits the kv-head axis over the model axis and cannot
+        # split a head — fail here with the real constraint instead of an
+        # opaque sharding error from inside the shard_map trace
+        raise ValueError(
+            f"paged_attention(use_pallas=True): {Hkv} kv heads are not "
+            f"divisible by the mesh's model axis ({tp}); the Pallas decode "
+            "kernel shards whole kv-head groups. Use a model axis that "
+            "divides n_kv_heads, or pass use_pallas=False for the XLA "
+            "gather path."
+        )
     if use_pallas:
         from areal_tpu.ops.pallas import paged_attention as pl_paged
 

@@ -270,9 +270,6 @@ def _latex_to_expr(s: str) -> str:
     )
     # \binom{n}{k} -> binomial(n, k)
     s = re.sub(r"\\binom\{([^{}]*)\}\{([^{}]*)\}", r"binomial(\1, \2)", s)
-    # floor/ceiling delimiters (latex2sympy floor_test/ceil_test grammar)
-    s = re.sub(r"\\lfloor([^\\]*)\\rfloor", r"floor(\1)", s)
-    s = re.sub(r"\\lceil([^\\]*)\\rceil", r"ceiling(\1)", s)
     # a \mod b / a \pmod{b} (mod_test grammar): unbrace the \pmod argument,
     # then rewrite to python's %, whose MULTIPLICATIVE precedence matches
     # latex2sympy's mp-level mod rule ('3 + 7 \mod 4' == 3 + Mod(7,4), not
@@ -294,6 +291,17 @@ def _latex_to_expr(s: str) -> str:
         r"exp|min|max|gcd|lcm)\b",
         r"\1", s,
     )
+    # floor/ceiling delimiters (latex2sympy floor_test/ceil_test grammar).
+    # AFTER every inner-command rewrite (\frac, \log, \sin, \mod, …) so the
+    # argument is already plain-expression text; non-greedy with a
+    # no-inner-opener guard, innermost-first for nesting — the old
+    # ``[^\\]*`` match could not cross a backslash and left
+    # ``\lfloor \log_2 8 \rfloor``-style answers untranslated (ADVICE r5 #2)
+    prev = None
+    while prev != s:
+        prev = s
+        s = re.sub(r"\\lfloor((?:(?!\\lfloor).)*?)\\rfloor", r"floor(\1)", s)
+        s = re.sub(r"\\lceil((?:(?!\\lceil).)*?)\\rceil", r"ceiling(\1)", s)
     # sums / integrals as ANSWERS (rare but latex2sympy-grammar): the rest
     # of the string is the summand/integrand. LITERAL bounds only, sum span
     # capped — a model-controlled \sum_{i=1}^{10^9} (or symbolic bounds)

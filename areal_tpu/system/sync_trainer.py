@@ -29,7 +29,7 @@ from areal_tpu.parallel import multihost
 from areal_tpu.rewards.math_verify import grade_math_answers
 from areal_tpu.system.function_executor import FunctionExecutor
 from areal_tpu.system.trainer_worker import TrainerControl
-from areal_tpu.train.engine import TrainEngine
+from areal_tpu.train.engine import TrainEngine, fetch_stats_dict
 from areal_tpu.train.generation import SyncGenerator, SyncGenOutput
 
 logger = logging.getLogger("areal_tpu.sync_trainer")
@@ -187,6 +187,10 @@ class SyncPPOTrainerWorker:
         batch = SequenceSample.gather(items)
 
         stats = self.executor.run(batch)
+        # the sync loop blocks on generation every step anyway, so the
+        # deferred-stats discipline buys nothing here — pull all device
+        # scalars in ONE transfer and keep per-step host floats
+        stats = fetch_stats_dict(stats)
         stats["timeperf/gen"] = t_gen
         stats["timeperf/e2e"] = time.perf_counter() - t0
         if "flops" in stats:  # train-side FLOPs only (gen not counted)

@@ -33,6 +33,7 @@ from areal_tpu.experiments import graphs
 from areal_tpu.system.buffer import SequenceBuffer
 from areal_tpu.system.function_executor import FunctionExecutor
 from areal_tpu.base import constants, hbm, name_resolve, names, recover, tracing
+from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.base.metrics import MetricLogger
 from areal_tpu.base.timeutil import EpochStepTimeFreqCtl
 from areal_tpu.parallel import multihost
@@ -51,6 +52,11 @@ class TrainerControl:
     ckpt_freq_steps: Optional[int] = 50          # recover checkpoint
     ckpt_freq_secs: Optional[float] = 600.0
     weight_sync_freq_steps: int = 1              # fleet weight push cadence
+    # device-scalar stats are pulled to host once per this many steps (ONE
+    # batched device_get), not once per step — each pull is a full host
+    # round trip that stalls the dispatch pipeline. Inactive (per-step
+    # fetch) when AREAL_TRAIN_PREFETCH is off.
+    stats_log_freq_steps: int = 8
 
 
 class AsyncPPOTrainerWorker:
@@ -137,6 +143,10 @@ class AsyncPPOTrainerWorker:
         self._ckpt_ctl = EpochStepTimeFreqCtl(
             freq_step=control.ckpt_freq_steps, freq_sec=control.ckpt_freq_secs
         )
+        # deferred-stats buffer: (step, wall_time, stats-with-device-scalars)
+        # triples awaiting the per-logging-interval device_get
+        self._pending_stats: List = []
+        self._counters_before = metrics_mod.counters.snapshot()
 
     # ------------------------------------------------------------------ #
     # weight sync + counters (the async critical path, §3.5)
@@ -280,6 +290,16 @@ class AsyncPPOTrainerWorker:
             stats["tflops_per_sec"] = (
                 stats.pop("flops") / max(stats["timeperf/e2e"], 1e-9) / 1e12
             )
+        # data-plane observability: this step's pipeline counter deltas
+        # (dispatch-ahead depth, device-idle gap, pack/put/fetch spans)
+        stats.update({
+            f"pipe/{k}": v
+            for k, v in metrics_mod.counters.delta(self._counters_before).items()
+        })
+        # peaks are lifetime maxima — clear per step so the next step's
+        # reported depth reflects ITS forwards, not an earlier step's
+        metrics_mod.counters.clear("fwd_pipe/max_in_flight")
+        self._counters_before = metrics_mod.counters.snapshot()
         n_tokens = sum(
             sum(inner) for inner in sample.seqlens[sample.main_key()]
         )
@@ -309,12 +329,42 @@ class AsyncPPOTrainerWorker:
         # hosts must not split the control flow
         if multihost.main_decides(self._ckpt_ctl.check(steps=1)):
             self.save_recover_checkpoint()
-        if self.metrics is not None and multihost.is_main():
-            self.metrics.log(
-                {k: v for k, v in stats.items() if np.isscalar(v)}, self.step,
-                prefix="ppo",
-            )
+        # Deferred stats: device scalars in `stats` are NOT pulled here —
+        # they queue (with this step's wall-clock, for honest jsonl
+        # timestamps) and flush as ONE device_get per logging interval, so
+        # the train loop never blocks on a per-step host round trip.
+        self._pending_stats.append((self.step, time.time(), stats))
+        from areal_tpu.train.engine import train_prefetch_enabled
+
+        flush_every = (
+            max(self.control.stats_log_freq_steps, 1)
+            if train_prefetch_enabled()
+            else 1
+        )
+        if len(self._pending_stats) >= flush_every:
+            self.flush_stats()
         return stats
+
+    def flush_stats(self):
+        """Pull every pending step's device scalars in ONE transfer and log
+        them with their original per-step timestamps."""
+        if not self._pending_stats:
+            return
+        import jax
+
+        from areal_tpu.train.engine import host_stats_view
+
+        pending, self._pending_stats = self._pending_stats, []
+        metrics_mod.counters.add("train_pipe/stats_flushes", 1)
+        with tracing.span("train_pipe/stats_fetch_deferred"):
+            fetched = jax.device_get([s for (_, _, s) in pending])
+        for (step, wall, _), stats in zip(pending, fetched):
+            host = host_stats_view(stats)
+            if self.metrics is not None and multihost.is_main():
+                self.metrics.log(
+                    {k: v for k, v in host.items() if np.isscalar(v)},
+                    step, prefix="ppo", wall_time=wall,
+                )
 
     def run(self):
         try:
@@ -323,10 +373,19 @@ class AsyncPPOTrainerWorker:
                     logger.warning("no data from rollout stream; stopping")
                     break
         finally:
-            # the final version must land before exit — and a crashed
+            # trailing deferred stats must land in the jsonl before exit
+            # (the bench/judge reads it) — best-effort: after a device-side
+            # crash the pending device_get raises again, and that secondary
+            # failure must not mask the original exception from run_step.
+            # Then the final version must land before exit — and a crashed
             # run_step must not leave the daemon writer to be killed
-            # mid-file on interpreter teardown
-            self._join_publish()
+            # mid-file on interpreter teardown.
+            try:
+                self.flush_stats()
+            except Exception:
+                logger.exception("deferred stats flush failed at exit")
+            finally:
+                self._join_publish()
         return self.step
 
     # ------------------------------------------------------------------ #

@@ -14,7 +14,9 @@ segment's token span so every device array is uniformly ``[n_rows, capacity]``
 """
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+import queue
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -205,6 +207,81 @@ def empty_like(pb: PackedBatch) -> PackedBatch:
         n_rows=pb.n_rows,
         capacity=pb.capacity,
     )
+
+
+class Prefetcher:
+    """Bounded background producer: computes ``fn(item)`` for upcoming items
+    on a packer thread while the consumer works on the current one.
+
+    The train data plane uses this with ``depth=1`` (one-deep queue): the
+    pack + ``device_put`` of minibatch n+1 overlaps the in-flight jitted
+    step for minibatch n. All ``fn`` calls run on ONE thread in item order,
+    so host-collective sequences inside ``fn`` (multi-host micro-batch
+    agreements) keep their global ordering — but callers must not issue
+    OTHER host collectives on the consumer thread while iterating (see
+    docs/pipelined_data_plane.md; the trainer interfaces honor this by
+    placing their allreduces before/after the minibatch loop).
+
+    A producer exception is re-raised at the consumer's ``next()`` for the
+    failing item, so errors surface at the same call site as the serial
+    loop's.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, items: Iterable, fn: Callable, depth: int = 1):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        self._fn = fn
+        self._items = iter(items)
+        self._cancelled = False
+        self._thread = threading.Thread(
+            target=self._produce, name="areal-train-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _put(self, msg) -> bool:
+        """Bounded put that gives up when the consumer cancelled — a plain
+        ``q.put`` would block forever (pinning prepared device buffers)
+        once an abandoned consumer stops draining the queue."""
+        while not self._cancelled:
+            try:
+                self._q.put(msg, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self):
+        try:
+            for item in self._items:
+                if self._cancelled or not self._put(("ok", self._fn(item))):
+                    return
+        except BaseException as e:  # surfaced at the consumer
+            self._put(("err", e))
+            return
+        self._put(("end", self._SENTINEL))
+
+    def close(self):
+        """Release the producer: consumers that stop iterating early (an
+        exception mid-loop) MUST call this or the packer thread would stay
+        blocked on the full queue for the life of the process."""
+        self._cancelled = True
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                return
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        kind, payload = self._q.get()
+        if kind == "err":
+            raise payload
+        if kind == "end":
+            raise StopIteration
+        return payload
 
 
 def count_action_tokens(pb: PackedBatch) -> float:

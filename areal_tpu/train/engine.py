@@ -15,9 +15,12 @@ Losses/outputs are supplied by interfaces as pure functions
 ``loss_fn`` argument to ``train_batch``.
 """
 
+import collections
 import dataclasses
 import functools
-from typing import Any, Callable, Dict, List, Optional, Tuple
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +29,8 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.base import constants, tracing
+from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.models.config import ModelConfig
 from areal_tpu.models import transformer as tfm
 from areal_tpu.parallel import multihost
@@ -41,13 +46,86 @@ LossFn = Callable[[Any, ModelConfig, Dict[str, jnp.ndarray]], Tuple[jnp.ndarray,
 OutputFn = Callable[[Any, ModelConfig, Dict[str, jnp.ndarray]], jnp.ndarray]
 
 
-def fetch_stats_dict(stats: Dict[str, Any]) -> Dict[str, float]:
-    """Pull every device scalar in one transfer (a per-scalar ``float()``
-    costs a full host round trip on remote accelerators)."""
-    host = jax.device_get(stats)
+def _env_knob(name: str, default_depth: int) -> int:
+    """Parse a pipeline env knob: unset/"true"/"on" -> default depth,
+    "false"/"off" -> 0 (disabled), an integer -> exactly that depth
+    (so "1" really means depth 1, the serial discipline — not "enabled")."""
+    v = os.environ.get(name)
+    if v is None or v.strip() in ("", "true", "on"):
+        return default_depth
+    if v.strip().lower() in ("false", "off"):
+        return 0
+    try:
+        return max(int(v), 0)
+    except ValueError:
+        return default_depth
+
+
+def fwd_pipeline_depth() -> int:
+    """Micro-batches kept in flight by :meth:`TrainEngine.forward` (the
+    dispatch-ahead window). Default 2: dispatch mb i+1 before fetching mb i,
+    so the device never idles on the host's fetch→unpack round trip. 0/1 =
+    the serial path."""
+    return _env_knob(constants.FWD_PIPELINE_ENV, 2)
+
+
+def train_prefetch_enabled() -> bool:
+    """Gates BOTH halves of the train-side pipeline: background pack+put
+    prefetch of minibatch n+1 under the in-flight step for minibatch n, and
+    the deferred (per-logging-interval, not per-step) stats fetch."""
+    return _env_knob(constants.TRAIN_PREFETCH_ENV, 1) > 0
+
+
+def host_stats_view(host: Dict[str, Any]) -> Dict[str, float]:
+    """Normalize an already-fetched stats dict: 0-d leaves become python
+    floats, everything else passes through. ONE definition shared by the
+    blocking fetch below and the trainer's deferred flush, so the two paths
+    can never drift in how they render scalars."""
     return {
         k: (float(v) if np.ndim(v) == 0 else v) for k, v in host.items()
     }
+
+
+def fetch_stats_dict(stats: Dict[str, Any]) -> Dict[str, float]:
+    """Pull every device scalar in one transfer (a per-scalar ``float()``
+    costs a full host round trip on remote accelerators)."""
+    metrics_mod.counters.add("stats_fetch/blocking", 1)
+    with tracing.span("train_pipe/stats_fetch"):
+        host = jax.device_get(stats)
+    return host_stats_view(host)
+
+
+def mean_stats_dicts(all_stats: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Mean per-key over a list of stats dicts WITHOUT a device pull: device
+    scalars are averaged by a (tiny, async) on-device stack+mean, host
+    scalars by numpy. Interfaces use this to merge per-minibatch stats while
+    deferring the single blocking ``device_get`` to the trainer's logging
+    interval (``np.mean`` over jax scalars would implicitly block)."""
+    if len(all_stats) == 1:
+        return dict(all_stats[0])
+    out: Dict[str, Any] = {}
+    for k in all_stats[0]:
+        vs = [s[k] for s in all_stats]
+        if any(isinstance(v, jax.Array) for v in vs):
+            out[k] = jnp.mean(
+                jnp.stack([jnp.asarray(v, jnp.float32) for v in vs])
+            )
+        else:
+            out[k] = float(np.mean(vs))
+    return out
+
+
+@dataclasses.dataclass
+class PreparedTrainBatch:
+    """Host-prepared input of one optimizer step: stacked device buffers
+    (transfer already dispatched) + normalized per-micro-batch loss weights.
+    Produced by :meth:`TrainEngine.prepare_train_batch`, consumed by
+    :meth:`TrainEngine.train_prepared` — the seam the minibatch prefetcher
+    pipelines across."""
+
+    stacked: Dict[str, jax.Array]
+    weights: np.ndarray
+    n_mbs: int
 
 
 @dataclasses.dataclass
@@ -634,6 +712,62 @@ class TrainEngine:
     # PipelinableEngine API (≈ model_api.py:514)
     # ------------------------------------------------------------------ #
 
+    def prepare_train_batch(
+        self,
+        sample: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        loss_weight_fn: Callable[[batching.PackedBatch], float] = None,
+    ) -> "PreparedTrainBatch":
+        """The HOST half of one optimizer step: micro-batch split + packing
+        + the stacked ``device_put``. Split out of :meth:`train_batch` so a
+        prefetcher can run it for minibatch n+1 while the jitted step for
+        minibatch n is still in flight (the transfer is async — it overlaps
+        device compute, and the result handle is ready immediately).
+        """
+        if loss_weight_fn is None:
+            loss_weight_fn = batching.count_action_tokens
+        # Per-mb loss weights must be identical on every process (they enter
+        # the jit replicated), and the loss each mb computes inside pjit is
+        # already GLOBAL over all hosts' rows — so weight by the global
+        # action-token count of each micro-batch (gathered in the same
+        # round as the capacity agreement).
+        with tracing.span("train_pipe/pack"):
+            _, packed, weights = self._make_micro_batches(
+                sample, mb_spec, weight_fn=loss_weight_fn
+            )
+        weights = np.asarray(weights, np.float32)
+        total_w = weights.sum() or 1.0
+        weights = weights / total_w
+        with tracing.span("train_pipe/put"):
+            stacked = self._put_stacked(packed)
+        return PreparedTrainBatch(
+            stacked=stacked, weights=weights, n_mbs=len(packed)
+        )
+
+    def train_prepared(
+        self,
+        prep: "PreparedTrainBatch",
+        loss_fn: LossFn,
+        fetch_stats: bool = True,
+    ) -> Dict[str, Any]:
+        """The DEVICE half: dispatch the jitted step on an already-prepared
+        batch. Non-blocking with ``fetch_stats=False`` (outputs are async
+        futures; params/opt-state handles are valid for the next dispatch
+        immediately)."""
+        assert self.tx is not None, "call setup_optimizer() first"
+        step = self._get_jitted("train_step", loss_fn)
+        with tracing.span("train_pipe/dispatch"):
+            self.params, self.opt_state, out = step(
+                self.params, self.opt_state, prep.stacked,
+                jnp.asarray(prep.weights),
+            )
+        lr = self._lr_host(self._step)
+        self._step += 1
+        out = dict(out)
+        out["lr"] = lr
+        out["n_mbs"] = prep.n_mbs
+        return fetch_stats_dict(out) if fetch_stats else out
+
     def train_batch(
         self,
         sample: SequenceSample,
@@ -656,32 +790,61 @@ class TrainEngine:
         looping over minibatches fetch once at the end via
         :func:`fetch_stats_dict`.
         """
-        assert self.tx is not None, "call setup_optimizer() first"
-        if loss_weight_fn is None:
-            loss_weight_fn = batching.count_action_tokens
-        # Per-mb loss weights must be identical on every process (they enter
-        # the jit replicated), and the loss each mb computes inside pjit is
-        # already GLOBAL over all hosts' rows — so weight by the global
-        # action-token count of each micro-batch (gathered in the same
-        # round as the capacity agreement).
-        _, packed, weights = self._make_micro_batches(
-            sample, mb_spec, weight_fn=loss_weight_fn
-        )
-        weights = np.asarray(weights, np.float32)
-        total_w = weights.sum() or 1.0
-        weights = weights / total_w
+        prep = self.prepare_train_batch(sample, mb_spec, loss_weight_fn)
+        return self.train_prepared(prep, loss_fn, fetch_stats=fetch_stats)
 
-        step = self._get_jitted("train_step", loss_fn)
-        stacked = self._put_stacked(packed)
-        self.params, self.opt_state, out = step(
-            self.params, self.opt_state, stacked, jnp.asarray(weights)
+    def train_batches_pipelined(
+        self,
+        samples: Sequence[SequenceSample],
+        mb_spec: MicroBatchSpec,
+        loss_fn: LossFn,
+        loss_weight_fn: Callable[[batching.PackedBatch], float] = None,
+        fetch_stats: bool = False,
+    ) -> List[Dict[str, Any]]:
+        """One optimizer step per sample (the PPO minibatch loop), with the
+        pack + ``device_put`` of minibatch n+1 prefetched on a background
+        packer thread (one-deep queue) while the jitted step for minibatch n
+        is in flight — the host never sits between a finished step and the
+        next dispatch doing packing the device could have overlapped.
+
+        Multi-host: the packer thread's prepares run host collectives (the
+        micro-batch agreements ride ``process_allgather``, itself a global
+        device computation) while the consumer thread dispatches the global
+        jitted step — TWO threads enqueueing global computations interleave
+        nondeterministically per process, which multi-controller JAX
+        forbids (mismatched collective order deadlocks the pod). So
+        multi-host runs take the serial loop: prepare and dispatch stay on
+        one thread in a fixed global order, and the async jit dispatch
+        still overlaps device compute with the NEXT prepare's host work.
+        With ``AREAL_TRAIN_PREFETCH`` off this likewise degrades to exactly
+        the serial per-sample :meth:`train_batch` loop.
+        """
+        samples = list(samples)
+        if not samples:
+            return []
+        if not train_prefetch_enabled() or multihost.is_multihost():
+            return [
+                self.train_batch(
+                    s, mb_spec, loss_fn, loss_weight_fn=loss_weight_fn,
+                    fetch_stats=fetch_stats,
+                )
+                for s in samples
+            ]
+        metrics_mod.counters.add("train_pipe/prefetched_minibatches",
+                                 max(len(samples) - 1, 0))
+        prefetcher = batching.Prefetcher(
+            samples,
+            lambda s: self.prepare_train_batch(s, mb_spec, loss_weight_fn),
         )
-        lr = self._lr_host(self._step)
-        self._step += 1
-        out = dict(out)
-        out["lr"] = lr
-        out["n_mbs"] = len(packed)
-        return fetch_stats_dict(out) if fetch_stats else out
+        try:
+            return [
+                self.train_prepared(prep, loss_fn, fetch_stats=fetch_stats)
+                for prep in prefetcher
+            ]
+        finally:
+            # a consumer-side raise (HBM kill, jit error) must not leave the
+            # packer thread blocked on the queue holding device buffers
+            prefetcher.close()
 
     def eval_batch(
         self, sample: SequenceSample, mb_spec: MicroBatchSpec, loss_fn: LossFn
@@ -704,26 +867,83 @@ class TrainEngine:
         sample: SequenceSample,
         mb_spec: MicroBatchSpec,
         output_fn: OutputFn,
+        pipeline_depth: Optional[int] = None,
     ) -> List[np.ndarray]:
         """Token-aligned inference (logprob recompute, critic values, …).
         ``output_fn`` runs fully inside jit (e.g. forward + logprob gather so
         the [T, vocab] logits never leave the device). Returns one array per
         sequence, in the sample's original (item, seq) order — the micro-batch
-        split reorders items, so results are matched back via item ids."""
+        split reorders items, so results are matched back via item ids.
+
+        Dispatch-ahead pipeline (``AREAL_FWD_PIPELINE``, default depth 2):
+        up to ``pipeline_depth`` micro-batches stay in flight — mb i+1 is
+        dispatched BEFORE mb i's result is fetched, so the device works
+        through the queue while the host blocks in ``fetch_local_rows`` and
+        unpacks rows. Results are byte-identical to the serial path (same
+        jitted program, same inputs, only the host-side fetch order moves);
+        ``self._last_forward_events`` records the (dispatch|fetch, mb)
+        sequence and ``metrics.counters`` the realized depth, so tests and
+        the bench can PROVE overlap rather than infer it."""
+        depth = fwd_pipeline_depth() if pipeline_depth is None else pipeline_depth
         mbs, packed, _ = self._make_micro_batches(sample, mb_spec)
         fwd = self._get_jitted("forward", output_fn)
         by_key: Dict[Any, np.ndarray] = {}
-        # iterate over `packed` (not zip) — trailing multi-host padding
-        # batches have no local mb but every process must dispatch them
-        for i, pb in enumerate(packed):
-            out = multihost.fetch_local_rows(
-                fwd(self.params, self._put_batch(pb)), self.n_local_rows
-            )
+        events: List[Tuple[str, int]] = []
+        # device-idle-gap accounting: wall time spent with NOTHING dispatched
+        #-but-unfetched while more micro-batches remained — the host-side
+        # serialization the pipeline exists to remove
+        idle_gap = 0.0
+        drained_at: Optional[float] = None
+
+        def dispatch(i: int, pb):
+            nonlocal idle_gap, drained_at
+            with tracing.span("fwd_pipe/put"):
+                dev_in = self._put_batch(pb)
+            with tracing.span("fwd_pipe/dispatch"):
+                out_dev = fwd(self.params, dev_in)
+            if drained_at is not None:
+                # compute queue was empty from the previous fetch until this
+                # dispatch landed: pure host-serialization time
+                idle_gap += time.perf_counter() - drained_at
+                drained_at = None
+            events.append(("dispatch", i))
+            return out_dev
+
+        def collect(i: int, pb, out_dev, n_in_flight: int):
+            nonlocal drained_at
+            with tracing.span("fwd_pipe/fetch"):
+                out = multihost.fetch_local_rows(out_dev, self.n_local_rows)
+            events.append(("fetch", i))
+            if n_in_flight == 0 and i + 1 < len(packed):
+                drained_at = time.perf_counter()
             if i >= len(mbs):
-                continue
+                # trailing multi-host padding batch: every process had to
+                # dispatch it, but it carries no local rows
+                return
             mb = mbs[i]
-            for p, arr in zip(pb.placements, pb.unpack(out)):
-                by_key[(mb.ids[p.item_idx], p.seq_idx)] = arr
+            with tracing.span("fwd_pipe/unpack"):
+                for p, arr in zip(pb.placements, pb.unpack(out)):
+                    by_key[(mb.ids[p.item_idx], p.seq_idx)] = arr
+
+        max_in_flight = 0
+        # iterate over `packed` (not zip with mbs) — trailing multi-host
+        # padding batches have no local mb but every process must dispatch
+        in_flight: "collections.deque" = collections.deque()
+        for i, pb in enumerate(packed):
+            in_flight.append((i, pb, dispatch(i, pb)))
+            max_in_flight = max(max_in_flight, len(in_flight))
+            if len(in_flight) >= max(depth, 1):
+                j, jpb, jout = in_flight.popleft()
+                collect(j, jpb, jout, len(in_flight))
+        while in_flight:
+            j, jpb, jout = in_flight.popleft()
+            collect(j, jpb, jout, len(in_flight))
+
+        self._last_forward_events = events
+        metrics_mod.counters.add("fwd_pipe/dispatched", len(packed))
+        metrics_mod.counters.peak("fwd_pipe/max_in_flight", max_in_flight)
+        metrics_mod.counters.add("fwd_pipe/device_idle_gap_s", idle_gap)
+
         outs: List[np.ndarray] = []
         main = sample.main_key()
         for i, item_id in enumerate(sample.ids):

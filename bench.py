@@ -349,6 +349,151 @@ def _bench_bwd_pipe(cfg_small, cfg_32k, peak):
             os.environ["AREAL_FLASH_BWD_PIPELINE"] = prev
 
 
+def _bench_fwd_pipe(peak):
+    """A/B the host↔device data-plane pipeline (round 6): serial vs
+    dispatch-ahead ``forward()`` (AREAL_FWD_PIPELINE) and serial vs
+    prefetched+deferred PPO step (AREAL_TRAIN_PREFETCH). ``vs_baseline`` =
+    serial / pipelined wall time (>1 means the pipeline wins — if it does
+    not on real hardware, flip the env defaults in base/constants.py).
+    Every sub-A/B is individually guarded so the section always returns
+    structured JSON."""
+    import contextlib
+
+    import jax
+
+    from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model import PPOHyperparameters, make_interface
+    from areal_tpu.base import constants as const
+    from areal_tpu.base import metrics as metrics_mod
+    from areal_tpu.interfaces.ppo import logprob_output_fn
+    from areal_tpu.models.config import ModelConfig
+    from areal_tpu.parallel.mesh import ParallelConfig
+    from areal_tpu.train.engine import OptimizerConfig, TrainEngine
+
+    @contextlib.contextmanager
+    def _env(name, val):
+        prev = os.environ.get(name)
+        os.environ[name] = val
+        try:
+            yield
+        finally:
+            if prev is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = prev
+
+    cfg = ModelConfig(
+        n_layers=12, n_q_heads=12, n_kv_heads=4, head_dim=64, hidden_dim=768,
+        intermediate_dim=2048, vocab_size=32768, use_attention_bias=True,
+        dtype="bfloat16", remat_policy="none", layer_scan_unroll=12,
+    )
+    eng = TrainEngine(
+        cfg, ParallelConfig(), OptimizerConfig(lr=1e-5), param_dtype="bfloat16"
+    )
+    eng.init_random(0)
+    eng.setup_optimizer(100)
+    rng = np.random.default_rng(0)
+    # 16 x 512-token sequences at a 2048-token budget -> 4 micro-batches:
+    # enough host round trips per call for the dispatch-ahead window to show
+    lens = [512] * 16
+    sample_fwd = _mk_sample(cfg, lens, rng)
+    spec = MicroBatchSpec(n_mbs=4, max_tokens_per_mb=2048)
+    out = {}
+
+    def time_forward(knob, n_iters=4):
+        with _env(const.FWD_PIPELINE_ENV, knob):
+            eng.forward(sample_fwd, spec, logprob_output_fn)  # warm/compile
+            t0 = time.perf_counter()
+            for _ in range(n_iters):
+                eng.forward(sample_fwd, spec, logprob_output_fn)
+            return (time.perf_counter() - t0) / n_iters
+
+    try:
+        serial = time_forward("0")
+        # the peak is a lifetime max: clear it so the value below can only
+        # have come from THIS pipelined run (earlier sections also forward)
+        metrics_mod.counters.clear("fwd_pipe/max_in_flight")
+        piped = time_forward("2")
+        out["forward"] = {
+            "serial_s": round(serial, 4),
+            "pipelined_s": round(piped, 4),
+            "vs_baseline": round(serial / max(piped, 1e-9), 4),
+            "max_in_flight": int(
+                metrics_mod.counters.get("fwd_pipe/max_in_flight")
+            ),
+            "n_mbs": 4,
+        }
+    except Exception as e:
+        out["forward"] = {"error": repr(e)[:200]}
+
+    # one PPO step = prox-logprob recompute (forward MFC) + 4-minibatch
+    # decoupled-PPO update — the trainer hot path run through both knobs
+    PLEN, GLEN, N = 128, 384, 16
+
+    def mk_ppo_sample():
+        seqs, pmask, lps = [], [], []
+        for _ in range(N):
+            seqs.append(rng.integers(1, 30000, PLEN + GLEN).astype(np.int64))
+            pmask.append(np.r_[np.ones(PLEN, bool), np.zeros(GLEN, bool)])
+            lp = np.zeros(PLEN + GLEN, np.float32)
+            lp[PLEN - 1 : PLEN - 1 + GLEN] = -1.0
+            lps.append(lp)
+        lp_all = np.concatenate(lps)
+        return SequenceSample.from_default(
+            ids=list(range(N)), seqlens=[PLEN + GLEN] * N,
+            data={
+                "packed_input_ids": np.concatenate(seqs),
+                "prompt_mask": np.concatenate(pmask),
+                "packed_logprobs": lp_all,
+                "packed_ref_logprobs": lp_all.copy(),
+                "rewards": rng.standard_normal(N).astype(np.float32),
+                "seq_no_eos_mask": np.ones(N, bool),
+            },
+        )
+
+    actor = make_interface("ppo_actor", hp=PPOHyperparameters(
+        ppo_n_minibatches=4, disable_value=True, adv_norm=True,
+        group_adv_norm=False, use_decoupled_loss=True,
+    ))
+
+    def one_ppo_step():
+        s = mk_ppo_sample()
+        s.update_(actor.inference(eng, s, spec))
+        actor.train_step(eng, s, spec)
+
+    def time_ppo(knob, n_iters=3):
+        fwd_depth = "0" if knob == "0" else "2"
+        with _env(const.TRAIN_PREFETCH_ENV, knob), \
+                _env(const.FWD_PIPELINE_ENV, fwd_depth):
+            one_ppo_step()                       # warm/compile
+            jax.block_until_ready(eng.params)
+            t0 = time.perf_counter()
+            for _ in range(n_iters):
+                one_ppo_step()
+            jax.block_until_ready(eng.params)    # drain deferred dispatches
+            return (time.perf_counter() - t0) / n_iters
+
+    try:
+        serial = time_ppo("0")
+        piped = time_ppo("1")
+        out["ppo_step"] = {
+            "serial_s": round(serial, 4),
+            "pipelined_s": round(piped, 4),
+            "vs_baseline": round(serial / max(piped, 1e-9), 4),
+            "n_minibatches": 4,
+        }
+    except Exception as e:
+        out["ppo_step"] = {"error": repr(e)[:200]}
+
+    eng.params = eng.opt_state = None
+    eng._jit_cache = None
+    del eng
+    import gc
+
+    gc.collect()
+    return out
+
+
 def _bench_async_ppo(peak):
     """One complete async-PPO round on a single chip: generate a GRPO group
     per prompt on the paged engine, score, run the decoupled-PPO update,
@@ -735,6 +880,7 @@ def main():
         ("system_ppo", lambda: _bench_system_ppo(), False),
         # pure A/B diagnostics go LAST: if the deadline trips, the
         # pipeline flags simply stay at their measured-default settings
+        ("fwd_pipe", lambda: _bench_fwd_pipe(peak), True),
         ("gen_pipe", lambda: _bench_gen(peak_bw, peak, pipelined=True), True),
         ("bwd_pipe",
          lambda: _bench_bwd_pipe(cfg_small, cfg_32k, peak), True),

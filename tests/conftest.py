@@ -15,6 +15,15 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("AREAL_FILEROOT", "/tmp/areal_tpu_test")
+# Data-plane pipelining (docs/pipelined_data_plane.md) defaults OFF under
+# the CPU harness: with JAX_PLATFORMS=cpu the "device" IS the host, so
+# dispatch-ahead depth and the background packer thread only oversubscribe
+# the cores the multi-process e2e worlds already share (~35% wall-time
+# regression measured on test_experiment_e2e). Production (TPU) keeps the
+# ON defaults; tests/test_data_pipeline.py turns the knobs on explicitly
+# to exercise both paths.
+os.environ.setdefault("AREAL_FWD_PIPELINE", "0")
+os.environ.setdefault("AREAL_TRAIN_PREFETCH", "0")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
