@@ -10,7 +10,11 @@ from typing import List, Optional
 
 import numpy as np
 
-from areal_tpu.api.agent import Agent, BundledGenerationOutputs
+from areal_tpu.api.agent import (
+    Agent,
+    BundledGenerationOutputs,
+    GenerationFailedError,
+)
 from areal_tpu.api.data import SequenceSample
 from areal_tpu.api.env import EnvironmentService
 from areal_tpu.api.model import GenerationHyperparameters
@@ -61,6 +65,10 @@ class MathMultiTurnAgent(Agent):
         for turn in range(self.max_turns):
             await obs_queue.put((f"{qid}-t{turn}", cur_prompt, self.gconfig))
             act: BundledGenerationOutputs = await act_queue.get()
+            if act.error is not None:
+                # fleet failure: requeue the whole multi-turn sample rather
+                # than training on a truncated conversation
+                raise GenerationFailedError(f"qid {qid} turn {turn}: {act.error}")
             answer = self._decode(act.output_ids[0])
             _, success, *_ = await env.step((qid, [answer]))
             # graded envs (tool_use) return scores in [0, 1]; >= 0.5 is the

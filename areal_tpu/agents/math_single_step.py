@@ -19,7 +19,11 @@ from typing import List, Optional
 
 import numpy as np
 
-from areal_tpu.api.agent import Agent, BundledGenerationOutputs
+from areal_tpu.api.agent import (
+    Agent,
+    BundledGenerationOutputs,
+    GenerationFailedError,
+)
 from areal_tpu.api.data import SequenceSample
 from areal_tpu.api.env import EnvironmentService
 from areal_tpu.api.model import GenerationHyperparameters
@@ -69,6 +73,10 @@ class MathSingleStepAgent(Agent):
         await obs_queue.put((qid, prompt_ids, self.gconfig))
         act: BundledGenerationOutputs = await act_queue.get()
 
+        if act.error is not None:
+            # fleet failure (not a reward/filter rejection): surface it so
+            # the rollout worker requeues this sample on another server
+            raise GenerationFailedError(f"qid {qid}: {act.error}")
         if all(len(o) == 0 for o in act.output_ids):
             # generation failed entirely (e.g. fleet unreachable): drop
             return []

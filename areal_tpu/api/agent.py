@@ -10,9 +10,16 @@ on the other side of both).
 import abc
 import asyncio
 import dataclasses
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from areal_tpu.api.data import SequenceSample
+
+
+class GenerationFailedError(RuntimeError):
+    """The fleet failed to produce this prompt's group even after client
+    retries and chunk re-scheduling.  Agents raise it on ``bundle.error`` so
+    the rollout worker's requeue plane can retry the sample on a different
+    server instead of dropping it as rejected."""
 
 
 @dataclasses.dataclass
@@ -28,6 +35,9 @@ class BundledGenerationOutputs:
     no_eos: List[bool]                 # True = truncated by length
     version_start: List[int]           # weight version of first chunk
     version_end: List[int]             # weight version of last chunk
+    # set when generation failed (outputs are empty placeholders) — agents
+    # raise GenerationFailedError so the sample is requeued, not rejected
+    error: Optional[str] = None
 
     @property
     def seqs(self) -> List[List[int]]:

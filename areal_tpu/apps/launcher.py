@@ -324,10 +324,14 @@ def trainer_main(cfg):
         ema_ref_eta=cfg.ema_ref_eta,
         max_head_offpolicyness=cfg.manager.max_head_offpolicyness,
     )
+    recovered = False
     if cfg.recover_mode in ("auto", "resume"):
-        worker.load_recover_checkpoint()
-    # publish v0 weights so the fleet starts from the trainer's init
-    worker.publish_weights()
+        # a successful recover republishes the restored model_version +
+        # training_samples itself (trainer_worker.load_recover_checkpoint)
+        recovered = worker.load_recover_checkpoint()
+    if not recovered:
+        # publish v0 weights so the fleet starts from the trainer's init
+        worker.publish_weights()
     worker.run()
 
 

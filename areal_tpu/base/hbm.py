@@ -92,6 +92,17 @@ class HBMMonitor:
         )
         self.tag = tag
         self._warned = False
+        # throttle for the live-array FALLBACK only: jax.live_arrays() walks
+        # every array the process references, which is O(all arrays alive) —
+        # called per serving-loop iteration / train step it degrades from
+        # "cheap gauge" to a real tax as a long-lived process accumulates
+        # arrays. It is an observability lower bound, so ~1s staleness is
+        # free; the memory_stats() path (real TPU) stays unthrottled.
+        self.fallback_interval_s = float(
+            os.environ.get("AREAL_HBM_FALLBACK_INTERVAL", 1.0)
+        )
+        self._fallback_last_t = 0.0
+        self._fallback_cached = 0.0
 
     def check(self, kill: bool = True) -> Dict[str, float]:
         """Snapshot gauges; warn/kill on thresholds. ``kill=False`` for
@@ -100,7 +111,13 @@ class HBMMonitor:
         if stats is None:
             # proxied/dev platforms: report the client-side lower bound so
             # dashboards are never fully blind
-            return {"hbm_live_array_bytes": float(live_array_bytes())}
+            import time
+
+            now = time.monotonic()
+            if now - self._fallback_last_t >= self.fallback_interval_s:
+                self._fallback_last_t = now
+                self._fallback_cached = float(live_array_bytes())
+            return {"hbm_live_array_bytes": self._fallback_cached}
         limit = stats["bytes_limit"]
         util = stats["bytes_in_use"] / limit if limit else 0.0
         out = {

@@ -81,6 +81,30 @@ class CounterRegistry:
 counters = CounterRegistry()
 
 
+# --------------------------------------------------------------------- #
+# Fault-tolerance counter namespace (``ft/``) — every retry / eviction /
+# requeue decision the fleet-health subsystem makes is observable here
+# (docs/fault_tolerance.md).  Tests assert on these instead of scraping
+# logs.  ``faults/<point>`` counts injected faults per injection point.
+# --------------------------------------------------------------------- #
+
+FT_CLIENT_RETRIES = "ft/client_retries"            # GenAPIClient backoff retries
+FT_GEN_SERVER_FAILURES = "ft/gen_server_failures"  # generate failed after retries
+FT_ROLLOUT_REQUEUES = "ft/rollout_requeues"        # failed sample requeued
+FT_ROLLOUT_DROPPED = "ft/rollout_dropped"          # attempts exhausted; sample lost
+FT_FAILURES_OBSERVED = "ft/failures_observed"      # health-plane failure observations
+FT_EVICTIONS = "ft/evictions"                      # breaker closed → open
+FT_READMISSIONS = "ft/readmissions"                # probe + catch-up succeeded
+FT_PROBE_FAILURES = "ft/probe_failures"            # half-open probe failed
+FT_WEIGHT_UPDATE_FAILURES = "ft/weight_update_failures"
+FT_STICKY_REMAPS = "ft/sticky_remaps"              # qid→server remapped off corpse
+FT_ROUTE_NO_HEALTHY = "ft/route_no_healthy"        # routed with zero healthy servers
+FT_PRUNE_DEFERRED = "ft/prune_deferred"            # ckpt prune blocked by un-acked server
+FT_PUSH_DROPS = "ft/push_drops"                    # ZMQ push timed out; trajectory dropped
+FT_DRAIN_ABANDONED = "ft/drain_abandoned"          # tasks cancelled at drain timeout
+FT_STALE_DROPPED_ON_RECOVER = "ft/stale_dropped_on_recover"
+
+
 class MetricLogger:
     def __init__(self, logdir: str, backends: tuple = ("jsonl", "tensorboard")):
         os.makedirs(logdir, exist_ok=True)

@@ -41,6 +41,11 @@ class RecoverInfo:
     eval_ctl_states: Dict[str, dict] = dataclasses.field(default_factory=dict)
     data_loading_dp_idx: int = 0
     hash_vals_to_ignore: List[int] = dataclasses.field(default_factory=list)
+    # async-RL restart-the-world state: the resumed trainer republishes both
+    # so the gserver manager's staleness gate and the fleet's weight version
+    # converge on the restored run instead of the crashed one
+    samples_consumed: int = 0
+    model_version: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

@@ -9,8 +9,10 @@ xprof / tensorboard-plugin-profile).
 
 import contextlib
 import os
+import time
 
 from areal_tpu.base import constants
+from areal_tpu.base import metrics as metrics_mod
 
 
 def trace_enabled() -> bool:
@@ -64,10 +66,6 @@ def span(name: str):
     stages so the host-side cost split is observable WITHOUT collecting an
     xplane trace (a ``time.perf_counter`` pair is ~100 ns — free against
     any of those stages)."""
-    import time
-
-    from areal_tpu.base import metrics as metrics_mod
-
     t0 = time.perf_counter()
     try:
         with annotate(name):

@@ -2,14 +2,53 @@
 
 Counterpart of the reference's ``SGLangAPIClient``
 (``realhf/impl/model/backend/sglang.py:62``): generate + weight-update calls
-with the same retry/timeout posture.
+with the same retry/timeout posture, hardened for preemptible fleets:
+
+- capped exponential backoff with jitter on idempotent calls (generate and
+  weight updates retry on *connection* errors only — a timeout proves the
+  client gave up, not that the peer never saw the request, and the fan-out
+  path must not multiply a black-holing server's timeout budget),
+- per-call timeouts distinct from the session total (a health probe must
+  answer in seconds even when the session budget covers minutes-long
+  generates),
+- named fault-injection points (``gen.http``, ``gen.weight_update``) so
+  tests script failures deterministically (``areal_tpu/base/faults.py``).
+
+Retries are observable via ``metrics.counters``: ``ft/client_retries``.
 """
 
 import asyncio
 import dataclasses
+import random
 from typing import Dict, List, Optional
 
 import aiohttp
+
+from areal_tpu.base import faults
+from areal_tpu.base import metrics as metrics_mod
+
+# the request never completed: safe to retry even non-idempotent calls
+CONNECTION_ERRORS = (
+    aiohttp.ClientConnectionError,  # refused / reset / disconnected
+    ConnectionError,                # includes faults.FaultInjected
+    asyncio.TimeoutError,
+)
+# 5xx the fleet emits while pausing/restarting — transient by contract
+RETRYABLE_STATUS = (502, 503, 504)
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Capped exponential backoff with full jitter."""
+
+    max_attempts: int = 3
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
+    jitter: float = 0.5  # each delay is scaled by U[1-jitter, 1]
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        d = min(self.backoff_cap_s, self.backoff_base_s * (2 ** attempt))
+        return d * (1.0 - self.jitter * rng.random())
 
 
 @dataclasses.dataclass
@@ -33,8 +72,23 @@ class APIGenerateResult:
 
 
 class GenAPIClient:
-    def __init__(self, timeout: float = 300.0):
+    def __init__(
+        self,
+        timeout: float = 300.0,
+        request_timeout: Optional[float] = None,
+        retry: Optional[RetryPolicy] = None,
+        seed: Optional[int] = None,
+    ):
+        """``timeout`` bounds the whole session (the longest generate);
+        ``request_timeout`` bounds one control-plane call (health/metrics) —
+        defaults to min(10s, timeout)."""
         self._timeout = aiohttp.ClientTimeout(total=timeout)
+        self._request_timeout = aiohttp.ClientTimeout(
+            total=min(10.0, timeout) if request_timeout is None
+            else request_timeout
+        )
+        self.retry = retry or RetryPolicy()
+        self._rng = random.Random(seed)
         self._session: Optional[aiohttp.ClientSession] = None
 
     async def __aenter__(self):
@@ -44,6 +98,71 @@ class GenAPIClient:
     async def __aexit__(self, *exc):
         await self._session.close()
 
+    # ------------------------------------------------------------------ #
+    # retrying request core
+    # ------------------------------------------------------------------ #
+
+    async def _request_json(
+        self,
+        method: str,
+        server_url: str,
+        endpoint: str,
+        op: str,
+        json_body: Optional[Dict] = None,
+        timeout: Optional[aiohttp.ClientTimeout] = None,
+        retry_connection_only: bool = False,
+    ) -> Dict:
+        """One logical call = up to ``retry.max_attempts`` HTTP attempts.
+
+        ``retry_connection_only`` restricts retries to errors where the
+        request provably never completed (generate: re-sending a request the
+        server may be running would double-bill its rid)."""
+        attempt = 0
+        # aiohttp treats an explicit timeout=None as "no timeout at all"
+        # (not "session default"), so the kwarg is only passed when set —
+        # otherwise the session total (the long generate budget) applies
+        req_kw: Dict = {"json": json_body}
+        if timeout is not None:
+            req_kw["timeout"] = timeout
+        while True:
+            try:
+                await faults.maybe_fail_async(
+                    "gen.http", url=server_url, op=op
+                )
+                async with self._session.request(
+                    method, f"{server_url}{endpoint}", **req_kw
+                ) as resp:
+                    if resp.status in RETRYABLE_STATUS:
+                        resp.release()
+                        raise aiohttp.ClientResponseError(
+                            resp.request_info, (), status=resp.status,
+                            message="transient server status",
+                        )
+                    resp.raise_for_status()
+                    return await resp.json()
+            except Exception as e:
+                if retry_connection_only:
+                    # a timeout proves the client gave up, NOT that the
+                    # request never reached the server — resending a
+                    # possibly-still-running generate would double-bill it
+                    retryable = isinstance(
+                        e, CONNECTION_ERRORS
+                    ) and not isinstance(e, asyncio.TimeoutError)
+                else:
+                    retryable = isinstance(e, CONNECTION_ERRORS) or (
+                        isinstance(e, aiohttp.ClientResponseError)
+                        and e.status in RETRYABLE_STATUS
+                    )
+                attempt += 1
+                if not retryable or attempt >= self.retry.max_attempts:
+                    raise
+                metrics_mod.counters.add(metrics_mod.FT_CLIENT_RETRIES)
+                await asyncio.sleep(self.retry.delay(attempt - 1, self._rng))
+
+    # ------------------------------------------------------------------ #
+    # API calls
+    # ------------------------------------------------------------------ #
+
     async def generate(
         self,
         server_url: str,
@@ -51,16 +170,18 @@ class GenAPIClient:
         input_ids: List[int],
         sampling_params: Dict,
     ) -> APIGenerateResult:
-        async with self._session.post(
-            f"{server_url}/generate",
-            json={
+        d = await self._request_json(
+            "POST",
+            server_url,
+            "/generate",
+            op="generate",
+            json_body={
                 "rid": rid,
                 "input_ids": input_ids,
                 "sampling_params": sampling_params,
             },
-        ) as resp:
-            resp.raise_for_status()
-            d = await resp.json()
+            retry_connection_only=True,
+        )
         return APIGenerateResult(
             rid=d["rid"],
             output_ids=d["output_ids"],
@@ -76,25 +197,41 @@ class GenAPIClient:
         version: Optional[int] = None,
         allow_interrupt: bool = True,
     ) -> Dict:
-        async with self._session.post(
-            f"{server_url}/update_weights_from_disk",
-            json={
+        await faults.maybe_fail_async("gen.weight_update", url=server_url)
+        # connection-only retries: connection-refused fails in milliseconds
+        # and is worth retrying, but a black-holing server must burn the
+        # timeout budget at most ONCE — the manager's fan-out awaits the
+        # slowest server, so timeout x max_attempts would multiply the
+        # fleet-wide flush wedge (eviction + the probe loop own stragglers)
+        return await self._request_json(
+            "POST",
+            server_url,
+            "/update_weights_from_disk",
+            op="update_weights",
+            json_body={
                 "model_path": model_path,
                 "version": version,
                 "allow_interrupt": allow_interrupt,
             },
-        ) as resp:
-            resp.raise_for_status()
-            return await resp.json()
+            retry_connection_only=True,
+        )
 
     async def metrics(self, server_url: str) -> Dict:
-        async with self._session.get(f"{server_url}/metrics_json") as resp:
-            resp.raise_for_status()
-            return await resp.json()
+        return await self._request_json(
+            "GET", server_url, "/metrics_json", op="metrics",
+            timeout=self._request_timeout,
+        )
 
     async def health(self, server_url: str) -> bool:
+        """Single non-retried probe with the short per-call timeout — the
+        breaker's half-open logic supplies the retry cadence."""
         try:
-            async with self._session.get(f"{server_url}/health") as resp:
+            await faults.maybe_fail_async(
+                "gen.http", url=server_url, op="health"
+            )
+            async with self._session.get(
+                f"{server_url}/health", timeout=self._request_timeout
+            ) as resp:
                 return resp.status == 200
-        except aiohttp.ClientError:
+        except (aiohttp.ClientError, ConnectionError, asyncio.TimeoutError):
             return False

@@ -13,6 +13,16 @@ Semantics ported faithfully (they are the heart of async RL):
 - **Weight sync** (≈ :131-190): polls the trainer's ``model_version`` key in
   name_resolve; on bump, pauses/updates every server from the published
   checkpoint dir, then prunes old checkpoint dirs (keeping the newest few).
+
+Fault tolerance (docs/fault_tolerance.md): a :class:`FleetHealth` record per
+server drives routing and fan-out.  Failures observed while routing
+(``/report_failure`` from rollout workers) trip a per-server circuit
+breaker; a failed weight update evicts immediately (the server would serve
+stale weights).  Evicted servers are excluded from ``_pick_server`` and the
+update fan-out, their sticky qid assignments are remapped, and a background
+probe loop re-admits them after a successful ``/health`` probe + catch-up
+weight load.  Weight updates proceed on the surviving servers and still
+publish the new version — one dead server no longer wedges the trial.
 """
 
 import asyncio
@@ -27,7 +37,9 @@ from typing import Dict, List, Optional
 from aiohttp import web
 
 from areal_tpu.base import name_resolve, names
+from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.gen.client import GenAPIClient
+from areal_tpu.system.fleet import FleetHealth
 
 logger = logging.getLogger("areal_tpu.gserver_manager")
 
@@ -45,6 +57,11 @@ class GserverManagerConfig:
     schedule_policy: str = "round_robin"
     flush_request_timeout: float = 300.0
     n_checkpoints_to_keep: int = 2
+    # --- health plane -------------------------------------------------- #
+    health_fail_threshold: int = 3      # consecutive failures → evict
+    health_probe_cooldown: float = 5.0  # open → probe-eligible delay
+    health_check_interval: float = 2.0  # probe-loop tick
+    heartbeat_interval: float = 10.0    # active /health poll of closed servers
 
 
 @dataclasses.dataclass
@@ -59,28 +76,54 @@ class GserverManager:
         self.config = config
         self.server_urls: List[str] = server_urls or []
         self.rollout_stat = RolloutStat()
+        self.fleet = FleetHealth(
+            self.server_urls,
+            fail_threshold=config.health_fail_threshold,
+            probe_cooldown_s=config.health_probe_cooldown,
+        )
         self._qid_to_server: Dict[str, str] = {}
         self._request_counts: Dict[str, int] = defaultdict(int)
         self._token_usage: Dict[str, float] = defaultdict(float)
-        # per-qid accounting so finish_rollout can release exactly what the
-        # qid's schedule_request calls accumulated (chunks × group members)
-        self._qid_sched: Dict[str, Dict[str, float]] = {}
+        # per-qid, per-server accounting so finish_rollout can release
+        # exactly what the qid's schedule_request calls accumulated (chunks ×
+        # group members) — per-server because an eviction mid-rollout remaps
+        # the qid and its later chunks land on a different server
+        self._qid_sched: Dict[str, Dict[str, Dict[str, float]]] = {}
         self._rr_next = 0
         # -1 so the trainer's initial v0 snapshot is pushed to the fleet
         # (check_new_params requires version > self.version)
         self.version = -1
         self._ckpt_dirs: List[str] = []
+        self._ckpt_versions: Dict[str, int] = {}
+        self._latest_path: Optional[str] = None
+        # version currently being fanned out (None = no flush in flight);
+        # gates probe-loop re-admission against racing a publish
+        self._flushing_version: Optional[int] = None
+        # qids with a live allocation: finish_rollout decrements `running`
+        # only for these, so a duplicate finish (e.g. drain's best-effort
+        # slot release racing the task's own) cannot double-decrement
+        self._active_rollouts: set = set()
+        # refcount of in-flight catch-up loads per checkpoint dir — the
+        # pruner must not delete a dir any load is still reading, even if
+        # every healthy server has moved past its version (two concurrent
+        # catch-ups from the same dir must hold it until BOTH finish)
+        self._catchup_paths: Dict[str, int] = defaultdict(int)
+        self._last_heartbeat: Dict[str, float] = {}
         self._lock = asyncio.Lock()
         self.app = web.Application()
         self.app.router.add_post("/schedule_request", self._schedule_request)
         self.app.router.add_post("/allocate_rollout", self._allocate_rollout)
         self.app.router.add_post("/finish_rollout", self._finish_rollout)
+        self.app.router.add_post("/report_failure", self._report_failure)
         self.app.router.add_post("/get_model_version", self._get_version)
         self.app.router.add_get("/health", self._health)
         self.app.router.add_get("/metrics_json", self._metrics)
         self.app.on_startup.append(self._on_startup)
         self.app.on_cleanup.append(self._on_cleanup)
         self._poll_task: Optional[asyncio.Task] = None
+        self._probe_task: Optional[asyncio.Task] = None
+        # one detached catch-up/probe task per server being re-admitted
+        self._probe_tasks: Dict[str, asyncio.Task] = {}
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -94,14 +137,19 @@ class GserverManager:
             self.server_urls = sorted(name_resolve.get_subtree(root))
         except name_resolve.NameEntryNotFoundError:
             self.server_urls = []
+        for url in self.server_urls:
+            self.fleet.add_server(url)
         return self.server_urls
 
     async def _on_startup(self, app):
-        self._poll_task = asyncio.get_event_loop().create_task(self._poll_weights())
+        loop = asyncio.get_event_loop()
+        self._poll_task = loop.create_task(self._poll_weights())
+        self._probe_task = loop.create_task(self._probe_loop())
 
     async def _on_cleanup(self, app):
-        if self._poll_task:
-            self._poll_task.cancel()
+        for t in (self._poll_task, self._probe_task, *self._probe_tasks.values()):
+            if t:
+                t.cancel()
 
     def _training_samples(self) -> int:
         name = names.training_samples(
@@ -147,46 +195,241 @@ class GserverManager:
         version = int(version)
         if version <= self.version:
             return None
-        await self.flush_and_update_weights(path, version)
+        # visible to the probe loop: a catch-up load completing while this
+        # fan-out is in flight must NOT re-admit at the version being
+        # superseded (self.version only bumps after the gather returns)
+        self._flushing_version = version
+        try:
+            await self.flush_and_update_weights(path, version)
+        finally:
+            self._flushing_version = None
+        # the version advances even on partial failure: survivors serve the
+        # new weights, failed servers were evicted and will catch up through
+        # the probe loop — re-flushing the whole fleet every poll tick until
+        # a dead server answers (the old behavior) wedged the trial forever
         self.version = version
         self._ckpt_dirs.append(path)
+        self._ckpt_versions[path] = version
+        self._latest_path = path
         self._prune_checkpoints()
         return path
 
     async def flush_and_update_weights(self, path: str, version: int):
+        urls = [u for u in self.server_urls if self.fleet.is_healthy(u)]
         async with GenAPIClient(timeout=self.config.flush_request_timeout) as c:
             results = await asyncio.gather(
                 *(
                     c.update_weights_from_disk(
                         url, path, version=version, allow_interrupt=True
                     )
-                    for url in self.server_urls
-                )
+                    for url in urls
+                ),
+                return_exceptions=True,
             )
-        n_paused = sum(r.get("num_paused_requests", 0) for r in results)
-        for r in results:
-            if not r.get("success"):
-                raise RuntimeError(f"weight update failed: {r}")
+        n_paused, n_ok = 0, 0
+        for url, r in zip(urls, results):
+            if isinstance(r, BaseException) or not r.get("success"):
+                # this server now lags the fleet's weight version; routing
+                # to it would break the staleness accounting — evict now,
+                # the probe loop re-admits it after a catch-up load
+                logger.error("weight update v%d failed on %s: %r", version, url, r)
+                metrics_mod.counters.add(metrics_mod.FT_WEIGHT_UPDATE_FAILURES)
+                self.fleet.evict(url, f"weight update v{version} failed")
+                self._remap_stickies()
+            else:
+                n_ok += 1
+                n_paused += r.get("num_paused_requests", 0)
+                self.fleet.observe_success(url)
+                self.fleet.ack_version(url, version)
+        if n_ok < len(urls):
+            logger.warning(
+                "weight update v%d: %d/%d servers updated; evicted the rest",
+                version, n_ok, len(urls),
+            )
         logger.info(
             "updated %d servers to v%d (%d requests interrupted)",
-            len(self.server_urls), version, n_paused,
+            n_ok, version, n_paused,
         )
 
     def _prune_checkpoints(self):
+        """Delete superseded checkpoint dirs — but only dirs whose version
+        every *healthy* server has acked moving past (a slow server may
+        still be reading an older dir) and that no catch-up load holds."""
         while len(self._ckpt_dirs) > self.config.n_checkpoints_to_keep:
-            old = self._ckpt_dirs.pop(0)
+            old = self._ckpt_dirs[0]
+            v = self._ckpt_versions.get(old, -1)
+            if (
+                self._catchup_paths.get(old, 0) > 0
+                or self.fleet.min_acked_version() < v
+            ):
+                metrics_mod.counters.add(metrics_mod.FT_PRUNE_DEFERRED)
+                logger.info(
+                    "deferring prune of %s (v%d): not every healthy server "
+                    "has acked it", old, v,
+                )
+                break
+            self._ckpt_dirs.pop(0)
+            self._ckpt_versions.pop(old, None)
             shutil.rmtree(old, ignore_errors=True)
+
+    # ------------------------------------------------------------------ #
+    # health probing / re-admission
+    # ------------------------------------------------------------------ #
+
+    async def _probe_loop(self):
+        while True:
+            try:
+                await self.run_health_checks()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("health probe pass failed")
+            await asyncio.sleep(self.config.health_check_interval)
+
+    async def run_health_checks(self, wait_probes: bool = False):
+        """One probe pass: heartbeat closed servers, probe open ones.
+        ``wait_probes`` awaits the detached probe tasks before returning —
+        for tests; the production loop must never block on them."""
+        now = time.monotonic()
+        # first sighting stamps the clock without probing — a server gets a
+        # full heartbeat_interval of grace after discovery/startup
+        for u in self.fleet.healthy_urls():
+            self._last_heartbeat.setdefault(u, now)
+        heartbeats = [
+            u
+            for u in self.fleet.healthy_urls()
+            if now - self._last_heartbeat[u] >= self.config.heartbeat_interval
+        ]
+        candidates = self.fleet.probe_candidates()
+        if not heartbeats and not candidates:
+            return
+        # probes carry a catch-up weight load (minutes on a big model), so
+        # they run as DETACHED per-server tasks — neither this pass nor the
+        # next may wait on them, or one slow load would freeze heartbeating
+        # for the whole fleet (begin_probe flips the server to half_open,
+        # which keeps it out of probe_candidates meanwhile)
+        loop = asyncio.get_event_loop()
+        for url in candidates:
+            prev = self._probe_tasks.get(url)
+            if prev is None or prev.done():
+                self.fleet.begin_probe(url)
+                self._probe_tasks[url] = loop.create_task(
+                    self._probe_one(url)
+                )
+        if wait_probes and self._probe_tasks:
+            await asyncio.gather(
+                *self._probe_tasks.values(), return_exceptions=True
+            )
+        if not heartbeats:
+            return
+        async with GenAPIClient(
+            timeout=self.config.flush_request_timeout
+        ) as client:
+
+            async def _heartbeat_one(url: str):
+                self._last_heartbeat[url] = now
+                if await client.health(url):
+                    self.fleet.observe_success(url)
+                elif self.fleet.observe_failure(url, "heartbeat failed"):
+                    self._remap_stickies()
+
+            # heartbeats are cheap (short per-call timeout) and independent
+            await asyncio.gather(
+                *[_heartbeat_one(u) for u in heartbeats],
+                return_exceptions=True,
+            )
+
+    async def _probe_one(self, url: str):
+        """Half-open probe: /health, then catch-up weight load, then
+        re-admission into routing + fan-out.  Runs detached (its own client
+        session) — the caller must not await it on the heartbeat path."""
+        async with GenAPIClient(
+            timeout=self.config.flush_request_timeout
+        ) as client:
+            await self._probe_with_client(client, url)
+
+    async def _probe_with_client(self, client: GenAPIClient, url: str):
+        self.fleet.begin_probe(url)
+        if not await client.health(url):
+            self.fleet.probe_failed(url, "health probe failed")
+            return
+        # catch up to the fleet's current weights before serving again —
+        # re-admitting at a stale version would poison staleness accounting
+        if self.version >= 0 and self._latest_path is not None:
+            path, version = self._latest_path, self.version
+            self._catchup_paths[path] += 1
+            try:
+                r = await client.update_weights_from_disk(
+                    url, path, version=version, allow_interrupt=True
+                )
+            except Exception as e:
+                self.fleet.probe_failed(url, f"catch-up load failed: {e!r}")
+                return
+            finally:
+                self._catchup_paths[path] -= 1
+                if self._catchup_paths[path] <= 0:
+                    del self._catchup_paths[path]
+            if not r.get("success"):
+                self.fleet.probe_failed(url, f"catch-up load rejected: {r}")
+                return
+            if version != self.version or self._flushing_version is not None:
+                # a newer version published (or its fan-out is mid-flight,
+                # which skipped us: half-open is not healthy) while the load
+                # ran — re-admitting now would serve stale weights. Stay
+                # open; the next probe cycle catches up to the new version.
+                self.fleet.probe_failed(
+                    url,
+                    f"fleet moved past v{version} during catch-up "
+                    f"(now v{self.version}, flushing="
+                    f"{self._flushing_version})",
+                )
+                return
+            self.fleet.readmit(url, acked_version=version)
+        elif self._flushing_version is not None:
+            # nothing published yet BUT the first publish's fan-out is in
+            # flight (self.version only bumps when it returns) — it skipped
+            # this server (half-open is not healthy), so re-admitting now
+            # would serve pre-publish weights at the announced version.
+            # Stay open; the next probe cycle catches up properly.
+            self.fleet.probe_failed(
+                url, f"first publish (v{self._flushing_version}) in flight"
+            )
+            return
+        else:
+            self.fleet.readmit(url)
+        self._last_heartbeat[url] = time.monotonic()
+
+    def _remap_stickies(self):
+        """Drop sticky qid → server assignments that point at evicted
+        servers; the next schedule_request re-picks among the healthy."""
+        dead = {
+            qid: url
+            for qid, url in self._qid_to_server.items()
+            if not self.fleet.is_healthy(url)
+        }
+        for qid in dead:
+            del self._qid_to_server[qid]
+        if dead:
+            metrics_mod.counters.add(metrics_mod.FT_STICKY_REMAPS, len(dead))
+            logger.info("remapped %d sticky qids off evicted servers", len(dead))
 
     # ------------------------------------------------------------------ #
     # handlers
     # ------------------------------------------------------------------ #
 
     def _pick_server(self, meta: dict) -> str:
+        urls = [u for u in self.server_urls if self.fleet.is_healthy(u)]
+        if not urls:
+            # whole fleet evicted: route to any server rather than erroring
+            # the rollout worker — its retry plane handles the failure and
+            # the probe loop is working on re-admission
+            metrics_mod.counters.add(metrics_mod.FT_ROUTE_NO_HEALTHY)
+            urls = self.server_urls
         if self.config.schedule_policy == "least_requests":
-            return min(self.server_urls, key=lambda u: self._request_counts[u])
+            return min(urls, key=lambda u: self._request_counts[u])
         if self.config.schedule_policy == "least_token_usage":
-            return min(self.server_urls, key=lambda u: self._token_usage[u])
-        url = self.server_urls[self._rr_next % len(self.server_urls)]
+            return min(urls, key=lambda u: self._token_usage[u])
+        url = urls[self._rr_next % len(urls)]
         self._rr_next += 1
         return url
 
@@ -194,10 +437,16 @@ class GserverManager:
         meta = await request.json()
         async with self._lock:
             prev_url = meta.get("previous_server_url")
-            if prev_url and meta.get("previous_version") == self.version:
+            if (
+                prev_url
+                and meta.get("previous_version") == self.version
+                and self.fleet.is_healthy(prev_url)
+            ):
                 return web.json_response({"url": prev_url, "version": self.version})
             qid = str(meta["qid"])
             url = self._qid_to_server.get(qid)
+            if url is not None and not self.fleet.is_healthy(url):
+                url = None  # sticky target was evicted: remap
             if url is None:
                 url = self._pick_server(meta)
                 self._qid_to_server[qid] = url
@@ -206,13 +455,14 @@ class GserverManager:
             ) * meta.get("group_size", 1)
             self._request_counts[url] += 1
             self._token_usage[url] += tokens
-            acct = self._qid_sched.setdefault(qid, {"url": url, "n": 0, "tokens": 0.0})
+            per_url = self._qid_sched.setdefault(qid, {})
+            acct = per_url.setdefault(url, {"n": 0, "tokens": 0.0})
             acct["n"] += 1
             acct["tokens"] += tokens
             return web.json_response({"url": url, "version": self.version})
 
     async def _allocate_rollout(self, request: web.Request) -> web.Response:
-        await request.json()
+        d = await request.json()
         async with self._lock:
             has_capacity = (
                 self.rollout_stat.running < self.config.max_concurrent_rollouts
@@ -221,6 +471,7 @@ class GserverManager:
             if has_capacity and not staled:
                 self.rollout_stat.submitted += 1
                 self.rollout_stat.running += 1
+                self._active_rollouts.add(str(d.get("qid")))
                 return web.json_response({"success": True, "reason": ""})
             reason = []
             if not has_capacity:
@@ -246,20 +497,40 @@ class GserverManager:
             for key in [qid] + [
                 k for k in self._qid_sched if k.startswith(f"{qid}-t")
             ]:
-                acct = self._qid_sched.pop(key, None)
+                per_url = self._qid_sched.pop(key, None)
                 self._qid_to_server.pop(key, None)
-                if acct:
-                    url = acct["url"]
+                for url, acct in (per_url or {}).items():
                     self._request_counts[url] = max(
                         0, self._request_counts[url] - acct["n"]
                     )
                     self._token_usage[url] = max(
                         0.0, self._token_usage[url] - acct["tokens"]
                     )
-            self.rollout_stat.running = max(0, self.rollout_stat.running - 1)
-            if d.get("accepted"):
-                self.rollout_stat.accepted += 1
+            # idempotent: only a qid with a live allocation releases a slot
+            # (a duplicate finish must not double-decrement `running` and
+            # over-admit through the capacity/staleness gates)
+            if qid in self._active_rollouts:
+                self._active_rollouts.discard(qid)
+                self.rollout_stat.running = max(0, self.rollout_stat.running - 1)
+                if d.get("accepted"):
+                    self.rollout_stat.accepted += 1
             return web.json_response({"success": True})
+
+    async def _report_failure(self, request: web.Request) -> web.Response:
+        """Passive failure observation from routing: a rollout worker's
+        generate against ``url`` failed after client-level retries."""
+        d = await request.json()
+        url = d.get("url", "")
+        async with self._lock:
+            evicted = self.fleet.observe_failure(
+                url, d.get("reason", "reported by rollout worker")
+            )
+            if evicted:
+                self._remap_stickies()
+            s = self.fleet.get(url)
+            return web.json_response(
+                {"evicted": evicted, "state": s.state if s else "unknown"}
+            )
 
     async def _get_version(self, request: web.Request) -> web.Response:
         return web.json_response({"version": self.version})
@@ -275,6 +546,8 @@ class GserverManager:
                 "running": self.rollout_stat.running,
                 "accepted": self.rollout_stat.accepted,
                 "servers": self.server_urls,
+                "healthy_servers": self.fleet.healthy_urls(),
+                "fleet": self.fleet.snapshot(),
                 "request_counts": dict(self._request_counts),
             }
         )

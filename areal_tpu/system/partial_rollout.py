@@ -16,8 +16,9 @@ from typing import Dict, List, Optional
 
 import aiohttp
 
-from areal_tpu.api.agent import BundledGenerationOutputs
+from areal_tpu.api.agent import BundledGenerationOutputs, GenerationFailedError
 from areal_tpu.api.model import GenerationHyperparameters
+from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.gen.client import GenAPIClient
 
 logger = logging.getLogger("areal_tpu.partial_rollout")
@@ -31,12 +32,18 @@ class PartialRolloutManager:
         gserver_manager_url: str,
         new_tokens_per_chunk: int = 256,
         timeout: float = 300.0,
+        max_server_failures: int = 6,
     ):
         self.request_queue = request_queue
         self.reply_queue = reply_queue
         self.manager_url = gserver_manager_url
         self.new_tokens_per_chunk = new_tokens_per_chunk
         self.timeout = timeout
+        # generate failures tolerated per group member before the whole
+        # group is surfaced as failed (each failure is reported to the
+        # manager's health plane and the chunk re-scheduled, so by the
+        # breaker threshold the dead server is already out of rotation)
+        self.max_server_failures = max_server_failures
         self._tasks: Dict[str, asyncio.Task] = {}
 
     async def _schedule(
@@ -64,6 +71,20 @@ class PartialRolloutManager:
             d = await resp.json()
         return d["url"], d["version"]
 
+    async def _report_failure(
+        self, session: aiohttp.ClientSession, url: str, qid: str, reason: str
+    ):
+        """Passive health observation: tell the manager this server failed a
+        generate so its circuit breaker counts it (best-effort)."""
+        try:
+            async with session.post(
+                f"{self.manager_url}/report_failure",
+                json={"url": url, "qid": qid, "reason": reason},
+            ) as resp:
+                resp.raise_for_status()
+        except (aiohttp.ClientError, ConnectionError, asyncio.TimeoutError):
+            logger.warning("could not report failure of %s to manager", url)
+
     async def _gen_one(
         self,
         session: aiohttp.ClientSession,
@@ -80,6 +101,7 @@ class PartialRolloutManager:
         prev_url = None
         prev_version = None
         no_eos = True
+        server_failures = 0
         while len(acc_out) < gconfig.max_new_tokens:
             url, version = await self._schedule(
                 session, qid, len(prompt_ids), gconfig.n,
@@ -106,13 +128,34 @@ class PartialRolloutManager:
                         "stop_token_ids": list(gconfig.stop_token_ids),
                     },
                 )
-            except aiohttp.ClientResponseError as e:
-                if e.status == 400:
-                    # sequence hit the server's context capacity: treat as a
-                    # length truncation (≈ SGLang behavior on max context)
-                    logger.warning("generate rejected for %s: %s", qid, e)
-                    break
-                raise
+            except (aiohttp.ClientError, ConnectionError,
+                    asyncio.TimeoutError) as e:
+                if isinstance(e, aiohttp.ClientResponseError):
+                    if e.status == 400:
+                        # sequence hit the server's context capacity: treat
+                        # as a length truncation (≈ SGLang on max context)
+                        logger.warning("generate rejected for %s: %s", qid, e)
+                        break
+                    if e.status < 500:
+                        # deterministic rejection of THIS request (404/422):
+                        # not a server-health signal — reporting it would
+                        # let one poison prompt evict healthy servers
+                        raise
+                # the server died mid-chunk (client-level retries exhausted)
+                # or is erroring (5xx): report it to the health plane and
+                # re-schedule this chunk — the accumulated tokens are in
+                # hand, nothing is lost. Once the breaker opens, the manager
+                # routes us elsewhere.
+                server_failures += 1
+                metrics_mod.counters.add(metrics_mod.FT_GEN_SERVER_FAILURES)
+                await self._report_failure(session, url, qid, repr(e))
+                if server_failures >= self.max_server_failures:
+                    raise GenerationFailedError(
+                        f"{qid}: {server_failures} generate failures, "
+                        f"last on {url}: {e!r}"
+                    ) from e
+                prev_url = prev_version = None  # drop the sticky hint
+                continue
             acc_out.extend(res.output_ids)
             acc_lp.extend(res.output_logprobs)
             if version_start < 0:
@@ -135,6 +178,7 @@ class PartialRolloutManager:
         # Always deliver a bundle and release the task slot — a stuck agent
         # would strand a manager capacity slot forever (finish_rollout never
         # fires) and eventually deadlock the staleness gate.
+        error = None
         try:
             async with GenAPIClient(timeout=self.timeout) as client:
                 async with aiohttp.ClientSession(
@@ -144,10 +188,18 @@ class PartialRolloutManager:
                         *(
                             self._gen_one(session, client, qid, prompt_ids, gconfig)
                             for _ in range(gconfig.n)
-                        )
+                        ),
+                        return_exceptions=True,
                     )
-        except Exception:
+            for r in results:
+                # one failed member fails the group: training on a partial
+                # group would bias the grouped-advantage baseline, and the
+                # requeue plane redoes the whole prompt anyway
+                if isinstance(r, BaseException):
+                    raise r
+        except Exception as e:
             logger.exception("generation for qid %s failed", qid)
+            error = repr(e)
             results = [([], [], True, -1, -1) for _ in range(gconfig.n)]
         finally:
             self._tasks.pop(qid, None)
@@ -159,6 +211,7 @@ class PartialRolloutManager:
             no_eos=[r[2] for r in results],
             version_start=[r[3] for r in results],
             version_end=[r[4] for r in results],
+            error=error,
         )
         await self.reply_queue.put(bundle)
 

@@ -14,19 +14,48 @@ from typing import Any, Dict, List, Optional
 import zmq
 
 from areal_tpu.base import name_resolve, names, network
+from areal_tpu.base import metrics as metrics_mod
 
 logger = logging.getLogger("areal_tpu.push_pull_stream")
 
 
 class ZMQJsonPusher:
-    def __init__(self, host: str, port: int, hwm: int = 1000):
+    def __init__(
+        self, host: str, port: int, hwm: int = 1000,
+        send_timeout_ms: int = 2000,
+    ):
         self.ctx = zmq.Context.instance()
         self.sock = self.ctx.socket(zmq.PUSH)
         self.sock.setsockopt(zmq.SNDHWM, hwm)
+        # a PUSH socket blocks FOREVER once SNDHWM is hit and the puller is
+        # gone — a dead trainer must degrade to dropped trajectories
+        # (counted + warned), not a wedged rollout worker. SNDTIMEO guards
+        # any residual blocking path (e.g. close-time flush).
+        self.sock.setsockopt(zmq.SNDTIMEO, send_timeout_ms)
         self.sock.connect(f"tcp://{host}:{port}")
+        self.drop_cnt = 0
 
-    def push(self, data: Any):
-        self.sock.send(json.dumps(data).encode("utf-8"), flags=0)
+    def push(self, data: Any) -> bool:
+        """Returns False when the send queue is full (trajectory dropped).
+
+        Always non-blocking: push() is called from the rollout worker's
+        event loop, and even a bounded wait here would freeze every
+        concurrent rollout task. The SNDHWM queue is the burst absorber —
+        once it is full the puller is dead or seconds behind, and dropping
+        beats stalling the whole worker."""
+        try:
+            self.sock.send(
+                json.dumps(data).encode("utf-8"), flags=zmq.NOBLOCK
+            )
+            return True
+        except zmq.Again:
+            self.drop_cnt += 1
+            metrics_mod.counters.add(metrics_mod.FT_PUSH_DROPS)
+            logger.warning(
+                "push queue full (puller dead or backlogged); dropped "
+                "trajectory (%d drops so far)", self.drop_cnt,
+            )
+            return False
 
     def close(self):
         self.sock.close(linger=0)

@@ -9,14 +9,16 @@ completion. Structure ported intact — this layer is device-agnostic.
 
 import asyncio
 import logging
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Deque, Dict, List, Optional
 
 import aiohttp
 
 from areal_tpu.api.agent import Agent, make_agent
 from areal_tpu.api.data import SequenceSample
 from areal_tpu.api.env import EnvironmentService, make_env
-from areal_tpu.base import name_resolve, names
+from areal_tpu.base import faults, name_resolve, names
+from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.system.partial_rollout import PartialRolloutManager
 from areal_tpu.system.push_pull_stream import NameResolvingZmqPusher
 
@@ -38,6 +40,7 @@ class RolloutWorker:
         max_concurrent_tasks: int = 16,
         pusher: Optional[object] = None,
         manager_url: Optional[str] = None,
+        max_rollout_attempts: int = 3,
     ):
         self.experiment_name = experiment_name
         self.trial_name = trial_name
@@ -66,6 +69,15 @@ class RolloutWorker:
         self.push_cnt = 0
         self.accepted_cnt = 0
         self._used_qids: set = set()  # recover: skip already-consumed ids
+        # requeue plane: a failed rollout (gen server died mid-trajectory)
+        # goes back into this queue for up to max_rollout_attempts tries —
+        # the manager's sticky mapping was released at finish_rollout, so the
+        # retry routes to a different (healthy) server
+        self.max_rollout_attempts = max_rollout_attempts
+        self._requeue: Deque[SequenceSample] = deque()
+        self._attempts: Dict[str, int] = {}
+        self.requeued_cnt = 0
+        self.dropped_cnt = 0
 
     # ------------------------------------------------------------------ #
 
@@ -105,22 +117,69 @@ class RolloutWorker:
     async def _rollout_task(self, session, prompt: SequenceSample):
         qid = str(prompt.ids[0])
         try:
-            trajs = await self.agent.collect_trajectory(
-                prompt, self.env, self.obs_queue, self._route_queue(qid)
-            )
-            accepted = len(trajs) > 0
+            try:
+                trajs = await self.agent.collect_trajectory(
+                    prompt, self.env, self.obs_queue, self._route_queue(qid)
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self._handle_rollout_failure(qid, prompt, e)
+                trajs, accepted = [], False
+            else:
+                accepted = len(trajs) > 0
+                self._attempts.pop(qid, None)
+            if trajs:
+                try:
+                    # scripted push-path failure (nothing delivered yet, so
+                    # the requeue this triggers cannot duplicate samples)
+                    faults.maybe_fail("rollout.push", qid=qid)
+                except faults.FaultInjected as e:
+                    self._handle_rollout_failure(qid, prompt, e)
+                    trajs, accepted = [], False
             for t in trajs:
-                self.pusher.push(t.as_json_compatible())
-                self.push_cnt += 1
+                if self.pusher.push(t.as_json_compatible()):
+                    self.push_cnt += 1
             if accepted:
                 self.accepted_cnt += 1
-            await self.finish_rollout(session, qid, accepted)
-        except Exception:
-            logger.exception("rollout task %s failed", qid)
-            await self.finish_rollout(session, qid, False)
+            try:
+                # release the manager's capacity slot (and the sticky qid →
+                # server mapping) in every outcome; a requeued sample
+                # re-allocates and re-enters the staleness gate
+                await self.finish_rollout(session, qid, accepted)
+            except Exception:
+                # NEVER requeue on a finish failure — the trajectories may
+                # already be pushed and a retry would duplicate samples; a
+                # leaked running slot on a flaky manager is the lesser risk
+                logger.warning(
+                    "finish_rollout(%s) failed", qid, exc_info=True
+                )
         finally:
             self._tasks.pop(qid, None)
             self._act_queues.pop(qid, None)
+
+    def _handle_rollout_failure(self, qid: str, prompt: SequenceSample, e):
+        """Requeue a failed sample (bounded attempts) instead of finishing
+        it as rejected: the manager released the sticky mapping, so the
+        retry routes to a different (healthy) server."""
+        attempts = self._attempts.get(qid, 0) + 1
+        self._attempts[qid] = attempts
+        if attempts < self.max_rollout_attempts:
+            self.requeued_cnt += 1
+            metrics_mod.counters.add(metrics_mod.FT_ROLLOUT_REQUEUES)
+            logger.warning(
+                "rollout %s failed (attempt %d/%d): %r — requeued",
+                qid, attempts, self.max_rollout_attempts, e,
+            )
+            self._requeue.append(prompt)
+        else:
+            self.dropped_cnt += 1
+            metrics_mod.counters.add(metrics_mod.FT_ROLLOUT_DROPPED)
+            logger.error(
+                "rollout %s failed %d times (%r); dropping sample",
+                qid, attempts, e,
+            )
+            self._attempts.pop(qid, None)
 
     def _route_queue(self, qid: str) -> asyncio.Queue:
         q = self._act_queues.get(qid)
@@ -165,12 +224,25 @@ class RolloutWorker:
                         break
                     steps += 1
                     if len(self._tasks) < self.max_concurrent_tasks:
-                        prompt = carry if carry is not None else self.load_next_data()
+                        # requeued (failed) samples retry before new data
+                        from_requeue = False
+                        if carry is not None:
+                            prompt = carry
+                        elif self._requeue:
+                            prompt = self._requeue.popleft()
+                            from_requeue = True
+                        else:
+                            prompt = self.load_next_data()
                         carry = None
                         if prompt is not None:
                             qid = str(prompt.ids[0])
                             if qid in self._tasks:
-                                pass  # duplicate in flight; move on
+                                if from_requeue:
+                                    # the failed task is still unwinding
+                                    # (awaiting finish_rollout); retry the
+                                    # requeue next tick, don't lose it
+                                    self._requeue.append(prompt)
+                                # else: duplicate in flight; move on
                             elif await self.allocate_new_rollout(session, qid):
                                 self._used_qids.add(f"{qid}@{self._epoch}")
                                 self._route_queue(qid)
@@ -189,8 +261,41 @@ class RolloutWorker:
             dispatch.cancel()
 
     async def drain(self, timeout: float = 300.0):
-        """Wait for all in-flight rollout tasks to finish."""
-        if self._tasks:
-            await asyncio.wait(
-                list(self._tasks.values()), timeout=timeout
-            )
+        """Wait for all in-flight rollout tasks to finish; tasks that miss
+        the deadline are CANCELLED (and their cancellation awaited) so no
+        orphan task keeps generating after the worker believes it has
+        drained, and their manager capacity slots are released (a cancelled
+        task skips its own finish_rollout)."""
+        if not self._tasks:
+            return
+        items = list(self._tasks.items())  # _tasks mutates as tasks finish
+        _, pending = await asyncio.wait(
+            [t for _, t in items], timeout=timeout
+        )
+        if not pending:
+            return
+        abandoned = sorted(qid for qid, t in items if t in pending)
+        for t in pending:
+            t.cancel()
+        await asyncio.gather(*pending, return_exceptions=True)
+        metrics_mod.counters.add(metrics_mod.FT_DRAIN_ABANDONED, len(abandoned))
+        logger.warning(
+            "drain timed out after %.0fs; cancelled %d rollout tasks "
+            "(qids: %s)", timeout, len(abandoned), ", ".join(abandoned),
+        )
+        # best-effort slot release for the cancelled qids — otherwise the
+        # manager's running count stays inflated and tightens the
+        # capacity/staleness gate for every future allocation
+        try:
+            async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=30)
+            ) as session:
+                for qid in abandoned:
+                    try:
+                        await self.finish_rollout(session, qid, False)
+                    except Exception:
+                        logger.warning(
+                            "could not release slot for abandoned %s", qid
+                        )
+        except Exception:
+            logger.warning("slot release after drain failed", exc_info=True)

@@ -63,6 +63,19 @@ class PullerStreamDataset:
             pass
         return out
 
+    def clear(self) -> int:
+        """Drop everything currently buffered; returns the count.  Used by
+        restart-the-world recovery: trajectories in flight at crash time
+        belong to the pre-restart run (stale versions, possibly-duplicate
+        qids) and must not leak into the resumed optimizer."""
+        n = 0
+        while True:
+            try:
+                self._queue.get_nowait()
+                n += 1
+            except queue.Empty:
+                return n
+
     def qsize(self) -> int:
         return self._queue.qsize()
 

@@ -401,13 +401,23 @@ class AsyncPPOTrainerWorker:
             epoch=0, epoch_step=self.step, global_step=self.step
         )
         info = recover.RecoverInfo(
-            recover_start=step_info, last_step_info=step_info
+            recover_start=step_info,
+            last_step_info=step_info,
+            ckpt_ctl_states={"trainer": self._ckpt_ctl.state_dict()},
+            samples_consumed=self.samples_consumed,
+            model_version=self.actor_engine.version,
         )
         if multihost.is_main():
             recover.dump(info)
         multihost.barrier("recover_ckpt")
 
     def load_recover_checkpoint(self) -> bool:
+        """Restart-the-world resume (the load side of
+        ``save_recover_checkpoint``): restore engine state + step counters,
+        republish ``model_version`` and ``training_samples`` so the manager
+        and the gen fleet converge on the RESTORED version (not whatever the
+        crashed run last announced), and drop in-flight trajectories — they
+        were generated against pre-crash weights/counters."""
         root = os.path.join(constants.get_recover_root(), "trainer")
         info = recover.load()
         if info is None or not os.path.exists(os.path.join(root, "actor")):
@@ -418,7 +428,47 @@ class AsyncPPOTrainerWorker:
         ):
             self.critic_engine.load_checkpoint(os.path.join(root, "critic"))
         self.step = info.recover_start.global_step
-        logger.info("recovered trainer at step %d", self.step)
+        self.samples_consumed = info.samples_consumed
+        # the engine checkpoint's version is authoritative; RecoverInfo's
+        # copy exists for cross-checking (a mismatch means the info file and
+        # the engine checkpoint are from different ticks)
+        if info.model_version != self.actor_engine.version:
+            logger.warning(
+                "RecoverInfo model_version %d != engine checkpoint version "
+                "%d; republishing the engine's",
+                info.model_version, self.actor_engine.version,
+            )
+        ctl_state = info.ckpt_ctl_states.get("trainer")
+        if ctl_state:
+            self._ckpt_ctl.load_state_dict(ctl_state)
+        # stale in-flight trajectories: anything the pullers buffered was
+        # born before the restart — drop it on the floor, loudly
+        stale = 0
+        if hasattr(self.stream, "clear"):
+            stale = self.stream.clear()
+        if stale:
+            metrics_mod.counters.add(
+                metrics_mod.FT_STALE_DROPPED_ON_RECOVER, stale
+            )
+            logger.warning(
+                "dropped %d stale in-flight trajectories on recover", stale
+            )
+        # converge the fleet on the restored state: training_samples feeds
+        # the staleness gate; publish_weights re-exports + re-announces the
+        # restored model_version (joined so the announce lands before the
+        # first train step)
+        if multihost.is_main():
+            name_resolve.add(
+                names.training_samples(self.experiment_name, self.trial_name),
+                str(self.samples_consumed),
+                replace=True,
+            )
+        self.publish_weights()
+        self._join_publish()
+        logger.info(
+            "recovered trainer at step %d (v%d, %d samples consumed)",
+            self.step, self.actor_engine.version, self.samples_consumed,
+        )
         return True
 
 

@@ -1,0 +1,65 @@
+"""Tier-1 static async-hygiene pass (tools/check_async_hygiene.py).
+
+Keeps ``areal_tpu/system/`` free of the exact bug class the fault-tolerance
+subsystem fixed: bare ``asyncio.gather(`` without ``return_exceptions``
+(one dead peer aborts the whole fan-out) and discarded ``create_task``
+results (unreferenced tasks can be GC'd; their exceptions vanish).
+"""
+
+import importlib.util
+import os
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_async_hygiene",
+        os.path.join(REPO, "tools", "check_async_hygiene.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_system_layer_is_clean():
+    mod = _checker()
+    findings = mod.scan_paths([os.path.join(REPO, "areal_tpu", "system")])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_checker_flags_bare_gather_and_discarded_task():
+    mod = _checker()
+    src = textwrap.dedent(
+        """
+        import asyncio
+
+        async def bad():
+            await asyncio.gather(one(), two())
+            asyncio.get_event_loop().create_task(three())
+
+        async def good():
+            await asyncio.gather(one(), two(), return_exceptions=True)
+            t = asyncio.get_event_loop().create_task(three())
+            await t
+        """
+    )
+    rules = sorted(f.rule for f in mod.scan_source(src))
+    assert rules == ["bare-gather", "discarded-task"]
+
+
+def test_checker_suppression_and_non_asyncio_gather():
+    mod = _checker()
+    src = textwrap.dedent(
+        """
+        import asyncio
+
+        async def deliberate():
+            await asyncio.gather(one(), two())  # async-hygiene: ok
+
+        def data_join(batch):
+            return SequenceSample.gather(batch)  # not asyncio: ignored
+        """
+    )
+    assert mod.scan_source(src) == []

@@ -18,10 +18,12 @@ from areal_tpu.system.gserver_manager import (
 )
 
 class StubGenServer:
-    """Mock generation server recording update_weights calls."""
+    """Mock generation server recording update_weights calls.
+    ``fail_updates=True`` makes it report update failure (success=False)."""
 
-    def __init__(self):
+    def __init__(self, fail_updates: bool = False):
         self.update_calls = []
+        self.fail_updates = fail_updates
         self.app = web.Application()
         self.app.router.add_post(
             "/update_weights_from_disk", self._update
@@ -31,6 +33,10 @@ class StubGenServer:
     async def _update(self, request):
         d = await request.json()
         self.update_calls.append(d)
+        if self.fail_updates:
+            return web.json_response(
+                {"success": False, "message": "disk error"}
+            )
         return web.json_response(
             {"success": True, "message": "ok", "num_paused_requests": 2}
         )
@@ -143,3 +149,111 @@ async def test_weight_update_fanout(cfg, tmp_path):
     assert await m.check_new_params() is None
     for ts in servers:
         await ts.close()
+
+
+async def _start_stubs(stubs):
+    servers, urls = [], []
+    for s in stubs:
+        ts = TestServer(s.app)
+        await ts.start_server()
+        servers.append(ts)
+        urls.append(str(ts.make_url("")).rstrip("/"))
+    return servers, urls
+
+
+async def test_weight_update_partial_failure_proceeds_on_survivors(
+    cfg, tmp_path
+):
+    """One server reporting failure must not block the fleet: survivors get
+    the new version, the failure is evicted, and the version advances."""
+    stubs = [StubGenServer(), StubGenServer(fail_updates=True), StubGenServer()]
+    servers, urls = await _start_stubs(stubs)
+    m = GserverManager(cfg, server_urls=urls)
+    ckpt = tmp_path / "v1"
+    ckpt.mkdir()
+    name_resolve.add(
+        names.model_version("t", "t", "actor"), f"1:{ckpt}", replace=True
+    )
+    assert await m.check_new_params() == str(ckpt)
+    assert m.version == 1
+    for i in (0, 2):
+        assert len(stubs[i].update_calls) == 1
+        assert m.fleet.get(urls[i]).acked_version == 1
+    assert m.fleet.get(urls[1]).state == "open"
+    assert set(m.fleet.healthy_urls()) == {urls[0], urls[2]}
+    for ts in servers:
+        await ts.close()
+
+
+async def test_poll_loop_does_not_hot_loop_after_partial_failure(
+    cfg, tmp_path
+):
+    """The version bumps despite a failed server, so subsequent poll ticks
+    are no-ops — the old behavior re-flushed the whole fleet every 0.5s
+    forever (and never advanced the version)."""
+    stubs = [StubGenServer(), StubGenServer(fail_updates=True)]
+    servers, urls = await _start_stubs(stubs)
+    m = GserverManager(cfg, server_urls=urls)
+    ckpt = tmp_path / "v1"
+    ckpt.mkdir()
+    name_resolve.add(
+        names.model_version("t", "t", "actor"), f"1:{ckpt}", replace=True
+    )
+    assert await m.check_new_params() == str(ckpt)
+    assert m.version == 1
+    # several poll ticks: nothing re-flushes, neither survivor nor corpse
+    for _ in range(5):
+        assert await m.check_new_params() is None
+    assert len(stubs[0].update_calls) == 1
+    assert len(stubs[1].update_calls) == 1
+    # the evicted server is also out of the next version's fan-out
+    ckpt2 = tmp_path / "v2"
+    ckpt2.mkdir()
+    name_resolve.add(
+        names.model_version("t", "t", "actor"), f"2:{ckpt2}", replace=True
+    )
+    assert await m.check_new_params() == str(ckpt2)
+    assert len(stubs[0].update_calls) == 2
+    assert len(stubs[1].update_calls) == 1
+    for ts in servers:
+        await ts.close()
+
+
+async def test_prune_respects_unacked_servers(cfg, tmp_path):
+    """A checkpoint dir is only deleted once every *healthy* server has
+    acked a version >= the dir's (a slow loader may still be reading it)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, n_checkpoints_to_keep=1)
+    m = GserverManager(cfg, server_urls=["http://a", "http://b"])
+    dirs = []
+    for v in (1, 2, 3):
+        d = tmp_path / f"v{v}"
+        d.mkdir()
+        dirs.append(str(d))
+        m._ckpt_dirs.append(str(d))
+        m._ckpt_versions[str(d)] = v
+    # a acked v3, b lags at v1 → v1's dir may go (min_acked 1 >= 1), but
+    # v2's dir must survive (b may still be loading it)
+    m.fleet.ack_version("http://a", 3)
+    m.fleet.ack_version("http://b", 1)
+    m._prune_checkpoints()
+    assert m._ckpt_dirs == dirs[1:]
+    assert not (tmp_path / "v1").exists()
+    assert (tmp_path / "v2").exists()
+    # b catches up → v2's dir becomes prunable
+    m.fleet.ack_version("http://b", 3)
+    m._prune_checkpoints()
+    assert m._ckpt_dirs == dirs[2:]
+    assert not (tmp_path / "v2").exists()
+    assert (tmp_path / "v3").exists()
+    # an EVICTED laggard does not block pruning (it catches up from the
+    # newest dir on re-admission)
+    m._ckpt_dirs.insert(0, str(tmp_path / "v2b"))
+    (tmp_path / "v2b").mkdir()
+    m._ckpt_versions[str(tmp_path / "v2b")] = 2
+    m.fleet.ack_version("http://a", 2)  # no-op (already 3)
+    m.fleet.get("http://b").acked_version = 1
+    m.fleet.evict("http://b", "test")
+    m._prune_checkpoints()
+    assert not (tmp_path / "v2b").exists()

@@ -1,0 +1,111 @@
+#!/usr/bin/env python3
+"""Static async-hygiene pass over the orchestration layer.
+
+Flags the exact bug class behind the fleet-wedging failure this repo's
+fault-tolerance subsystem fixes (docs/fault_tolerance.md):
+
+1. **Bare ``asyncio.gather(...)``** without ``return_exceptions`` — one dead
+   peer throws, the whole fan-out aborts, and every sibling result is lost
+   (the old ``flush_and_update_weights`` hot-loop).
+2. **Discarded ``create_task``/``ensure_future``** — a task spawned as a
+   bare expression statement is never awaited *and* unreferenced: the event
+   loop may garbage-collect it mid-flight and its exceptions vanish.
+
+Suppress a deliberate violation with ``# async-hygiene: ok`` on the call's
+first line.  Run from the CLI (exits 1 on findings)::
+
+    python tools/check_async_hygiene.py [paths...]
+
+or from tests via :func:`scan_paths` (tier-1:
+``tests/test_async_hygiene.py`` keeps ``areal_tpu/system/`` clean).
+"""
+
+import ast
+import pathlib
+import sys
+from typing import List, NamedTuple
+
+SUPPRESS = "# async-hygiene: ok"
+DEFAULT_PATHS = ["areal_tpu/system"]
+
+
+class Finding(NamedTuple):
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _is_gather(call: ast.Call) -> bool:
+    """Match ``asyncio.gather(...)`` and bare ``gather(...)`` (from-import),
+    but not e.g. ``SequenceSample.gather`` (a data join)."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "gather":
+        return isinstance(f.value, ast.Name) and f.value.id == "asyncio"
+    return isinstance(f, ast.Name) and f.id == "gather"
+
+
+def _is_spawn(call: ast.Call) -> bool:
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else ""
+    )
+    return name in ("create_task", "ensure_future")
+
+
+def _suppressed(lines: List[str], node: ast.AST) -> bool:
+    line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
+    return SUPPRESS in line
+
+
+def scan_source(src: str, path: str = "<string>") -> List[Finding]:
+    findings: List[Finding] = []
+    lines = src.splitlines()
+    tree = ast.parse(src, filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_gather(node):
+            if not any(k.arg == "return_exceptions" for k in node.keywords):
+                if not _suppressed(lines, node):
+                    findings.append(Finding(
+                        path, node.lineno, "bare-gather",
+                        "asyncio.gather without return_exceptions — one "
+                        "failed awaitable aborts the whole fan-out",
+                    ))
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call) \
+                and _is_spawn(node.value):
+            if not _suppressed(lines, node):
+                findings.append(Finding(
+                    path, node.lineno, "discarded-task",
+                    "create_task result discarded — task is unreferenced "
+                    "(may be GC'd) and never awaited (exceptions vanish)",
+                ))
+    return findings
+
+
+def scan_paths(paths) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            findings.extend(scan_source(f.read_text(), str(f)))
+    return findings
+
+
+def main(argv) -> int:
+    paths = argv[1:] or DEFAULT_PATHS
+    findings = scan_paths(paths)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"\n{len(findings)} async-hygiene finding(s).")
+        return 1
+    print("async hygiene clean.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
