@@ -294,6 +294,14 @@ def trainer_main(cfg):
         TrainerControl,
     )
 
+    from areal_tpu.system import worker_base
+
+    # preemption plane: SIGTERM/SIGINT (how a preemptible slice ends a
+    # trial) flips a flag the train loop polls; the worker then commits a
+    # recover checkpoint within the deadline and we exit EXIT_PREEMPTED,
+    # which run_async_ppo maps to "preempted, restart-the-world"
+    shutdown = worker_base.GracefulShutdown.from_env()
+    watchdog_timeout = worker_base.watchdog_timeout_from_env()
     total = cfg.control.total_train_steps
     # bind the puller first so rollout workers can rendezvous while the
     # engines load/compile
@@ -313,6 +321,7 @@ def trainer_main(cfg):
             ckpt_freq_steps=cfg.control.ckpt_freq_steps,
             ckpt_freq_secs=cfg.control.ckpt_freq_secs,
             weight_sync_freq_steps=cfg.control.weight_sync_freq_steps,
+            watchdog_timeout_secs=watchdog_timeout,
         ),
         train_batch_size=cfg.train_batch_size,
         mb_spec=cfg.mb_spec,
@@ -332,7 +341,9 @@ def trainer_main(cfg):
     if not recovered:
         # publish v0 weights so the fleet starts from the trainer's init
         worker.publish_weights()
-    worker.run()
+    worker.run(shutdown=shutdown)
+    if worker.preempted:
+        sys.exit(worker_base.EXIT_PREEMPTED)
 
 
 def evaluator_main(cfg, stop_event=None):
@@ -536,11 +547,40 @@ def run_async_ppo(cfg) -> int:
                     p.terminate()
             for p in procs.values():
                 p.join(timeout=10)
+            # SIGKILL escalation: the trainer's GracefulShutdown turns
+            # SIGTERM into a (possibly minutes-long) preemption save, and a
+            # straggler outliving the join would overlap the next attempt's
+            # freshly spawned world (same staging dirs, same devices). The
+            # commit protocol makes the hard kill safe: the previous
+            # committed checkpoint survives a death mid-save.
+            for name, p in procs.items():
+                if p.is_alive():
+                    logger.warning(
+                        "%s survived terminate(); escalating to kill", name
+                    )
+                    p.kill()
+                    p.join(timeout=10)
         if trainer.exitcode == 0 and not failed:
             return 0
+        if trainer.exitcode == worker_base.EXIT_PREEMPTED and not failed:
+            # NOT a crash: the trainer committed a recover checkpoint inside
+            # its deadline — restart-the-world resumes it (recover_mode
+            # auto), or the code propagates so an outer scheduler can.
+            # (With `failed` set, exit 75 just means OUR teardown SIGTERMed
+            # the trainer after a sibling died — that is the crash path.)
+            logger.warning(
+                "trainer preempted (exit %d): recover checkpoint committed; "
+                "restart-the-world", worker_base.EXIT_PREEMPTED,
+            )
         if cfg.recover_mode != "auto":
             break
-    return trainer.exitcode if trainer.exitcode is not None else 1
+    rc = trainer.exitcode if trainer.exitcode is not None else 1
+    if failed and rc == worker_base.EXIT_PREEMPTED:
+        # a sibling worker's crash triggered the teardown; reporting the
+        # trainer's teardown-induced exit code would tell an outer
+        # scheduler "state intact, try again" about a reproducible crash
+        rc = 1
+    return rc
 
 
 def run_sync_ppo(cfg) -> int:

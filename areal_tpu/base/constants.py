@@ -22,6 +22,11 @@ WEIGHT_SYNC_IMPL_ENV = "AREAL_WEIGHT_SYNC_IMPL"  # DISK (default) | DCN
 # default ON; "0"/"false"/"off" disables, an integer sets the depth.
 FWD_PIPELINE_ENV = "AREAL_FWD_PIPELINE"       # dispatch-ahead forward()
 TRAIN_PREFETCH_ENV = "AREAL_TRAIN_PREFETCH"   # minibatch prefetch + deferred stats
+# Trainer survivability (docs/fault_tolerance.md "Trainer survivability").
+TRAIN_GUARD_ENV = "AREAL_TRAIN_GUARD"         # on-device finite-ness guard (default on)
+PREEMPT_DEADLINE_ENV = "AREAL_PREEMPT_DEADLINE_S"  # SIGTERM -> ckpt-save budget
+WATCHDOG_TIMEOUT_ENV = "AREAL_WATCHDOG_TIMEOUT_S"  # 0/unset disables the watchdog
+WATCHDOG_ABORT_ENV = "AREAL_WATCHDOG_ABORT"   # dump AND exit so the scheduler restarts
 
 
 def set_experiment_trial_names(experiment_name: str, trial_name: str):
@@ -96,6 +101,10 @@ def get_env_vars(**extra) -> dict:
         WEIGHT_SYNC_IMPL_ENV,
         FWD_PIPELINE_ENV,
         TRAIN_PREFETCH_ENV,
+        TRAIN_GUARD_ENV,
+        PREEMPT_DEADLINE_ENV,
+        WATCHDOG_TIMEOUT_ENV,
+        WATCHDOG_ABORT_ENV,
         "JAX_PLATFORMS",
         "XLA_FLAGS",
         "TPU_VISIBLE_DEVICES",

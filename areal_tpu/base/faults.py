@@ -25,6 +25,9 @@ Actions
   no response (semantically: the server may have seen it).
 - ``delay`` — sleep ``delay_s`` then proceed (async points use
   :func:`maybe_fail_async` so the event loop is not blocked).
+- ``trip`` — do not raise; the *dedicated* check :func:`maybe_trip` returns
+  True so the production code takes its own fault path (poison a train
+  step, simulate a delivered SIGTERM). ``maybe_fail`` ignores trip rules.
 
 Injection-point catalog (kept in sync with ``docs/fault_tolerance.md``):
 
@@ -34,6 +37,12 @@ point                 where                                      kwargs
 ``gen.http``          every GenAPIClient request attempt         url, op
 ``gen.weight_update`` GenAPIClient.update_weights_from_disk      url
 ``rollout.push``      RolloutWorker trajectory push              qid
+``ckpt.save``         engine checkpoint commit (post-stage,      path
+                      pre-manifest: simulates dying mid-save)
+``train.step``        TrainEngine.train_prepared (trip: poison   step
+                      the step's loss weights -> non-finite)
+``signal.term``       GracefulShutdown.should_stop (trip:        (none)
+                      simulate a delivered SIGTERM)
 ====================  ========================================  ==========
 """
 
@@ -88,7 +97,7 @@ def inject(
     **match,
 ) -> FaultRule:
     """Arm a fault at ``point``. Returns the rule (inspect ``.fired``)."""
-    assert action in ("fail", "drop", "delay"), action
+    assert action in ("fail", "drop", "delay", "trip"), action
     global _enabled
     rule = FaultRule(
         point=point, action=action, match=match, times=times,
@@ -113,10 +122,19 @@ def active() -> bool:
     return _enabled
 
 
-def _pick(point: str, kw: Dict[str, object]) -> Optional[FaultRule]:
+def _pick(
+    point: str, kw: Dict[str, object], actions: tuple
+) -> Optional[FaultRule]:
+    # actions filters which rule kinds this check site can fire: a raise
+    # site must never consume a trip rule's call-count window (and vice
+    # versa) — the window semantics stay per-site deterministic.
     with _lock:
         for rule in _rules:
-            if rule.point == point and rule._matches(kw):
+            if (
+                rule.point == point
+                and rule.action in actions
+                and rule._matches(kw)
+            ):
                 rule.seen += 1
                 if rule._should_fire():
                     rule.fired += 1
@@ -140,7 +158,7 @@ def maybe_fail(point: str, **kw) -> None:
     """Sync injection point: no-op unless a matching rule is armed."""
     if not _enabled:
         return
-    rule = _pick(point, kw)
+    rule = _pick(point, kw, ("fail", "drop", "delay"))
     if rule is None:
         return
     delay = _fire(rule, point, kw)
@@ -148,11 +166,28 @@ def maybe_fail(point: str, **kw) -> None:
         time.sleep(delay)
 
 
+def maybe_trip(point: str, **kw) -> bool:
+    """Non-raising injection point: True when an armed ``trip`` rule fires.
+    The caller takes its own fault path (poison a value, request a stop) —
+    used where an exception would not model the failure (a NaN loss, a
+    delivered signal)."""
+    if not _enabled:
+        return False
+    rule = _pick(point, kw, ("trip",))
+    if rule is None:
+        return False
+    from areal_tpu.base import metrics
+
+    metrics.counters.add(f"faults/{point}")
+    logger.warning("tripped fault at %s (%s, hit #%d)", point, kw, rule.fired)
+    return True
+
+
 async def maybe_fail_async(point: str, **kw) -> None:
     """Async injection point — delays yield to the event loop."""
     if not _enabled:
         return
-    rule = _pick(point, kw)
+    rule = _pick(point, kw, ("fail", "drop", "delay"))
     if rule is None:
         return
     delay = _fire(rule, point, kw)

@@ -103,6 +103,23 @@ FT_PRUNE_DEFERRED = "ft/prune_deferred"            # ckpt prune blocked by un-ac
 FT_PUSH_DROPS = "ft/push_drops"                    # ZMQ push timed out; trajectory dropped
 FT_DRAIN_ABANDONED = "ft/drain_abandoned"          # tasks cancelled at drain timeout
 FT_STALE_DROPPED_ON_RECOVER = "ft/stale_dropped_on_recover"
+FT_PUBLISH_FAILURES = "ft/publish_failures"        # background weight publish raised
+FT_PREEMPTIONS = "ft/preemptions"                  # graceful-stop requests honored
+
+
+# --------------------------------------------------------------------- #
+# Trainer guardrail namespace (``guard/``) — the step-level anomaly plane
+# (docs/fault_tolerance.md "Trainer survivability"): on-device finite-ness
+# checks, skipped optimizer updates, rollbacks to the last committed
+# checkpoint, watchdog stack dumps.
+# --------------------------------------------------------------------- #
+
+GUARD_ANOMALOUS_STEPS = "guard/anomalous_steps"    # non-finite loss/grad_norm observed
+GUARD_SKIPPED_UPDATES = "guard/update_skipped"     # optimizer update selected away on device
+GUARD_ROLLBACKS = "guard/rollbacks"                # K consecutive anomalies -> ckpt rollback
+GUARD_ROLLBACK_FAILED = "guard/rollback_failed"    # wanted to roll back; no committed ckpt
+GUARD_CKPT_FALLBACKS = "guard/ckpt_fallbacks"      # committed sibling promoted over a missing/uncommitted canonical dir
+GUARD_WATCHDOG_DUMPS = "guard/watchdog_dumps"      # hang watchdog dumped thread stacks
 
 
 class MetricLogger:

@@ -9,10 +9,34 @@ xprof / tensorboard-plugin-profile).
 
 import contextlib
 import os
+import threading
 import time
+from typing import Dict, List
 
 from areal_tpu.base import constants
 from areal_tpu.base import metrics as metrics_mod
+
+# Live-span registry: every open tracing.span is visible here, so the hang
+# watchdog (system/worker_base.HangWatchdog) can report WHAT a wedged worker
+# was doing (e.g. "train_pipe/dispatch open for 1800s") alongside raw thread
+# stacks — without any profiler attached.
+_live_lock = threading.Lock()
+_live: List[dict] = []
+
+
+def live_spans() -> List[Dict[str, object]]:
+    """Snapshot of currently-open spans: name, seconds open, thread name.
+    Oldest first (the outermost wedged span is the interesting one)."""
+    now = time.perf_counter()
+    with _live_lock:
+        return [
+            {
+                "name": r["name"],
+                "elapsed_s": now - r["t0"],
+                "thread": r["thread"],
+            }
+            for r in _live
+        ]
 
 
 def trace_enabled() -> bool:
@@ -67,9 +91,19 @@ def span(name: str):
     xplane trace (a ``time.perf_counter`` pair is ~100 ns — free against
     any of those stages)."""
     t0 = time.perf_counter()
+    rec = {
+        "name": name, "t0": t0, "thread": threading.current_thread().name,
+    }
+    with _live_lock:
+        _live.append(rec)
     try:
         with annotate(name):
             yield
     finally:
+        with _live_lock:
+            try:
+                _live.remove(rec)
+            except ValueError:
+                pass
         metrics_mod.counters.add(f"{name}_s", time.perf_counter() - t0)
         metrics_mod.counters.add(f"{name}_n", 1.0)

@@ -29,7 +29,6 @@ import asyncio
 import dataclasses
 import logging
 import os
-import shutil
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional
@@ -254,9 +253,15 @@ class GserverManager:
     def _prune_checkpoints(self):
         """Delete superseded checkpoint dirs — but only dirs whose version
         every *healthy* server has acked moving past (a slow server may
-        still be reading an older dir) and that no catch-up load holds."""
+        still be reading an older dir) and that no catch-up load holds.
+        The newest (committed) snapshot is never deleted, whatever the
+        keep-count says: it is the fleet's only catch-up/restart source."""
+        from areal_tpu.base import recover
+
         while len(self._ckpt_dirs) > self.config.n_checkpoints_to_keep:
             old = self._ckpt_dirs[0]
+            if old == self._latest_path:
+                break  # never the last committed snapshot
             v = self._ckpt_versions.get(old, -1)
             if (
                 self._catchup_paths.get(old, 0) > 0
@@ -270,7 +275,7 @@ class GserverManager:
                 break
             self._ckpt_dirs.pop(0)
             self._ckpt_versions.pop(old, None)
-            shutil.rmtree(old, ignore_errors=True)
+            recover.discard_checkpoint(old)
 
     # ------------------------------------------------------------------ #
     # health probing / re-admission

@@ -23,6 +23,7 @@ from areal_tpu.api.data import MicroBatchSpec, SequenceSample
 from areal_tpu.api.dataset import dataset_metadata
 from areal_tpu.api.model import GenerationHyperparameters, PPOHyperparameters
 from areal_tpu.base import constants
+from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.base.metrics import MetricLogger
 from areal_tpu.experiments import graphs
 from areal_tpu.parallel import multihost
@@ -191,6 +192,17 @@ class SyncPPOTrainerWorker:
         # deferred-stats discipline buys nothing here — pull all device
         # scalars in ONE transfer and keep per-step host floats
         stats = fetch_stats_dict(stats)
+        # guardrail plane (per-step fetch -> zero detection lag here): the
+        # poisoned update was already skipped on-device; count it and warn.
+        # Sync PPO generates from the trainer's own params, so a skipped
+        # update also protects the NEXT rollout batch from poisoned weights.
+        if float(stats.get("guard/step_ok", 1.0)) < 1.0:
+            metrics_mod.counters.add(metrics_mod.GUARD_ANOMALOUS_STEPS)
+            metrics_mod.counters.add(metrics_mod.GUARD_SKIPPED_UPDATES)
+            logger.warning(
+                "step %d: non-finite loss/grad_norm; optimizer update was "
+                "skipped on device", self.step,
+            )
         stats["timeperf/gen"] = t_gen
         stats["timeperf/e2e"] = time.perf_counter() - t0
         if "flops" in stats:  # train-side FLOPs only (gen not counted)

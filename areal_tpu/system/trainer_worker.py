@@ -57,6 +57,13 @@ class TrainerControl:
     # round trip that stalls the dispatch pipeline. Inactive (per-step
     # fetch) when AREAL_TRAIN_PREFETCH is off.
     stats_log_freq_steps: int = 8
+    # guardrail plane: after this many CONSECUTIVE anomalous steps (each
+    # one's optimizer update was already skipped on-device), roll the engine
+    # back to the last committed recover checkpoint — persistent anomalies
+    # mean the live params/opt state are themselves suspect. 0 disables.
+    guard_rollback_steps: int = 3
+    # hang watchdog threshold for the train loop (None/0 = disabled)
+    watchdog_timeout_secs: Optional[float] = None
 
 
 class AsyncPPOTrainerWorker:
@@ -147,6 +154,16 @@ class AsyncPPOTrainerWorker:
         # triples awaiting the per-logging-interval device_get
         self._pending_stats: List = []
         self._counters_before = metrics_mod.counters.snapshot()
+        # guardrail plane: consecutive anomalous steps observed at stats
+        # flush time; at control.guard_rollback_steps the engines roll back
+        # to the last committed recover checkpoint
+        self._consec_anomalies = 0
+        self.preempted = False
+        self._watchdog = None  # set by run() while its loop is live
+
+    def _bump_watchdog(self):
+        if self._watchdog is not None:
+            self._watchdog.bump()
 
     # ------------------------------------------------------------------ #
     # weight sync + counters (the async critical path, §3.5)
@@ -187,6 +204,10 @@ class AsyncPPOTrainerWorker:
             t.join()
             self._publish_thread = None
             if t._areal_exc is not None:
+                # surfaced, never swallowed: a failed export means the fleet
+                # would keep serving a version the trainer believes it
+                # published — stop the world loudly and observably
+                metrics_mod.counters.add(metrics_mod.FT_PUBLISH_FAILURES)
                 raise RuntimeError(
                     "background weight publish failed"
                 ) from t._areal_exc
@@ -324,11 +345,13 @@ class AsyncPPOTrainerWorker:
                 self.actor_if.save(self.actor_engine, save_dir)
             else:  # custom graph without an "actor_train" node
                 self.actor_engine.save_hf(save_dir, self.hf_family)
+            self._bump_watchdog()  # a slow HF export is not a hang
         # process 0's timer decides for everyone: save_recover_checkpoint
         # contains collectives, so a wall-clock boundary straddled across
         # hosts must not split the control flow
         if multihost.main_decides(self._ckpt_ctl.check(steps=1)):
             self.save_recover_checkpoint()
+            self._bump_watchdog()  # a slow committed save is not a hang
         # Deferred stats: device scalars in `stats` are NOT pulled here —
         # they queue (with this step's wall-clock, for honest jsonl
         # timestamps) and flush as ONE device_get per logging interval, so
@@ -347,7 +370,12 @@ class AsyncPPOTrainerWorker:
 
     def flush_stats(self):
         """Pull every pending step's device scalars in ONE transfer and log
-        them with their original per-step timestamps."""
+        them with their original per-step timestamps. This is also where the
+        guardrail plane runs its host-side accounting: ``guard/step_ok``
+        rides the same deferred fetch (no extra round trip), so anomaly
+        detection lags at most one logging interval behind the device —
+        acceptable because the poisoned updates were already skipped
+        on-device; the host only decides about ROLLBACK."""
         if not self._pending_stats:
             return
         import jax
@@ -360,19 +388,144 @@ class AsyncPPOTrainerWorker:
             fetched = jax.device_get([s for (_, _, s) in pending])
         for (step, wall, _), stats in zip(pending, fetched):
             host = host_stats_view(stats)
+            # step_ok is the minibatch-mean of the on-device finite-ness
+            # flag: < 1.0 means at least one minibatch's update was skipped
+            ok = float(host.get("guard/step_ok", 1.0))
+            if ok < 1.0:
+                self._consec_anomalies += 1
+                metrics_mod.counters.add(metrics_mod.GUARD_ANOMALOUS_STEPS)
+                metrics_mod.counters.add(metrics_mod.GUARD_SKIPPED_UPDATES)
+                logger.warning(
+                    "step %d: non-finite loss/grad_norm (step_ok=%.2f); "
+                    "optimizer update was skipped on device "
+                    "(%d consecutive anomalous steps)",
+                    step, ok, self._consec_anomalies,
+                )
+            else:
+                self._consec_anomalies = 0
             if self.metrics is not None and multihost.is_main():
                 self.metrics.log(
                     {k: v for k, v in host.items() if np.isscalar(v)},
                     step, prefix="ppo", wall_time=wall,
                 )
+        k = self.control.guard_rollback_steps
+        if k and self._consec_anomalies >= k:
+            self._rollback_to_committed()
 
-    def run(self):
+    def _rollback_to_committed(self) -> bool:
+        """K consecutive anomalous steps: the live params/opt state are
+        suspect even though each poisoned update was skipped (e.g. the
+        anomaly source is the data path or an earlier corruption) — restore
+        the engines from the last COMMITTED recover checkpoint and republish
+        the restored weights so the fleet stops sampling from a trainer
+        whose next publish would have been poisoned."""
+        root = os.path.join(constants.get_recover_root(), "trainer")
+        actor_path = os.path.join(root, "actor")
+        critic_path = os.path.join(root, "critic")
+        # FULLY validate every engine's checkpoint (manifest presence AND
+        # checksums, promoting an unswapped committed sibling) before
+        # touching ANY engine: a raise after the actor restore would leave
+        # a reverted actor paired with a live critic several versions
+        # ahead (silently corrupting the value baseline)
+        try:
+            self.actor_engine.validate_checkpoint(actor_path)
+            if self.critic_engine is not None:
+                self.critic_engine.validate_checkpoint(critic_path)
+        except (FileNotFoundError, ValueError) as e:
+            metrics_mod.counters.add(metrics_mod.GUARD_ROLLBACK_FAILED)
+            logger.error(
+                "anomaly rollback wanted but not every engine has a "
+                "restorable committed recover checkpoint (%s); continuing "
+                "with current params", e,
+            )
+            self._consec_anomalies = 0
+            return False
+        live_version = self.actor_engine.version
+        # both pre-validated above: a raise here is unexpected corruption
+        # mid-restore, and stopping the world beats training on a mix of
+        # restored and live ticks — so no catch
+        self.actor_engine.load_checkpoint(actor_path)
+        if self.critic_engine is not None:
+            self.critic_engine.load_checkpoint(critic_path)
+        restored_version = self.actor_engine.version
+        # The restored weights must be REPUBLISHED under a NEW version: the
+        # manager's check_new_params ignores version <= its current one, so
+        # announcing the restored (older) number would be silently dropped
+        # and the fleet would keep serving the suspect weights.
+        self.actor_engine.version = max(live_version, restored_version) + 1
+        self._consec_anomalies = 0
+        metrics_mod.counters.add(metrics_mod.GUARD_ROLLBACKS)
+        logger.warning(
+            "rolled back to committed checkpoint (engine step %d, restored "
+            "v%d, republishing as v%d) after %d consecutive anomalous steps",
+            self.actor_engine._step, restored_version,
+            self.actor_engine.version, self.control.guard_rollback_steps,
+        )
+        # trajectories buffered or in flight were generated by the suspect
+        # policy — drop them before the restored params train on them (the
+        # same stale-data hazard load_recover_checkpoint handles)
+        stale = self._buffer.clear()
+        if hasattr(self.stream, "clear"):
+            stale += self.stream.clear()
+        if stale:
+            metrics_mod.counters.add(
+                metrics_mod.FT_STALE_DROPPED_ON_RECOVER, stale
+            )
+            logger.warning(
+                "dropped %d suspect buffered/in-flight trajectories on "
+                "rollback", stale,
+            )
+        self.publish_weights()
+        return True
+
+    def run(self, shutdown=None):
+        """Main loop. ``shutdown`` (a :class:`worker_base.GracefulShutdown`)
+        makes SIGTERM/SIGINT end the loop through
+        :meth:`_handle_preemption`: commit a recover checkpoint, republish
+        ``model_version``, set ``self.preempted`` so the caller exits with
+        the distinct preemption code."""
+        from areal_tpu.system import worker_base
+
+        watchdog = None
+        if self.control.watchdog_timeout_secs:
+            watchdog = worker_base.HangWatchdog(
+                "trainer", timeout_s=self.control.watchdog_timeout_secs
+            ).start()
+        # run_step bumps this around its own legitimate long stalls
+        # (periodic committed save, HF export) so a slow checkpoint is
+        # never mistaken for a hang; the remaining un-bumpable stall is
+        # the first-step jit compile — size the timeout above it
+        self._watchdog = watchdog
         try:
             while self.step < self.control.total_train_steps:
+                # process 0 decides for everyone: SIGTERM lands on each
+                # host at a slightly different instant, and a host-local
+                # branch into the (collective-bearing) preemption save while
+                # siblings are mid-train-step would deadlock the pod — the
+                # same rule as the ckpt timer below (multihost.main_decides).
+                # Cost: one extra per-step allgather on multihost (free
+                # single-host), marginal next to _collect_batch's existing
+                # per-iteration allreduces.
+                if shutdown is not None and multihost.main_decides(
+                    shutdown.should_stop()
+                ):
+                    # the preemption save is a legitimate long stall: the
+                    # watchdog must not dump (or, abort-gated, kill us)
+                    # mid-commit of the very checkpoint preemption exists
+                    # to produce
+                    if watchdog is not None:
+                        watchdog.stop()
+                    self._handle_preemption(shutdown)
+                    break
+                if watchdog is not None:
+                    watchdog.bump()
                 if self.run_step() is None:
                     logger.warning("no data from rollout stream; stopping")
                     break
         finally:
+            if watchdog is not None:
+                watchdog.stop()
+            self._watchdog = None
             # trailing deferred stats must land in the jsonl before exit
             # (the bench/judge reads it) — best-effort: after a device-side
             # crash the pending device_get raises again, and that secondary
@@ -387,6 +540,42 @@ class AsyncPPOTrainerWorker:
             finally:
                 self._join_publish()
         return self.step
+
+    def _handle_preemption(self, shutdown):
+        """Graceful-stop path: inside the deadline, commit a recover
+        checkpoint (atomic — dying mid-save leaves the previous one) and
+        republish ``model_version`` so the restarted world converges on the
+        committed state, not whatever the dying run last announced."""
+        self.preempted = True
+        # start the deadline clock on hosts whose own signal has not landed
+        # yet (process 0 decided for everyone)
+        shutdown.request()
+        metrics_mod.counters.add(metrics_mod.FT_PREEMPTIONS)
+        t0 = time.monotonic()
+        logger.warning(
+            "preemption: saving recover checkpoint at step %d "
+            "(%.0fs deadline)", self.step, shutdown.remaining(),
+        )
+        try:
+            self.flush_stats()  # guard accounting + jsonl before the save
+        except Exception:
+            logger.exception("stats flush failed during preemption")
+        self.save_recover_checkpoint()
+        self.publish_weights()
+        self._join_publish()
+        took = time.monotonic() - t0
+        if shutdown.remaining() <= 0:
+            logger.error(
+                "preemption save took %.1fs and overran the %.0fs deadline "
+                "— the checkpoint is committed, but raise %s if the "
+                "scheduler hard-killed us first",
+                took, shutdown.deadline_s, constants.PREEMPT_DEADLINE_ENV,
+            )
+        else:
+            logger.info(
+                "preemption save committed in %.1fs (%.0fs to spare)",
+                took, shutdown.remaining(),
+            )
 
     # ------------------------------------------------------------------ #
     # recovery (≈ master_worker.__recover_save:585)
@@ -420,18 +609,42 @@ class AsyncPPOTrainerWorker:
         were generated against pre-crash weights/counters."""
         root = os.path.join(constants.get_recover_root(), "trainer")
         info = recover.load()
-        if info is None or not os.path.exists(os.path.join(root, "actor")):
+        if info is None:
             return False
-        self.actor_engine.load_checkpoint(os.path.join(root, "actor"))
-        if self.critic_engine is not None and os.path.exists(
-            os.path.join(root, "critic")
-        ):
-            self.critic_engine.load_checkpoint(os.path.join(root, "critic"))
+        actor_path = os.path.join(root, "actor")
+        critic_path = os.path.join(root, "critic")
+        load_critic = self.critic_engine is not None and os.path.exists(
+            critic_path
+        )
+        try:
+            # validate EVERY engine's manifest+checksums BEFORE restoring
+            # ANY: a raise after the actor restore would pair a restored
+            # actor with a fresh/live critic and then publish that mix as
+            # if it were a coherent tick. An uncommitted (crashed mid-save)
+            # or corrupt dir raises here and the trial starts fresh — which
+            # cannot happen when the crash hit DURING a save, because the
+            # commit protocol only replaces the previous checkpoint by an
+            # atomic rename after the new one is fully on disk.
+            self.actor_engine.validate_checkpoint(actor_path)
+            if load_critic:
+                self.critic_engine.validate_checkpoint(critic_path)
+            self.actor_engine.load_checkpoint(actor_path)
+            if load_critic:
+                self.critic_engine.load_checkpoint(critic_path)
+        except (FileNotFoundError, ValueError) as e:
+            logger.error(
+                "recover checkpoint not restorable (%s); starting fresh", e
+            )
+            return False
         self.step = info.recover_start.global_step
         self.samples_consumed = info.samples_consumed
-        # the engine checkpoint's version is authoritative; RecoverInfo's
-        # copy exists for cross-checking (a mismatch means the info file and
-        # the engine checkpoint are from different ticks)
+        # the ENGINE checkpoint's version is authoritative everywhere the
+        # version is republished below (publish_weights reads
+        # actor_engine.version); RecoverInfo's copy exists for
+        # cross-checking only — a mismatch means the info file and the
+        # engine checkpoint are from different ticks, and a stale
+        # RecoverInfo value must never win (tested in
+        # tests/test_fault_tolerance.py)
         if info.model_version != self.actor_engine.version:
             logger.warning(
                 "RecoverInfo model_version %d != engine checkpoint version "

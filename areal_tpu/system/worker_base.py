@@ -1,4 +1,5 @@
-"""Worker lifecycle: experiment death watch + heartbeats.
+"""Worker lifecycle: experiment death watch, heartbeats, graceful
+preemption, and a hang watchdog.
 
 Counterpart of the reference's worker framework
 (``realhf/system/worker_base.py:474`` poll/control loop) and its
@@ -13,14 +14,33 @@ The launcher is the lifecycle owner: it marks the experiment RUNNING at
 spawn and STOPPED at teardown (``mark_experiment_running/stopped``). Workers
 poll via :class:`ExperimentStatusWatch` and optionally publish heartbeats
 (`worker_status/<name>` timestamps) the launcher can inspect.
+
+Trainer survivability (docs/fault_tolerance.md "Trainer survivability"):
+
+- :class:`GracefulShutdown` turns SIGTERM/SIGINT (the normal way a
+  preemptible TPU slice ends a trial) into a flag the train loop polls; the
+  trainer saves a committed recover checkpoint within the deadline and
+  exits :data:`EXIT_PREEMPTED`, which the launcher maps to
+  "preempted, restart-the-world" rather than a crash.
+- :class:`HangWatchdog` is a monotonic heartbeat bumped once per
+  train/drain step plus a thread that, past a threshold, dumps every
+  thread's stack and the live ``tracing.span`` registry to the log (and,
+  env-gated via ``AREAL_WATCHDOG_ABORT``, exits :data:`EXIT_WATCHDOG` so
+  the scheduler restarts the world instead of burning the slice on a hung
+  collective).
 """
 
 import logging
+import os
+import signal as signal_mod
+import sys
 import threading
 import time
-from typing import Optional
+import traceback
+from typing import Callable, Optional
 
-from areal_tpu.base import name_resolve, names
+from areal_tpu.base import constants, faults, name_resolve, names, tracing
+from areal_tpu.base import metrics as metrics_mod
 
 logger = logging.getLogger("areal_tpu.worker_base")
 
@@ -30,6 +50,13 @@ STATUS_STOPPED = "stopped"
 # A worker exits when the status key has been absent/not-RUNNING for this
 # long (grace for launcher startup races and slow shared filesystems).
 DEFAULT_DEATH_TIMEOUT = 300.0
+
+# Distinct trainer exit codes the launcher switches on. 75 = EX_TEMPFAIL
+# ("try again"): the trial state is intact — a committed recover checkpoint
+# was saved — and a restart resumes it. 76: the watchdog killed a hung
+# worker; state is whatever the last committed checkpoint holds.
+EXIT_PREEMPTED = 75
+EXIT_WATCHDOG = 76
 
 
 def mark_experiment_running(experiment_name: str, trial_name: str):
@@ -149,3 +176,201 @@ def last_heartbeat(
         )
     except (name_resolve.NameEntryNotFoundError, ValueError):
         return None
+
+
+# --------------------------------------------------------------------- #
+# Preemption plane
+# --------------------------------------------------------------------- #
+
+
+def _env_float(name: str, default: float) -> float:
+    """Tolerant env knob parse: a malformed value falls back to the default
+    (logged) instead of crashing the worker at startup."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("ignoring malformed %s=%r (using %s)", name, raw, default)
+        return default
+
+
+def watchdog_timeout_from_env() -> Optional[float]:
+    """``AREAL_WATCHDOG_TIMEOUT_S`` as a timeout, or None (disabled)."""
+    timeout = _env_float(constants.WATCHDOG_TIMEOUT_ENV, 0.0)
+    return timeout if timeout > 0 else None
+
+
+class GracefulShutdown:
+    """SIGTERM/SIGINT → a graceful-stop request with a save deadline.
+
+    Preemptible TPU slices deliver SIGTERM with a grace window before the
+    hard kill; the train loop polls :meth:`should_stop` once per step and,
+    when set, saves a committed recover checkpoint, republishes
+    ``model_version``, and exits :data:`EXIT_PREEMPTED`. The ``signal.term``
+    fault point lets tests script a delivery without process machinery.
+    Handlers only install on the main thread (Python's restriction); worker
+    threads can still poll a shared instance.
+    """
+
+    def __init__(self, deadline_s: float = 60.0, install: bool = True):
+        self.deadline_s = deadline_s
+        self.requested_at: Optional[float] = None
+        self._event = threading.Event()
+        self._prev = {}
+        if install:
+            self.install()
+
+    @classmethod
+    def from_env(cls, install: bool = True) -> "GracefulShutdown":
+        return cls(
+            deadline_s=_env_float(constants.PREEMPT_DEADLINE_ENV, 60.0),
+            install=install,
+        )
+
+    def install(self, sigs=(signal_mod.SIGTERM, signal_mod.SIGINT)):
+        try:
+            for s in sigs:
+                self._prev[s] = signal_mod.signal(s, self._on_signal)
+        except ValueError:
+            logger.warning(
+                "not on the main thread; preemption signal handlers not "
+                "installed (should_stop still honors request()/faults)"
+            )
+        return self
+
+    def uninstall(self):
+        for s, h in self._prev.items():
+            signal_mod.signal(s, h)
+        self._prev = {}
+
+    def _on_signal(self, signum, frame):
+        logger.warning(
+            "received signal %d: graceful stop requested (%.0fs deadline "
+            "to commit a recover checkpoint)", signum, self.deadline_s,
+        )
+        self.request()
+
+    def request(self):
+        if self.requested_at is None:
+            self.requested_at = time.monotonic()
+        self._event.set()
+
+    def should_stop(self) -> bool:
+        if self._event.is_set():
+            return True
+        if faults.maybe_trip("signal.term"):
+            self.request()
+            return True
+        return False
+
+    def remaining(self) -> float:
+        """Seconds left of the save deadline (inf before any request)."""
+        if self.requested_at is None:
+            return float("inf")
+        return max(
+            self.deadline_s - (time.monotonic() - self.requested_at), 0.0
+        )
+
+
+# --------------------------------------------------------------------- #
+# Watchdog plane
+# --------------------------------------------------------------------- #
+
+
+def _watchdog_abort_enabled() -> bool:
+    return os.environ.get(constants.WATCHDOG_ABORT_ENV, "0") not in (
+        "", "0", "false", "off",
+    )
+
+
+class HangWatchdog:
+    """Detects a wedged worker: a monotonic heartbeat (:meth:`bump`, once
+    per train/rollout-drain step) plus a daemon thread that, once the
+    heartbeat goes stale past ``timeout_s``, logs every thread's stack and
+    the open ``tracing.span`` registry — a hung collective or jitted step
+    then shows exactly WHERE the fleet is stuck instead of wedging
+    silently. With ``AREAL_WATCHDOG_ABORT`` set it additionally exits
+    :data:`EXIT_WATCHDOG` (``os._exit``: a hung XLA runtime ignores
+    graceful teardown) so the scheduler can restart the world.
+    """
+
+    def __init__(
+        self,
+        name: str = "trainer",
+        timeout_s: float = 600.0,
+        poll_interval: Optional[float] = None,
+        on_dump: Optional[Callable[[float], None]] = None,
+    ):
+        self.name = name
+        self.timeout_s = timeout_s
+        self.poll_interval = (
+            poll_interval
+            if poll_interval is not None
+            else min(max(timeout_s / 4.0, 0.05), 30.0)
+        )
+        self.dumps = 0
+        self._on_dump = on_dump  # test hook
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def bump(self):
+        """Mark liveness — call once per step of the guarded loop."""
+        self._last = time.monotonic()
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._watch, name=f"watchdog:{self.name}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _watch(self):
+        while not self._stop.wait(self.poll_interval):
+            stalled = time.monotonic() - self._last
+            if stalled <= self.timeout_s:
+                continue
+            self._dump(stalled)
+            # re-arm: at most one dump per stalled window, so a wedged step
+            # does not flood the log at poll frequency
+            self._last = time.monotonic()
+            if _watchdog_abort_enabled():
+                logger.error(
+                    "watchdog[%s]: aborting (exit %d) so the scheduler "
+                    "restarts the world", self.name, EXIT_WATCHDOG,
+                )
+                os._exit(EXIT_WATCHDOG)
+
+    def _dump(self, stalled: float):
+        lines = [
+            f"watchdog[{self.name}]: no heartbeat for {stalled:.1f}s "
+            f"(threshold {self.timeout_s:.1f}s) — thread stacks follow"
+        ]
+        thread_names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            lines.append(
+                f"--- thread {thread_names.get(tid, '?')} (id {tid}) ---"
+            )
+            lines.extend(
+                l.rstrip() for l in traceback.format_stack(frame)
+            )
+        spans = tracing.live_spans()
+        if spans:
+            lines.append("--- open tracing spans ---")
+            for s in spans:
+                lines.append(
+                    f"{s['name']}: open {s['elapsed_s']:.1f}s "
+                    f"(thread {s['thread']})"
+                )
+        logger.error("\n".join(lines))
+        self.dumps += 1
+        metrics_mod.counters.add(metrics_mod.GUARD_WATCHDOG_DUMPS)
+        if self._on_dump is not None:
+            self._on_dump(stalled)

@@ -29,7 +29,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from areal_tpu.api.data import MicroBatchSpec, SequenceSample
-from areal_tpu.base import constants, tracing
+from areal_tpu.base import constants, faults, recover, tracing
 from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.models.config import ModelConfig
 from areal_tpu.models import transformer as tfm
@@ -74,6 +74,16 @@ def train_prefetch_enabled() -> bool:
     prefetch of minibatch n+1 under the in-flight step for minibatch n, and
     the deferred (per-logging-interval, not per-step) stats fetch."""
     return _env_knob(constants.TRAIN_PREFETCH_ENV, 1) > 0
+
+
+def train_guard_enabled() -> bool:
+    """On-device finite-ness guard inside the jitted train step (default
+    on): a non-finite loss or grad norm makes the step SELECT the old
+    params/opt state instead of applying the poisoned update, and report
+    ``guard/step_ok`` in the stats the trainer already fetches — no extra
+    host round trip (bench.py ``guard`` section proves ~0 overhead). Read
+    at jit-build time; toggling requires a fresh engine."""
+    return _env_knob(constants.TRAIN_GUARD_ENV, 1) > 0
 
 
 def host_stats_view(host: Dict[str, Any]) -> Dict[str, float]:
@@ -340,15 +350,26 @@ class TrainEngine:
         ``post_write()`` in the background — the weight-publish fast path
         (r5, VERDICT r4 #3). A failure inside the thread is stored on
         ``thread._areal_exc``; the joiner must check and re-raise so a
-        disk-full does not silently freeze the fleet's weight version."""
+        disk-full does not silently freeze the fleet's weight version.
+
+        The export is COMMITTED like the Orbax checkpoints: safetensors land
+        in a staging dir that is atomically renamed over ``path`` with a
+        manifest, so a gen server (or a restarted trainer re-announcing the
+        version) can never observe a half-written snapshot."""
         import threading
 
         from areal_tpu.models import hf as hf_conv
 
         host_params = multihost.gather_params_to_host(self.params)
+        abs_path = os.path.abspath(path)
+        step, version = self._step, self.version
 
         def _write():
-            hf_conv.save_hf_checkpoint(host_params, self.cfg, family, path)
+            staging = recover.prepare_staging(abs_path, "hf")
+            hf_conv.save_hf_checkpoint(host_params, self.cfg, family, staging)
+            recover.commit_checkpoint(staging, abs_path, {
+                "step": step, "version": version, "format": "hf",
+            })
             if post_write is not None:
                 post_write()
 
@@ -474,24 +495,43 @@ class TrainEngine:
             # param-sized copies and no extra dispatch latency (the reference
             # reaches the same shape via Megatron DDP grad buckets +
             # DistributedOptimizer, ``realhf/impl/model/backend/megatron.py``).
+            guard = train_guard_enabled()
+
             def train_step(params, opt_state, stacked, weights):
                 def loss_of(p, arrays, w):
                     loss, stats = fn(p, cfg, arrays)
                     return loss * w, (loss, stats)
 
                 grad_fn = jax.value_and_grad(loss_of, has_aux=True)
+
+                def eval_mb(arrays, w):
+                    (_, (loss, stats)), g = grad_fn(params, arrays, w)
+                    # A zero-weight micro-batch (multihost all-padding fill)
+                    # contributes nothing — and losses that divide by the
+                    # action-token count can be 0/0 = nan on an empty mask,
+                    # so the nan must be SELECTED out (``w * nan`` is still
+                    # nan), or the finite-ness guard below would veto real
+                    # updates over legitimately-empty micro-batches.
+                    live = w > 0
+                    g = jax.tree.map(
+                        lambda x: jnp.where(live, x, jnp.zeros_like(x)), g
+                    )
+                    loss = jnp.where(live, loss, 0.0)
+                    stats = jax.tree.map(
+                        lambda s: jnp.where(live, s, jnp.zeros_like(s)), stats
+                    )
+                    return g, loss, stats
+
                 n_mbs = weights.shape[0]
                 if n_mbs == 1:
                     arrays = jax.tree.map(lambda x: x[0], stacked)
-                    (_, (loss, stats)), grads = grad_fn(
-                        params, arrays, weights[0]
-                    )
+                    grads, loss, stats = eval_mb(arrays, weights[0])
                     losses = loss[None]
                     statss = jax.tree.map(lambda s: s[None], stats)
                 else:
                     def body(acc, xs):
                         arrays, w = xs
-                        (_, (loss, stats)), g = grad_fn(params, arrays, w)
+                        g, loss, stats = eval_mb(arrays, w)
                         return jax.tree.map(jnp.add, acc, g), (loss, stats)
 
                     zeros = jax.tree.map(
@@ -508,15 +548,31 @@ class TrainEngine:
                     lambda g, p: g.astype(p.dtype), grads, params
                 )
                 gnorm = optax.global_norm(grads)
-                updates, opt_state = self.tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
+                updates, new_opt_state = self.tx.update(
+                    grads, opt_state, params
+                )
+                new_params = optax.apply_updates(params, updates)
                 out = {"loss": jnp.sum(losses * weights), "grad_norm": gnorm}
+                if guard:
+                    # poisoned step (NaN loss, exploding/overflowed grads):
+                    # keep the pre-step params AND opt state (skipping the
+                    # Adam moment/count advance too), flag it in the stats
+                    # the caller already fetches — zero extra host syncs
+                    ok = jnp.isfinite(gnorm) & jnp.isfinite(jnp.sum(losses))
+                    new_params = jax.tree.map(
+                        lambda n, o: jnp.where(ok, n, o), new_params, params
+                    )
+                    new_opt_state = jax.tree.map(
+                        lambda n, o: jnp.where(ok, n, o),
+                        new_opt_state, opt_state,
+                    )
+                    out["guard/step_ok"] = ok.astype(jnp.float32)
                 # micro-batch scalar stats -> weighted means (weights are
                 # already normalized to sum 1 by the caller)
                 for k, v in statss.items():
                     if v.ndim == 1:
                         out[k] = jnp.sum(v * weights)
-                return params, opt_state, out
+                return new_params, new_opt_state, out
 
             # Donated-state outputs pinned to the CANONICAL shardings
             # (params at their logical-axis shardings, opt state where
@@ -755,6 +811,15 @@ class TrainEngine:
         futures; params/opt-state handles are valid for the next dispatch
         immediately)."""
         assert self.tx is not None, "call setup_optimizer() first"
+        if faults.maybe_trip("train.step", step=self._step):
+            # poison this optimizer step on-device (non-finite loss weights
+            # -> non-finite loss/grads): the guard plane must catch it and
+            # select the update away without any host-side special-casing
+            prep = PreparedTrainBatch(
+                stacked=prep.stacked,
+                weights=prep.weights * np.inf,
+                n_mbs=prep.n_mbs,
+            )
         step = self._get_jitted("train_step", loss_fn)
         with tracing.span("train_pipe/dispatch"):
             self.params, self.opt_state, out = step(
@@ -955,33 +1020,100 @@ class TrainEngine:
     # Checkpointing (orbax)
     # ------------------------------------------------------------------ #
 
+    def _ckpt_state(self, with_optim: bool):
+        state = {
+            "params": self.params, "step": self._step, "version": self.version
+        }
+        if with_optim and self.opt_state is not None:
+            state["opt_state"] = self.opt_state
+        return state
+
     def save_checkpoint(self, path: str, with_optim: bool = True):
+        """Atomic committed save: Orbax writes into a staging dir, then a
+        ``COMMIT.json`` manifest (step, version, per-tree structural
+        checksums) is fsynced and the staging dir renamed over ``path`` —
+        a preemption at ANY instant leaves the previous committed
+        checkpoint restorable (the old ``rmtree``-then-save destroyed it
+        for the whole duration of the save)."""
         import os
-        import shutil
 
         import orbax.checkpoint as ocp
 
         path = os.path.abspath(path)
+        # the staging tag must agree across hosts (all processes write
+        # shards into one dir): derive it from the step, not a nonce
+        tag = f"s{self._step}"
         # main-only clean + barrier: concurrent rmtrees on a shared FS race
         # each other and the distributed orbax save
-        if multihost.is_main() and os.path.exists(path):
-            shutil.rmtree(path)
-        multihost.barrier("ckpt_clean")
-        state = {"params": self.params, "step": self._step, "version": self.version}
-        if with_optim and self.opt_state is not None:
-            state["opt_state"] = self.opt_state
+        if multihost.is_main():
+            recover.prepare_staging(path, tag)
+        multihost.barrier("ckpt_stage")
+        staging = recover.staging_path(path, tag)
+        state = self._ckpt_state(with_optim)
         with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(path, state)
+            ckptr.save(staging, state)
+        multihost.barrier("ckpt_saved")
+        if multihost.is_main():
+            faults.maybe_fail("ckpt.save", path=path)  # die "mid-save"
+            recover.commit_checkpoint(staging, path, {
+                "step": self._step,
+                "version": self.version,
+                "with_optim": "opt_state" in state,
+                "checksums": {
+                    k: recover.tree_checksum(v) for k, v in state.items()
+                },
+            })
+        multihost.barrier("ckpt_commit")
+
+    def validate_checkpoint(self, path: str, with_optim: bool = True) -> dict:
+        """Validate WITHOUT restoring: resolve the newest committed dir at
+        ``path`` (promoting a committed-but-unswapped staging sibling) and
+        check the manifest's structural checksums against this engine's
+        state tree. Returns the manifest. Callers restoring SEVERAL engines
+        must validate all of them first — a raise after the first restore
+        would leave the engines on mixed ticks. Raises ``FileNotFoundError``
+        (nothing committed) or ``ValueError`` (incompatible/corrupt)."""
+        import os
+
+        path = os.path.abspath(path)
+        if multihost.is_main():
+            # promotes a committed-but-unswapped sibling and counts the
+            # fallback (guard/ckpt_fallbacks) inside resolve_committed
+            recover.resolve_committed(path)
+        multihost.barrier("ckpt_resolve")
+        manifest = recover.read_manifest(path)
+        if manifest is None:
+            raise FileNotFoundError(
+                f"no committed checkpoint at {path} (missing or crashed "
+                "before its COMMIT manifest landed)"
+            )
+        state = self._ckpt_state(with_optim)
+        saved_sums = manifest.get("checksums", {})
+        for k, v in state.items():
+            want = saved_sums.get(k)
+            if want is not None and want != recover.tree_checksum(v):
+                raise ValueError(
+                    f"checkpoint {path} is incompatible with this engine: "
+                    f"param-tree checksum mismatch on {k!r} (model/optimizer "
+                    "config drift or a corrupt save)"
+                )
+        return manifest
 
     def load_checkpoint(self, path: str, with_optim: bool = True):
+        """Restore from the newest COMMITTED checkpoint at ``path``:
+        uncommitted staging leftovers are skipped (and cleaned), a
+        committed-but-unswapped staging dir from a crash mid-commit is
+        promoted, and the manifest's structural checksums are validated
+        against this engine's state tree before Orbax touches anything
+        (:meth:`validate_checkpoint`)."""
         import os
 
         import orbax.checkpoint as ocp
 
         path = os.path.abspath(path)
-        state = {"params": self.params, "step": 0, "version": 0}
-        if with_optim and self.opt_state is not None:
-            state["opt_state"] = self.opt_state
+        self.validate_checkpoint(path, with_optim)
+        state = self._ckpt_state(with_optim)
+        state["step"], state["version"] = 0, 0
         with ocp.StandardCheckpointer() as ckptr:
             restored = ckptr.restore(path, state)
         self.params = restored["params"]

@@ -494,6 +494,82 @@ def _bench_fwd_pipe(peak):
     return out
 
 
+def _bench_guard(peak):
+    """A/B the on-device finite-ness guard (AREAL_TRAIN_GUARD, trainer
+    survivability): the isfinite(loss) & isfinite(grad_norm) check + the
+    select of old-vs-new params/opt state fold into the jitted step and the
+    flag rides the stats the pipelined path already fetches — so the
+    per-step overhead should be ~0 (no extra host round trip). Recorded
+    like the fwd_pipe section: ``vs_baseline`` = guard_off / guard_on wall
+    time (≈1.0 expected; if real hardware shows a regression, flip the env
+    default in base/constants.py)."""
+    import contextlib
+
+    import jax
+
+    from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+    from areal_tpu.base import constants as const
+    from areal_tpu.interfaces.sft import sft_loss_fn
+    from areal_tpu.models.config import ModelConfig
+    from areal_tpu.parallel.mesh import ParallelConfig
+    from areal_tpu.train.engine import OptimizerConfig, TrainEngine
+
+    @contextlib.contextmanager
+    def _env(name, val):
+        prev = os.environ.get(name)
+        os.environ[name] = val
+        try:
+            yield
+        finally:
+            if prev is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = prev
+
+    cfg = ModelConfig(
+        n_layers=6, n_q_heads=8, n_kv_heads=4, head_dim=64, hidden_dim=512,
+        intermediate_dim=1408, vocab_size=32768, use_attention_bias=True,
+        dtype="bfloat16", remat_policy="none", layer_scan_unroll=6,
+    )
+    rng = np.random.default_rng(0)
+    sample = _mk_sample(cfg, [512] * 8, rng)
+    spec = MicroBatchSpec(n_mbs=2, max_tokens_per_mb=2048)
+    n_steps = 8
+
+    def time_guard(knob):
+        # the knob is read at jit-build time, so each arm gets a fresh
+        # engine (identical seed/shapes: only the guard epilogue differs)
+        with _env(const.TRAIN_GUARD_ENV, knob):
+            eng = TrainEngine(
+                cfg, ParallelConfig(), OptimizerConfig(lr=1e-5),
+                param_dtype="bfloat16",
+            )
+            eng.init_random(0)
+            eng.setup_optimizer(100)
+            eng.train_batch(sample, spec, sft_loss_fn, fetch_stats=False)
+            jax.block_until_ready(eng.params)           # warm/compile
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                eng.train_batch(sample, spec, sft_loss_fn, fetch_stats=False)
+            jax.block_until_ready(eng.params)
+            dt = (time.perf_counter() - t0) / n_steps
+            eng.params = eng.opt_state = None
+            return dt
+
+    off = time_guard("0")
+    on = time_guard("1")
+    import gc
+
+    gc.collect()
+    return {
+        "guard_off_s": round(off, 5),
+        "guard_on_s": round(on, 5),
+        "overhead_pct": round((on - off) / max(off, 1e-9) * 100, 2),
+        "vs_baseline": round(off / max(on, 1e-9), 4),
+        "n_steps": n_steps,
+    }
+
+
 def _bench_async_ppo(peak):
     """One complete async-PPO round on a single chip: generate a GRPO group
     per prompt on the paged engine, score, run the decoupled-PPO update,
@@ -884,6 +960,7 @@ def main():
         ("gen_pipe", lambda: _bench_gen(peak_bw, peak, pipelined=True), True),
         ("bwd_pipe",
          lambda: _bench_bwd_pipe(cfg_small, cfg_32k, peak), True),
+        ("guard", lambda: _bench_guard(peak), True),
     ):
         if not want(name):
             continue

@@ -1,9 +1,12 @@
 """Tier-1 static async-hygiene pass (tools/check_async_hygiene.py).
 
-Keeps ``areal_tpu/system/`` free of the exact bug class the fault-tolerance
-subsystem fixed: bare ``asyncio.gather(`` without ``return_exceptions``
-(one dead peer aborts the whole fan-out) and discarded ``create_task``
-results (unreferenced tasks can be GC'd; their exceptions vanish).
+Keeps ``areal_tpu/system/`` and ``areal_tpu/train/`` free of the bug
+classes the fault-tolerance subsystems fixed: bare ``asyncio.gather(``
+without ``return_exceptions`` (one dead peer aborts the whole fan-out),
+discarded ``create_task`` results (unreferenced tasks can be GC'd; their
+exceptions vanish), ``shutil.rmtree`` on checkpoint-capable paths outside
+the commit helper (a crash mid-save destroys the only restore point), and
+``time.sleep`` inside ``async def`` (blocks the event loop).
 """
 
 import importlib.util
@@ -25,7 +28,10 @@ def _checker():
 
 def test_system_layer_is_clean():
     mod = _checker()
-    findings = mod.scan_paths([os.path.join(REPO, "areal_tpu", "system")])
+    findings = mod.scan_paths([
+        os.path.join(REPO, "areal_tpu", "system"),
+        os.path.join(REPO, "areal_tpu", "train"),
+    ])
     assert findings == [], "\n".join(str(f) for f in findings)
 
 
@@ -63,3 +69,58 @@ def test_checker_suppression_and_non_asyncio_gather():
         """
     )
     assert mod.scan_source(src) == []
+
+
+def test_checker_flags_live_checkpoint_rmtree():
+    mod = _checker()
+    src = textwrap.dedent(
+        """
+        import shutil
+        from shutil import rmtree
+
+        def clean(path):
+            shutil.rmtree(path)
+            rmtree(path)
+            shutil.rmtree(path)  # async-hygiene: ok
+        """
+    )
+    rules = [f.rule for f in mod.scan_source(src, "areal_tpu/train/x.py")]
+    assert rules == ["live-checkpoint-rmtree", "live-checkpoint-rmtree"]
+    # the commit helper itself is the one sanctioned deletion site
+    assert mod.scan_source(src, "areal_tpu/base/recover.py") == []
+
+
+def test_checker_flags_time_sleep_in_async():
+    mod = _checker()
+    src = textwrap.dedent(
+        """
+        import asyncio
+        import time
+
+        async def bad():
+            time.sleep(1.0)
+            if True:
+                time.sleep(2.0)
+
+        async def bad_from_import():
+            from time import sleep
+            sleep(3.0)
+
+        async def fine():
+            await asyncio.sleep(1.0)
+            time.sleep(0.1)  # async-hygiene: ok
+
+            def sync_helper():
+                time.sleep(0.5)  # runs where called (executor thread): ok
+
+        async def fine_awaited_bare():
+            from asyncio import sleep
+            await sleep(1.0)  # asyncio's sleep via from-import: awaited
+
+        def also_fine():
+            time.sleep(1.0)
+        """
+    )
+    findings = [f for f in mod.scan_source(src) if f.rule == "sleep-in-async"]
+    assert len(findings) == 3
+    assert all("blocks the event loop" in f.message for f in findings)

@@ -466,6 +466,305 @@ def test_trainer_recover_roundtrip(tmp_path, monkeypatch):
 
 
 # --------------------------------------------------------------------- #
+# (e) trainer survivability: atomic checkpoint commit protocol
+# --------------------------------------------------------------------- #
+
+
+def test_commit_protocol_resolves_newest_committed(tmp_path):
+    """Every crash window of commit_checkpoint is recoverable: an
+    uncommitted staging dir is discarded; a committed staging dir (crash
+    between manifest fsync and rename) is promoted over an older
+    committed canonical dir."""
+    from areal_tpu.base import recover
+
+    path = str(tmp_path / "ckpt")
+    # canonical: committed at step 3
+    os.makedirs(path)
+    recover.write_manifest(path, {"step": 3, "version": 3})
+    # crashed newer save: committed staging (manifest landed, rename didn't)
+    newer = recover.staging_path(path, "s5")
+    os.makedirs(newer)
+    recover.write_manifest(newer, {"step": 5, "version": 5})
+    # and an uncommitted staging leftover (no manifest)
+    os.makedirs(recover.staging_path(path, "s6"))
+
+    assert recover.resolve_committed(path) == path
+    m = recover.read_manifest(path)
+    assert (m["step"], m["version"]) == (5, 5)  # the newer one won
+    # strays cleaned
+    assert not os.path.exists(newer)
+    assert not os.path.exists(recover.staging_path(path, "s6"))
+
+    # nothing committed at all -> None
+    bare = str(tmp_path / "bare")
+    os.makedirs(recover.staging_path(bare, "s1"))
+    assert recover.resolve_committed(bare) is None
+
+
+def test_ckpt_crash_mid_save_preserves_previous_committed(
+    tmp_path, monkeypatch
+):
+    """Acceptance: a crash injected via the ``ckpt.save`` fault point
+    mid-save leaves the previous committed checkpoint loadable, and the
+    restarted trainer resumes from it."""
+    monkeypatch.setenv("AREAL_FILEROOT", str(tmp_path))
+    import jax
+
+    from areal_tpu.base import constants, recover
+
+    constants.set_experiment_trial_names(EXP, TRIAL)
+    name_resolve.reset()
+    w1, eng1, _ = _tiny_trainer()
+    w1.step = 5
+    w1.samples_consumed = 20
+    eng1.version = 5
+    w1.save_recover_checkpoint()  # commit #1
+    committed = np.asarray(jax.tree.leaves(eng1.params)[0]).copy()
+    actor_dir = os.path.join(
+        constants.get_recover_root(), "trainer", "actor"
+    )
+    assert recover.is_committed(actor_dir)
+
+    # the run advances, then dies mid-save of the NEXT checkpoint
+    eng1.init_random(3)
+    eng1._step += 7
+    w1.step = 12
+    eng1.version = 12
+    faults.inject("ckpt.save", times=1)
+    with pytest.raises(faults.FaultInjected):
+        w1.save_recover_checkpoint()
+    faults.reset()
+    # the staged-but-uncommitted dir must not shadow the committed one
+    assert recover.is_committed(actor_dir)
+    assert recover.read_manifest(actor_dir)["version"] == 5
+
+    # restart-the-world: scrambled engine, fresh worker
+    eng1.init_random(9)
+    eng1.version = 0
+    w2, eng2, _ = _tiny_trainer(eng=eng1)
+    assert w2.load_recover_checkpoint()
+    assert w2.step == 5 and eng2.version == 5
+    np.testing.assert_array_equal(
+        committed, np.asarray(jax.tree.leaves(eng2.params)[0])
+    )
+    # and the fleet converges on the COMMITTED version
+    raw = name_resolve.get(names.model_version(EXP, TRIAL, "actor"))
+    assert int(raw.partition(":")[0]) == 5
+
+
+def test_uncommitted_recover_checkpoint_falls_back_to_fresh_start(
+    tmp_path, monkeypatch
+):
+    """A recover dir that only ever got an UNCOMMITTED save (crash on the
+    very first checkpoint) is skipped: load_recover_checkpoint returns
+    False instead of restoring garbage."""
+    monkeypatch.setenv("AREAL_FILEROOT", str(tmp_path))
+    from areal_tpu.base import constants
+
+    constants.set_experiment_trial_names(EXP, TRIAL)
+    name_resolve.reset()
+    w1, eng1, _ = _tiny_trainer()
+    w1.step = 2
+    faults.inject("ckpt.save", times=1)
+    with pytest.raises(faults.FaultInjected):
+        w1.save_recover_checkpoint()
+    faults.reset()
+    # RecoverInfo may exist from other tests' layout — write one explicitly
+    # to prove the engine checkpoint validation is what gates the recover
+    from areal_tpu.base import recover as recover_mod
+
+    recover_mod.dump(recover_mod.RecoverInfo(samples_consumed=8))
+    import jax
+
+    before = np.asarray(jax.tree.leaves(eng1.params)[0]).copy()
+    w2, _, _ = _tiny_trainer(eng=eng1)
+    assert not w2.load_recover_checkpoint()
+    # validation runs BEFORE any restore: a failed recover must leave the
+    # engine exactly as it was (no partially-restored mixed state)
+    np.testing.assert_array_equal(
+        before, np.asarray(jax.tree.leaves(eng1.params)[0])
+    )
+    assert w2.step == 0 and w2.samples_consumed == 0
+
+
+# --------------------------------------------------------------------- #
+# (f) guardrail plane: K consecutive anomalies -> rollback to committed
+# --------------------------------------------------------------------- #
+
+
+def test_consecutive_anomalies_roll_back_to_committed(tmp_path, monkeypatch):
+    monkeypatch.setenv("AREAL_FILEROOT", str(tmp_path))
+    import jax
+    import time as time_mod
+
+    from areal_tpu.base import constants
+
+    constants.set_experiment_trial_names(EXP, TRIAL)
+    name_resolve.reset()
+    w, eng, _ = _tiny_trainer()
+    w.step = 4
+    eng.version = 4
+    w.save_recover_checkpoint()  # the rollback target
+    committed = np.asarray(jax.tree.leaves(eng.params)[0]).copy()
+
+    # params drift (simulating steps whose updates slipped through or an
+    # optimizer-state corruption the skip-guard cannot undo)
+    eng.init_random(7)
+    eng.version = 6
+
+    k = w.control.guard_rollback_steps
+    assert k >= 2
+    before_rb = metrics_mod.counters.get(metrics_mod.GUARD_ROLLBACKS)
+    # k-1 anomalies: counted, but NO rollback yet
+    w._pending_stats = [
+        (i, time_mod.time(), {"guard/step_ok": 0.0}) for i in range(k - 1)
+    ]
+    w.flush_stats()
+    assert w._consec_anomalies == k - 1
+    assert metrics_mod.counters.get(metrics_mod.GUARD_ROLLBACKS) == before_rb
+    # a clean step in between resets the streak
+    w._pending_stats = [(k, time_mod.time(), {"guard/step_ok": 1.0})]
+    w.flush_stats()
+    assert w._consec_anomalies == 0
+    # k consecutive anomalies: rollback fires
+    w._pending_stats = [
+        (k + 1 + i, time_mod.time(), {"guard/step_ok": 0.0})
+        for i in range(k)
+    ]
+    w.flush_stats()
+    w._join_publish()
+    assert (
+        metrics_mod.counters.get(metrics_mod.GUARD_ROLLBACKS) == before_rb + 1
+    )
+    assert w._consec_anomalies == 0
+    np.testing.assert_array_equal(
+        committed, np.asarray(jax.tree.leaves(eng.params)[0])
+    )
+    # the restored weights republish under a NEW (monotonic) version: the
+    # manager ignores version <= its current one, so re-announcing the
+    # restored number (4) while the fleet sits at 6 would be silently
+    # dropped and the fleet would keep serving the suspect weights
+    assert eng.version == 7
+    raw = name_resolve.get(names.model_version(EXP, TRIAL, "actor"))
+    assert int(raw.partition(":")[0]) == 7
+    assert metrics_mod.counters.get(metrics_mod.GUARD_ANOMALOUS_STEPS) >= k
+    # trajectories buffered against the suspect policy were dropped
+    # (_EmptyStream.clear pretends 3 were in flight)
+    assert (
+        metrics_mod.counters.get(metrics_mod.FT_STALE_DROPPED_ON_RECOVER) >= 3
+    )
+
+
+# --------------------------------------------------------------------- #
+# (g) preemption plane: signal.term -> committed ckpt + distinct exit code
+# --------------------------------------------------------------------- #
+
+
+def test_preemption_commits_checkpoint_and_sets_distinct_code(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("AREAL_FILEROOT", str(tmp_path))
+    from areal_tpu.base import constants, recover
+    from areal_tpu.system import worker_base
+
+    constants.set_experiment_trial_names(EXP, TRIAL)
+    name_resolve.reset()
+    w, eng, _ = _tiny_trainer()
+    w.step = 3
+    eng.version = 3
+    before = metrics_mod.counters.get(metrics_mod.FT_PREEMPTIONS)
+
+    shutdown = worker_base.GracefulShutdown(deadline_s=30.0, install=False)
+    faults.inject("signal.term", action="trip", times=1)
+    w.run(shutdown=shutdown)
+
+    assert w.preempted
+    assert metrics_mod.counters.get(metrics_mod.FT_PREEMPTIONS) == before + 1
+    # the recover checkpoint is COMMITTED (manifest present, right tick)
+    actor_dir = os.path.join(
+        constants.get_recover_root(), "trainer", "actor"
+    )
+    m = recover.read_manifest(actor_dir)
+    assert m is not None and m["version"] == 3
+    # model_version republished before exit
+    raw = name_resolve.get(names.model_version(EXP, TRIAL, "actor"))
+    assert int(raw.partition(":")[0]) == 3
+    # the exit code the launcher maps to restart-the-world is distinct
+    assert worker_base.EXIT_PREEMPTED not in (0, 1)
+    assert worker_base.EXIT_PREEMPTED != worker_base.EXIT_WATCHDOG
+
+
+def test_graceful_shutdown_handles_real_sigterm():
+    import signal
+
+    from areal_tpu.system import worker_base
+
+    shutdown = worker_base.GracefulShutdown(deadline_s=5.0)
+    try:
+        assert not shutdown.should_stop()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert shutdown.should_stop()
+        assert shutdown.remaining() <= 5.0
+    finally:
+        shutdown.uninstall()
+
+
+# --------------------------------------------------------------------- #
+# (h) satellites: stale RecoverInfo version, publish-failure surfacing
+# --------------------------------------------------------------------- #
+
+
+def test_stale_recover_info_version_cannot_win(tmp_path, monkeypatch):
+    """The ENGINE checkpoint's version is authoritative: a tampered/stale
+    RecoverInfo.model_version must not be what gets republished."""
+    monkeypatch.setenv("AREAL_FILEROOT", str(tmp_path))
+    from areal_tpu.base import constants
+    from areal_tpu.base import recover as recover_mod
+
+    constants.set_experiment_trial_names(EXP, TRIAL)
+    name_resolve.reset()
+    w1, eng1, _ = _tiny_trainer()
+    w1.step = 6
+    eng1.version = 6
+    w1.save_recover_checkpoint()
+    # tamper: RecoverInfo claims an older model_version (e.g. an info file
+    # surviving from an earlier tick than the engine checkpoint)
+    info = recover_mod.load()
+    info.model_version = 2
+    recover_mod.dump(info)
+
+    eng1.version = 0
+    w2, eng2, _ = _tiny_trainer(eng=eng1)
+    assert w2.load_recover_checkpoint()
+    assert eng2.version == 6  # engine checkpoint won
+    raw = name_resolve.get(names.model_version(EXP, TRIAL, "actor"))
+    assert int(raw.partition(":")[0]) == 6  # ...everywhere it republishes
+
+
+def test_publish_failure_surfaces_on_join_and_counts(tmp_path, monkeypatch):
+    monkeypatch.setenv("AREAL_FILEROOT", str(tmp_path))
+    from areal_tpu.base import constants
+    from areal_tpu.models import hf as hf_conv
+
+    constants.set_experiment_trial_names(EXP, TRIAL)
+    name_resolve.reset()
+    w, _, _ = _tiny_trainer()
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(hf_conv, "save_hf_checkpoint", boom)
+    before = metrics_mod.counters.get(metrics_mod.FT_PUBLISH_FAILURES)
+    w.publish_weights()
+    with pytest.raises(RuntimeError, match="publish failed"):
+        w._join_publish()
+    assert (
+        metrics_mod.counters.get(metrics_mod.FT_PUBLISH_FAILURES)
+        == before + 1
+    )
+
+
+# --------------------------------------------------------------------- #
 # retry plane units: client backoff + fault harness semantics
 # --------------------------------------------------------------------- #
 

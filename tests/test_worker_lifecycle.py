@@ -54,6 +54,67 @@ class TestStatusWatch:
         assert t is not None and abs(time.time() - t) < 5
 
 
+class TestHangWatchdog:
+    def test_dumps_stacks_and_live_spans_for_hung_step(self, caplog):
+        import logging as logging_mod
+        import threading
+
+        from areal_tpu.base import metrics as metrics_mod
+        from areal_tpu.base import tracing
+        from areal_tpu.system.worker_base import HangWatchdog
+
+        release = threading.Event()
+        started = threading.Event()
+
+        def hung_step():
+            # an artificially hung "step" holding a data-plane span open —
+            # the dump must attribute the hang to it
+            with tracing.span("train_pipe/dispatch_hung"):
+                started.set()
+                release.wait(10)
+
+        t = threading.Thread(target=hung_step, name="hung-step", daemon=True)
+        t.start()
+        assert started.wait(5)
+        before = metrics_mod.counters.get("guard/watchdog_dumps")
+        dumps = []
+        wd = HangWatchdog(
+            "test", timeout_s=0.15, poll_interval=0.05,
+            on_dump=lambda stalled: dumps.append(stalled),
+        )
+        with caplog.at_level(
+            logging_mod.ERROR, logger="areal_tpu.worker_base"
+        ):
+            wd.start()
+            deadline = time.time() + 5
+            while not dumps and time.time() < deadline:
+                time.sleep(0.02)
+            wd.stop()
+        release.set()
+        t.join(timeout=5)
+        assert wd.dumps >= 1
+        assert (
+            metrics_mod.counters.get("guard/watchdog_dumps")
+            >= before + wd.dumps
+        )
+        log = caplog.text
+        assert "no heartbeat" in log and "thread stacks" in log
+        assert "hung-step" in log                  # the wedged thread
+        assert "train_pipe/dispatch_hung" in log   # the open span
+
+    def test_bump_keeps_watchdog_quiet(self):
+        from areal_tpu.system.worker_base import HangWatchdog
+
+        wd = HangWatchdog("quiet", timeout_s=0.2, poll_interval=0.02)
+        wd.start()
+        t0 = time.time()
+        while time.time() - t0 < 0.5:
+            wd.bump()
+            time.sleep(0.02)
+        wd.stop()
+        assert wd.dumps == 0
+
+
 _CHILD = r"""
 import os, sys, time
 sys.path.insert(0, {repo!r})
