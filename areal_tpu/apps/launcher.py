@@ -44,7 +44,7 @@ def _setup_worker_env(cfg, device: str = ""):
     # (multi-node, no shared FS — AREAL_NAME_RESOLVE_RPC=host:port), else
     # the shared-filesystem backend (the in-memory default only works
     # within one process)
-    rpc_addr = os.environ.get("AREAL_NAME_RESOLVE_RPC")
+    rpc_addr = constants.name_resolve_rpc()
     if rpc_addr:
         name_resolve.reconfigure(
             name_resolve.NameResolveConfig(type="rpc", root=rpc_addr)
@@ -52,7 +52,7 @@ def _setup_worker_env(cfg, device: str = ""):
     else:
         name_resolve.reconfigure(
             name_resolve.NameResolveConfig(
-                type="file", root=os.environ["AREAL_NAME_RESOLVE_ROOT"]
+                type="file", root=constants.name_resolve_root()
             )
         )
 
@@ -438,7 +438,7 @@ def _cpu_child_env(force_cpu: bool):
         k: os.environ.pop(k, None)
         for k in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE")
     }
-    old_plat = os.environ.get("JAX_PLATFORMS")
+    old_plat = os.environ.get("JAX_PLATFORMS")  # arealint: ok(save/restore around child spawn, not a knob read)
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
         yield

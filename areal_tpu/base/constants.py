@@ -7,8 +7,11 @@ on TPU the parallel context is the ambient ``jax.sharding.Mesh`` managed by
 """
 
 import getpass
+import logging as _logging  # stdlib only — base/logging.py imports US
 import os
 from typing import Optional
+
+_logger = _logging.getLogger("areal_tpu.constants")
 
 _experiment_name: Optional[str] = None
 _trial_name: Optional[str] = None
@@ -17,6 +20,7 @@ _trial_name: Optional[str] = None
 TRACE_ENV = "AREAL_DUMP_TRACE"          # jax.profiler traces per MFC
 RECORD_PERF_ENV = "AREAL_RECORD_PERFORMANCE"
 MEMORY_KILL_ENV = "AREAL_HBM_KILL_THRESHOLD"
+MEMORY_WARN_ENV = "AREAL_HBM_WARN_THRESHOLD"
 WEIGHT_SYNC_IMPL_ENV = "AREAL_WEIGHT_SYNC_IMPL"  # DISK (default) | DCN
 # Host↔device data-plane pipelining (docs/pipelined_data_plane.md). Both
 # default ON; "0"/"false"/"off" disables, an integer sets the depth.
@@ -27,6 +31,209 @@ TRAIN_GUARD_ENV = "AREAL_TRAIN_GUARD"         # on-device finite-ness guard (def
 PREEMPT_DEADLINE_ENV = "AREAL_PREEMPT_DEADLINE_S"  # SIGTERM -> ckpt-save budget
 WATCHDOG_TIMEOUT_ENV = "AREAL_WATCHDOG_TIMEOUT_S"  # 0/unset disables the watchdog
 WATCHDOG_ABORT_ENV = "AREAL_WATCHDOG_ABORT"   # dump AND exit so the scheduler restarts
+
+
+# --------------------------------------------------------------------- #
+# Knob catalog.
+#
+# Every AREAL_* env knob is READ here (or through a tolerant
+# ``worker_base._env_*`` parser) — enforced statically by the ``env-knob``
+# rule of ``tools/arealint`` — so each knob has exactly one documented
+# default and the ``get_env_vars`` forwarding list below can't silently
+# drift from reality. Modules expose semantics (what a knob means); this
+# module owns parsing (how it is read).
+# --------------------------------------------------------------------- #
+
+_OFF_STRINGS = ("", "0", "false", "off", "no", "n")
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    return os.environ.get(name, default)
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Boolean knob: unset -> ``default``; ""/"0"/"false"/"off" -> False;
+    anything else -> True."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in _OFF_STRINGS
+
+
+def env_float(name: str, default: float) -> float:
+    """Tolerant float knob: malformed values fall back to the default
+    (logged) instead of crashing a worker at startup."""
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        _logger.warning(
+            "ignoring malformed %s=%r (using %s)", name, raw, default
+        )
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        _logger.warning(
+            "ignoring malformed %s=%r (using %s)", name, raw, default
+        )
+        return default
+
+
+def env_knob(name: str, default_depth: int) -> int:
+    """Pipeline-depth knob: unset/"true"/"on" -> the default depth,
+    "false"/"off" -> 0 (disabled), an integer -> exactly that depth (so
+    "1" really means depth 1, the serial discipline — not "enabled")."""
+    v = os.environ.get(name)
+    if v is None or v.strip().lower() in ("", "true", "on"):
+        return default_depth
+    if v.strip().lower() in ("false", "off"):
+        return 0
+    try:
+        return max(int(v), 0)
+    except ValueError:
+        return default_depth
+
+
+def log_level() -> str:
+    """``AREAL_LOG_LEVEL``: root log level for every areal logger."""
+    return (env_str("AREAL_LOG_LEVEL", "INFO") or "INFO").upper()
+
+
+def hbm_warn_threshold() -> float:
+    """``AREAL_HBM_WARN_THRESHOLD`` (default 0.92): fraction of
+    bytes_limit past which the HBM monitor logs a warning."""
+    return env_float(MEMORY_WARN_ENV, 0.92)
+
+
+def hbm_kill_threshold() -> float:
+    """``AREAL_HBM_KILL_THRESHOLD`` (default 1.0 = disabled): fraction of
+    bytes_limit past which the worker raises HBMPressureError."""
+    return env_float(MEMORY_KILL_ENV, 1.0)
+
+
+def hbm_fallback_interval() -> float:
+    """``AREAL_HBM_FALLBACK_INTERVAL`` (default 1.0s): min seconds between
+    jax.live_arrays() walks on platforms without memory_stats()."""
+    return env_float("AREAL_HBM_FALLBACK_INTERVAL", 1.0)
+
+
+def hbm_check_secs() -> float:
+    """``AREAL_HBM_CHECK_SECS`` (default 30.0): wall-clock period of the
+    gen server's HBM kill check (memory_stats can be a full RPC)."""
+    return env_float("AREAL_HBM_CHECK_SECS", 30.0)
+
+
+def name_resolve_root() -> str:
+    """``AREAL_NAME_RESOLVE_ROOT``: shared-FS root of the file-backed
+    name-resolve repository."""
+    return env_str(
+        "AREAL_NAME_RESOLVE_ROOT", "/tmp/areal_tpu/name_resolve"
+    )
+
+
+def name_resolve_rpc() -> Optional[str]:
+    """``AREAL_NAME_RESOLVE_RPC``: ``host:port`` of the TCP name-resolve
+    server (multi-node without a shared FS); None -> file backend."""
+    return env_str("AREAL_NAME_RESOLVE_RPC")
+
+
+def trace_enabled() -> bool:
+    """``AREAL_DUMP_TRACE``: collect jax.profiler traces per step/MFC."""
+    return env_flag(TRACE_ENV, False)
+
+
+def trace_step() -> int:
+    """``AREAL_TRACE_STEP`` (default 3): which training step the trainers
+    dump (tracing every step would grow unboundedly)."""
+    return env_int("AREAL_TRACE_STEP", 3)
+
+
+def debug_checks_enabled() -> bool:
+    """``AREAL_DEBUG_CHECKS``: extra device-side shape/degenerate-input
+    checks in the pallas kernels (read at TRACE time)."""
+    return env_flag("AREAL_DEBUG_CHECKS", False)
+
+
+def flash_bwd_pipeline_enabled() -> bool:
+    """``AREAL_FLASH_BWD_PIPELINE`` (default off): cross-block software
+    pipelining in the fused flash-attention backward."""
+    return env_flag("AREAL_FLASH_BWD_PIPELINE", False)
+
+
+def decode_pipeline_enabled() -> bool:
+    """``AREAL_DECODE_PIPELINE`` (default off): harvest decode chunks one
+    late so the per-chunk host sync overlaps the next chunk's compute."""
+    return env_flag("AREAL_DECODE_PIPELINE", False)
+
+
+def native_disabled() -> bool:
+    """``AREAL_DISABLE_NATIVE``: skip building/loading the C packer
+    extension (pure-python fallback)."""
+    return env_flag("AREAL_DISABLE_NATIVE", False)
+
+
+def watchdog_abort_enabled() -> bool:
+    """``AREAL_WATCHDOG_ABORT``: a stale heartbeat dumps stacks AND exits
+    (os._exit) so the scheduler restarts the world."""
+    return env_flag(WATCHDOG_ABORT_ENV, False)
+
+
+def function_call_enabled() -> bool:
+    """``AREAL_ENABLE_FUNCTION_CALL``: route math/code verification to the
+    remote sandboxed function-call service."""
+    return env_flag("AREAL_ENABLE_FUNCTION_CALL", False)
+
+
+def functioncall_service_domain() -> str:
+    """``AREAL_FUNCTIONCALL_SERVICE_DOMAIN``: base URL of the remote
+    verification service ("" = unset)."""
+    return env_str("AREAL_FUNCTIONCALL_SERVICE_DOMAIN", "") or ""
+
+
+def functioncall_concurrency_override() -> Optional[int]:
+    """``AREAL_FUNCTIONCALL_CONCURRENCY``: explicit per-process request
+    cap; None -> derive from the shared budget / DP split."""
+    raw = env_str("AREAL_FUNCTIONCALL_CONCURRENCY")
+    if raw is None or raw.strip() == "":
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def functioncall_dp() -> int:
+    """``AREAL_FUNCTIONCALL_DP`` (default 16): data-parallel caller count
+    the shared sandbox budget is split across."""
+    return env_int("AREAL_FUNCTIONCALL_DP", 16)
+
+
+def multihost_coordinator() -> Optional[str]:
+    """``AREAL_COORDINATOR``: jax.distributed coordinator ``host:port``,
+    or "auto" for Cloud-TPU topology autodetection; None -> single host."""
+    return env_str("AREAL_COORDINATOR")
+
+
+def multihost_num_processes() -> int:
+    """``AREAL_NUM_PROCESSES``: world size for explicit-coordinator
+    jax.distributed bring-up (required when AREAL_COORDINATOR is set to
+    an address)."""
+    return int(os.environ["AREAL_NUM_PROCESSES"])
+
+
+def multihost_process_id() -> int:
+    """``AREAL_PROCESS_ID``: this process's rank for explicit-coordinator
+    jax.distributed bring-up."""
+    return int(os.environ["AREAL_PROCESS_ID"])
 
 
 def set_experiment_trial_names(experiment_name: str, trial_name: str):
@@ -51,6 +258,14 @@ def get_fileroot() -> str:
     return os.environ.get(
         "AREAL_FILEROOT", f"/tmp/areal_tpu/{getpass.getuser()}"
     )
+
+
+def trace_root() -> str:
+    """``AREAL_FILEROOT`` for trace output, defaulting to the historical
+    shared ``/tmp/areal_tpu`` — NOT the per-user ``get_fileroot`` default,
+    so ``traces/<tag>`` stays where docs/performance.md and existing
+    tooling expect it."""
+    return env_str("AREAL_FILEROOT", "/tmp/areal_tpu")
 
 
 def set_fileroot(path: str):
@@ -95,6 +310,19 @@ def get_env_vars(**extra) -> dict:
         "AREAL_FILEROOT",
         "AREAL_LOG_LEVEL",
         "AREAL_NAME_RESOLVE_ROOT",
+        "AREAL_NAME_RESOLVE_RPC",
+        "AREAL_HBM_WARN_THRESHOLD",
+        "AREAL_HBM_FALLBACK_INTERVAL",
+        "AREAL_HBM_CHECK_SECS",
+        "AREAL_TRACE_STEP",
+        "AREAL_DEBUG_CHECKS",
+        "AREAL_FLASH_BWD_PIPELINE",
+        "AREAL_DECODE_PIPELINE",
+        "AREAL_DISABLE_NATIVE",
+        "AREAL_ENABLE_FUNCTION_CALL",
+        "AREAL_FUNCTIONCALL_SERVICE_DOMAIN",
+        "AREAL_FUNCTIONCALL_CONCURRENCY",
+        "AREAL_FUNCTIONCALL_DP",
         TRACE_ENV,
         RECORD_PERF_ENV,
         MEMORY_KILL_ENV,

@@ -57,6 +57,21 @@ from areal_tpu.base import logging
 logger = logging.getLogger("faults")
 
 
+# The injection-point registry — one entry per named point in the table
+# above (kept in sync with docs/fault_tolerance.md). Enforced statically
+# by the ``unregistered-fault-point`` rule of ``tools/arealint``: a
+# ``maybe_fail``/``maybe_trip``/``inject`` call naming an unlisted point
+# would silently never fire in a scripted scenario.
+FAULT_POINTS = (
+    "gen.http",
+    "gen.weight_update",
+    "rollout.push",
+    "ckpt.save",
+    "train.step",
+    "signal.term",
+)
+
+
 class FaultInjected(ConnectionError):
     """Raised by an armed injection point (subclass of ``ConnectionError``
     so retry/breaker machinery handles it like a real dead peer)."""

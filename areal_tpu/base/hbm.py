@@ -19,14 +19,11 @@ growth OOMs the pod with no warning otherwise.
 """
 
 import logging
-import os
 from typing import Dict, Optional
 
+from areal_tpu.base import constants
+
 logger = logging.getLogger("areal_tpu.hbm")
-
-_WARN_ENV = "AREAL_HBM_WARN_THRESHOLD"
-_KILL_ENV = "AREAL_HBM_KILL_THRESHOLD"
-
 
 class HBMPressureError(RuntimeError):
     """Device memory exceeded the kill threshold."""
@@ -83,11 +80,11 @@ class HBMMonitor:
     ):
         self._device = device
         self.warn_threshold = (
-            float(os.environ.get(_WARN_ENV, 0.92))
+            constants.hbm_warn_threshold()
             if warn_threshold is None else warn_threshold
         )
         self.kill_threshold = (
-            float(os.environ.get(_KILL_ENV, 1.0))
+            constants.hbm_kill_threshold()
             if kill_threshold is None else kill_threshold
         )
         self.tag = tag
@@ -98,9 +95,7 @@ class HBMMonitor:
         # "cheap gauge" to a real tax as a long-lived process accumulates
         # arrays. It is an observability lower bound, so ~1s staleness is
         # free; the memory_stats() path (real TPU) stays unthrottled.
-        self.fallback_interval_s = float(
-            os.environ.get("AREAL_HBM_FALLBACK_INTERVAL", 1.0)
-        )
+        self.fallback_interval_s = constants.hbm_fallback_interval()
         self._fallback_last_t = 0.0
         self._fallback_cached = 0.0
 
@@ -130,7 +125,7 @@ class HBMMonitor:
             raise HBMPressureError(
                 f"{self.tag or 'device'} HBM {stats['bytes_in_use']/2**30:.2f}"
                 f"/{limit/2**30:.2f} GiB = {util:.1%} exceeds kill threshold "
-                f"{self.kill_threshold:.2f} (tune ${_KILL_ENV})"
+                f"{self.kill_threshold:.2f} (tune ${constants.MEMORY_KILL_ENV})"
             )
         if limit and util > self.warn_threshold:
             if not self._warned:
@@ -138,7 +133,8 @@ class HBMMonitor:
                     "%s HBM pressure: %.2f/%.2f GiB (%.1f%%) past warn "
                     "threshold %.2f ($%s)",
                     self.tag or "device", stats["bytes_in_use"] / 2**30,
-                    limit / 2**30, util * 100, self.warn_threshold, _WARN_ENV,
+                    limit / 2**30, util * 100, self.warn_threshold,
+                    constants.MEMORY_WARN_ENV,
                 )
                 self._warned = True
         else:

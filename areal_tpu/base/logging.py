@@ -6,14 +6,15 @@ multi-sink metric logging); metric sinks live in
 """
 
 import logging
-import os
 import sys
 from typing import Optional
+
+from areal_tpu.base import constants
 
 _FORMAT = "%(asctime)s.%(msecs)03d %(name)s %(levelname)s: %(message)s"
 _DATE_FORMAT = "%Y%m%d-%H:%M:%S"
 
-_LEVEL = os.environ.get("AREAL_LOG_LEVEL", "INFO").upper()
+_LEVEL = constants.log_level()
 
 _configured = False
 

@@ -82,6 +82,27 @@ counters = CounterRegistry()
 
 
 # --------------------------------------------------------------------- #
+# Counter catalog. Every fixed counter name is an UPPERCASE constant in
+# this module — the single authoritative name list dashboards and tests
+# key off. Enforced statically by the ``unregistered-counter`` rule of
+# ``tools/arealint`` (string-literal names at call sites must match a
+# value here; constant references must be defined here). Dynamic families
+# (``tracing.span``'s ``<name>_s``/``<name>_n``, ``faults/<point>``) are
+# exempt — they cannot be checked statically.
+# --------------------------------------------------------------------- #
+
+# Data-plane pipeline namespace (``fwd_pipe/`` / ``train_pipe/`` /
+# ``stats_fetch/``) — proves the host<->device overlap happened
+# (docs/pipelined_data_plane.md) instead of inferring it from wall time.
+PIPE_STATS_FETCH_BLOCKING = "stats_fetch/blocking"   # blocking device pulls
+PIPE_PREFETCHED_MINIBATCHES = "train_pipe/prefetched_minibatches"
+PIPE_STATS_FLUSHES = "train_pipe/stats_flushes"      # deferred-stats flushes
+PIPE_FWD_DISPATCHED = "fwd_pipe/dispatched"          # forward mbs dispatched
+PIPE_FWD_MAX_IN_FLIGHT = "fwd_pipe/max_in_flight"    # realized pipeline depth
+PIPE_FWD_DEVICE_IDLE_GAP_S = "fwd_pipe/device_idle_gap_s"
+
+
+# --------------------------------------------------------------------- #
 # Fault-tolerance counter namespace (``ft/``) — every retry / eviction /
 # requeue decision the fleet-health subsystem makes is observable here
 # (docs/fault_tolerance.md).  Tests assert on these instead of scraping

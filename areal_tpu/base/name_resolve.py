@@ -27,7 +27,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from areal_tpu.base import logging
+from areal_tpu.base import constants, logging
 
 logger = logging.getLogger("name_resolve")
 
@@ -201,9 +201,7 @@ class FileNameRecordRepository(NameRecordRepository):
 
     def __init__(self, root: Optional[str] = None):
         if root is None:
-            root = os.environ.get(
-                "AREAL_NAME_RESOLVE_ROOT", "/tmp/areal_tpu/name_resolve"
-            )
+            root = constants.name_resolve_root()
         self._root = root
         self._to_delete = set()
         self._lock = threading.Lock()
@@ -256,6 +254,7 @@ class FileNameRecordRepository(NameRecordRepository):
 
     def clear_subtree(self, name_root):
         path = os.path.join(self._root, name_root.strip("/"))
+        # arealint: ok(name-resolve KV subtree under self._root, never a checkpoint dir)
         shutil.rmtree(path, ignore_errors=True)
         with self._lock:
             self._to_delete = {
@@ -304,7 +303,7 @@ class RpcNameRecordRepository(NameRecordRepository):
     def __init__(self, address: Optional[str] = None):
         import socket as _socket
 
-        address = address or os.environ.get("AREAL_NAME_RESOLVE_RPC")
+        address = address or constants.name_resolve_rpc()
         if not address or ":" not in address:
             raise ValueError(
                 "rpc name_resolve needs 'host:port' (config root or "

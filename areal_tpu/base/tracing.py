@@ -40,12 +40,11 @@ def live_spans() -> List[Dict[str, object]]:
 
 
 def trace_enabled() -> bool:
-    return os.environ.get(constants.TRACE_ENV, "0") not in ("", "0", "false")
+    return constants.trace_enabled()
 
 
 def trace_dir(tag: str) -> str:
-    root = os.environ.get("AREAL_FILEROOT", "/tmp/areal_tpu")
-    return os.path.join(root, "traces", tag)
+    return os.path.join(constants.trace_root(), "traces", tag)
 
 
 @contextlib.contextmanager
@@ -65,7 +64,7 @@ def maybe_trace(tag: str):
 def trace_step() -> int:
     """Which training step the trainers dump (tracing every step would grow
     unboundedly; the reference profiles a fixed early step the same way)."""
-    return int(os.environ.get("AREAL_TRACE_STEP", "3"))
+    return constants.trace_step()
 
 
 @contextlib.contextmanager

@@ -69,6 +69,9 @@ class MathCodeSingleStepEnv(EnvironmentService):
                 )
         else:
             loop = asyncio.get_event_loop()
+            # return_exceptions: a verifier crashing on one pathological
+            # answer must not abort the whole group's rewards — the broken
+            # answer grades False below, its siblings keep their scores
             if task == "math":
                 success = await asyncio.gather(
                     *(
@@ -77,7 +80,8 @@ class MathCodeSingleStepEnv(EnvironmentService):
                             a, meta["solutions"],
                         )
                         for a in answers
-                    )
+                    ),
+                    return_exceptions=True,
                 )
             else:
                 success = await asyncio.gather(
@@ -87,6 +91,9 @@ class MathCodeSingleStepEnv(EnvironmentService):
                             a, meta["input_output"],
                         )
                         for a in answers
-                    )
+                    ),
+                    return_exceptions=True,
                 )
-        return None, [bool(s) for s in success], True, False, {}
+        return None, [
+            bool(s) and not isinstance(s, BaseException) for s in success
+        ], True, False, {}

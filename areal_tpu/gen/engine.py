@@ -41,7 +41,6 @@ Thread-safety: ``submit`` arrives on the server's asyncio thread while
 """
 
 import dataclasses
-import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -51,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from areal_tpu.base import constants
 from areal_tpu.gen.pages import OutOfPagesError, PagePool, PrefixRegistry
 from areal_tpu.gen.sampling import SamplingParams, sample_tokens
 from areal_tpu.models import transformer as tfm
@@ -228,6 +228,7 @@ class GenerationEngine:
                 sh, cache=tfm.PagedKVCache(pages=self._pages_sh)
             )
             self._state_sh = sh
+            # arealint: ok(one-time engine-state materialization at construction)
             self.state = jax.jit(make_state, out_shardings=sh)()
         self.accepting = True  # False = decode only, no new admissions
         self.paused = False
@@ -248,8 +249,7 @@ class GenerationEngine:
         self._pipeline = (
             pipeline_chunks
             if pipeline_chunks is not None
-            else os.environ.get("AREAL_DECODE_PIPELINE", "0")
-            not in ("0", "false", "")
+            else constants.decode_pipeline_enabled()
         )
         self._prev_flags = None           # chunk k's undonated flag outputs
         self._prev_running: tuple = ()    # (slot, epoch) pairs at k's dispatch

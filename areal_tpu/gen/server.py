@@ -26,7 +26,7 @@ from typing import Dict, Optional
 
 from aiohttp import web
 
-from areal_tpu.base import hbm
+from areal_tpu.base import constants, hbm
 from areal_tpu.gen.engine import GenerationEngine, GenOutput, GenRequest
 
 logger = logging.getLogger("areal_tpu.gen.server")
@@ -111,7 +111,7 @@ class GenerationHTTPServer:
         # memory_stats() can be a full RPC on tunneled devices, so it must
         # stay off the per-chunk path (≈ the reference's per-MFC check +
         # kill threshold, realhf/system/model_worker.py:1507-1512)
-        hbm_period = float(os.environ.get("AREAL_HBM_CHECK_SECS", 30.0))
+        hbm_period = constants.hbm_check_secs()
         next_hbm = time.time() + hbm_period
         # metrics dump rides the same loop: PERIODIC, not only at cleanup —
         # a SIGTERM'd worker (launcher straggler kill) must still leave its

@@ -61,7 +61,9 @@ def _load():
         if _tried:
             return _lib
         _tried = True
-        if os.environ.get("AREAL_DISABLE_NATIVE"):
+        from areal_tpu.base import constants
+
+        if constants.native_disabled():
             return None
         try:
             stale = not os.path.exists(_SO) or (

@@ -54,7 +54,6 @@ casts would quarter MXU throughput).
 """
 
 import functools
-import os
 from typing import Optional
 
 import jax
@@ -82,9 +81,9 @@ def _bwd_pipeline() -> bool:
     # the next block's VPU work. Numerics identical (parking dtype = the
     # dots' operand dtype). Default OFF until chip-measured — the bench
     # A/Bs both settings and the winner becomes the default.
-    return os.environ.get("AREAL_FLASH_BWD_PIPELINE", "0") not in (
-        "0", "false", ""
-    )
+    from areal_tpu.base import constants
+
+    return constants.flash_bwd_pipeline_enabled()
 
 
 def _interpret() -> bool:
@@ -1312,7 +1311,9 @@ def packed_flash_attention(
     flag is read at TRACE time — set it before the first jit of a calling
     step; flipping it later does not retrace cached programs.
     """
-    if max_seqlen is not None and os.environ.get("AREAL_DEBUG_CHECKS") == "1":
+    from areal_tpu.base import constants
+
+    if max_seqlen is not None and constants.debug_checks_enabled():
         T = segment_ids.shape[0]
         seg_max = jnp.max(
             jnp.bincount(

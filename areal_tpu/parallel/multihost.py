@@ -22,12 +22,13 @@ serves laptop CPU tests and v5p-128 pods.
 """
 
 import logging
-import os
 import zlib
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
+
+from areal_tpu.base import constants
 
 logger = logging.getLogger("areal_tpu.multihost")
 
@@ -82,7 +83,7 @@ def maybe_initialize_from_env() -> bool:
     auto-detects the topology; setting only ``AREAL_COORDINATOR=auto``
     requests that path.
     """
-    coord = os.environ.get(COORDINATOR_ENV)
+    coord = constants.multihost_coordinator()
     if coord is None:
         return False
     if coord == "auto":
@@ -93,8 +94,8 @@ def maybe_initialize_from_env() -> bool:
         return jax.process_count() > 1
     return initialize(
         coordinator_address=coord,
-        num_processes=int(os.environ[NUM_PROCESSES_ENV]),
-        process_id=int(os.environ[PROCESS_ID_ENV]),
+        num_processes=constants.multihost_num_processes(),
+        process_id=constants.multihost_process_id(),
     )
 
 

@@ -16,7 +16,6 @@ logging (call.py:182-197). Enabled via ``AREAL_ENABLE_FUNCTION_CALL=1`` +
 
 import asyncio
 import logging
-import os
 import random
 import time
 from statistics import median
@@ -24,13 +23,15 @@ from typing import Any, Dict, List, Optional
 
 import aiohttp
 
+from areal_tpu.base import constants
+
 logger = logging.getLogger("areal_tpu.rewards.remote")
 
-ENABLED = os.environ.get("AREAL_ENABLE_FUNCTION_CALL", "0") == "1"
+ENABLED = constants.function_call_enabled()
 
 
 def service_domain() -> str:
-    return os.environ.get("AREAL_FUNCTIONCALL_SERVICE_DOMAIN", "")
+    return constants.functioncall_service_domain()
 
 
 def _failure(uid: str, reason: str) -> Dict[str, Any]:
@@ -68,11 +69,11 @@ def default_concurrency() -> int:
     """Per-process cap: a shared sandbox budget split across data-parallel
     callers (≈ call.py:211-218's 5000 // dp), overridable via
     ``AREAL_FUNCTIONCALL_CONCURRENCY``."""
-    if "AREAL_FUNCTIONCALL_CONCURRENCY" in os.environ:
-        return int(os.environ["AREAL_FUNCTIONCALL_CONCURRENCY"])
+    override = constants.functioncall_concurrency_override()
+    if override is not None:
+        return override
     budget = 5000
-    dp = int(os.environ.get("AREAL_FUNCTIONCALL_DP", 16))
-    return max(budget // max(dp, 1), 1)
+    return max(budget // max(constants.functioncall_dp(), 1), 1)
 
 
 async def async_invoke(
@@ -155,7 +156,19 @@ async def batch_function_call_async(
                 elapsed.append(time.monotonic() - t0)
                 return r
 
-        results = list(await asyncio.gather(*(one(p) for p in payloads)))
+        # return_exceptions: one crashed invocation (session teardown,
+        # cancelled connector) must not abort the whole batch — the caller
+        # contract is one result dict per payload, never an exception
+        raw = await asyncio.gather(
+            *(one(p) for p in payloads), return_exceptions=True
+        )
+        results = [
+            r if not isinstance(r, BaseException) else _failure(
+                p.get("uid", "") if isinstance(p, dict) else "",
+                f"{type(r).__name__}: {r}",
+            )
+            for p, r in zip(payloads, raw)
+        ]
     if elapsed:
         s = sorted(elapsed)
 

@@ -319,7 +319,7 @@ class AsyncPPOTrainerWorker:
         })
         # peaks are lifetime maxima — clear per step so the next step's
         # reported depth reflects ITS forwards, not an earlier step's
-        metrics_mod.counters.clear("fwd_pipe/max_in_flight")
+        metrics_mod.counters.clear(metrics_mod.PIPE_FWD_MAX_IN_FLIGHT)
         self._counters_before = metrics_mod.counters.snapshot()
         n_tokens = sum(
             sum(inner) for inner in sample.seqlens[sample.main_key()]
@@ -383,7 +383,7 @@ class AsyncPPOTrainerWorker:
         from areal_tpu.train.engine import host_stats_view
 
         pending, self._pending_stats = self._pending_stats, []
-        metrics_mod.counters.add("train_pipe/stats_flushes", 1)
+        metrics_mod.counters.add(metrics_mod.PIPE_STATS_FLUSHES, 1)
         with tracing.span("train_pipe/stats_fetch_deferred"):
             fetched = jax.device_get([s for (_, _, s) in pending])
         for (step, wall, _), stats in zip(pending, fetched):

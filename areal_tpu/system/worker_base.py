@@ -185,15 +185,9 @@ def last_heartbeat(
 
 def _env_float(name: str, default: float) -> float:
     """Tolerant env knob parse: a malformed value falls back to the default
-    (logged) instead of crashing the worker at startup."""
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        logger.warning("ignoring malformed %s=%r (using %s)", name, raw, default)
-        return default
+    (logged) instead of crashing the worker at startup. Delegates to the
+    knob catalog's parser so the fallback semantics live in one place."""
+    return constants.env_float(name, default)
 
 
 def watchdog_timeout_from_env() -> Optional[float]:
@@ -280,9 +274,7 @@ class GracefulShutdown:
 
 
 def _watchdog_abort_enabled() -> bool:
-    return os.environ.get(constants.WATCHDOG_ABORT_ENV, "0") not in (
-        "", "0", "false", "off",
-    )
+    return constants.watchdog_abort_enabled()
 
 
 class HangWatchdog:

@@ -46,34 +46,19 @@ LossFn = Callable[[Any, ModelConfig, Dict[str, jnp.ndarray]], Tuple[jnp.ndarray,
 OutputFn = Callable[[Any, ModelConfig, Dict[str, jnp.ndarray]], jnp.ndarray]
 
 
-def _env_knob(name: str, default_depth: int) -> int:
-    """Parse a pipeline env knob: unset/"true"/"on" -> default depth,
-    "false"/"off" -> 0 (disabled), an integer -> exactly that depth
-    (so "1" really means depth 1, the serial discipline — not "enabled")."""
-    v = os.environ.get(name)
-    if v is None or v.strip() in ("", "true", "on"):
-        return default_depth
-    if v.strip().lower() in ("false", "off"):
-        return 0
-    try:
-        return max(int(v), 0)
-    except ValueError:
-        return default_depth
-
-
 def fwd_pipeline_depth() -> int:
     """Micro-batches kept in flight by :meth:`TrainEngine.forward` (the
     dispatch-ahead window). Default 2: dispatch mb i+1 before fetching mb i,
     so the device never idles on the host's fetch→unpack round trip. 0/1 =
     the serial path."""
-    return _env_knob(constants.FWD_PIPELINE_ENV, 2)
+    return constants.env_knob(constants.FWD_PIPELINE_ENV, 2)
 
 
 def train_prefetch_enabled() -> bool:
     """Gates BOTH halves of the train-side pipeline: background pack+put
     prefetch of minibatch n+1 under the in-flight step for minibatch n, and
     the deferred (per-logging-interval, not per-step) stats fetch."""
-    return _env_knob(constants.TRAIN_PREFETCH_ENV, 1) > 0
+    return constants.env_knob(constants.TRAIN_PREFETCH_ENV, 1) > 0
 
 
 def train_guard_enabled() -> bool:
@@ -83,7 +68,7 @@ def train_guard_enabled() -> bool:
     ``guard/step_ok`` in the stats the trainer already fetches — no extra
     host round trip (bench.py ``guard`` section proves ~0 overhead). Read
     at jit-build time; toggling requires a fresh engine."""
-    return _env_knob(constants.TRAIN_GUARD_ENV, 1) > 0
+    return constants.env_knob(constants.TRAIN_GUARD_ENV, 1) > 0
 
 
 def host_stats_view(host: Dict[str, Any]) -> Dict[str, float]:
@@ -99,8 +84,9 @@ def host_stats_view(host: Dict[str, Any]) -> Dict[str, float]:
 def fetch_stats_dict(stats: Dict[str, Any]) -> Dict[str, float]:
     """Pull every device scalar in one transfer (a per-scalar ``float()``
     costs a full host round trip on remote accelerators)."""
-    metrics_mod.counters.add("stats_fetch/blocking", 1)
+    metrics_mod.counters.add(metrics_mod.PIPE_STATS_FETCH_BLOCKING, 1)
     with tracing.span("train_pipe/stats_fetch"):
+        # arealint: ok(the ONE designed stats sync — a single batched pull, deferred to the logging interval by fetch_stats=False on the hot path)
         host = jax.device_get(stats)
     return host_stats_view(host)
 
@@ -469,6 +455,7 @@ class TrainEngine:
         self.opt_state = jax.tree.map(
             lambda x: x if isinstance(x.sharding, NamedSharding)
             else jax.device_put(x, repl),
+            # arealint: ok(one-time optimizer-state init at setup, not a per-step rebuild)
             jax.jit(self.tx.init)(self.params),
         )
         return self
@@ -740,6 +727,7 @@ class TrainEngine:
         # mb CONTENT, not padding, so pre-repack values are final)
         w_local = None
         if weight_fn is not None:
+            # arealint: ok(weight_fn reads the host-side packed numpy buffers — no device value crosses here)
             w_local = [float(weight_fn(pb)) for pb in packed]
             w_local += [0.0] * n_empty          # padding mbs carry no loss
         weights = None
@@ -800,7 +788,7 @@ class TrainEngine:
             stacked=stacked, weights=weights, n_mbs=len(packed)
         )
 
-    def train_prepared(
+    def train_prepared(  # arealint: hot (per-minibatch PPO step dispatch)
         self,
         prep: "PreparedTrainBatch",
         loss_fn: LossFn,
@@ -833,7 +821,7 @@ class TrainEngine:
         out["n_mbs"] = prep.n_mbs
         return fetch_stats_dict(out) if fetch_stats else out
 
-    def train_batch(
+    def train_batch(  # arealint: hot (one optimizer step per call)
         self,
         sample: SequenceSample,
         mb_spec: MicroBatchSpec,
@@ -858,7 +846,7 @@ class TrainEngine:
         prep = self.prepare_train_batch(sample, mb_spec, loss_weight_fn)
         return self.train_prepared(prep, loss_fn, fetch_stats=fetch_stats)
 
-    def train_batches_pipelined(
+    def train_batches_pipelined(  # arealint: hot (the PPO minibatch loop)
         self,
         samples: Sequence[SequenceSample],
         mb_spec: MicroBatchSpec,
@@ -895,7 +883,7 @@ class TrainEngine:
                 )
                 for s in samples
             ]
-        metrics_mod.counters.add("train_pipe/prefetched_minibatches",
+        metrics_mod.counters.add(metrics_mod.PIPE_PREFETCHED_MINIBATCHES,
                                  max(len(samples) - 1, 0))
         prefetcher = batching.Prefetcher(
             samples,
@@ -927,7 +915,7 @@ class TrainEngine:
         tot = float(np.sum(np.where(weights > 0, losses * weights, 0.0)))
         return {"loss": tot / max(weights.sum(), 1)}
 
-    def forward(
+    def forward(  # arealint: hot (dispatch-ahead inference loop)
         self,
         sample: SequenceSample,
         mb_spec: MicroBatchSpec,
@@ -1005,9 +993,13 @@ class TrainEngine:
             collect(j, jpb, jout, len(in_flight))
 
         self._last_forward_events = events
-        metrics_mod.counters.add("fwd_pipe/dispatched", len(packed))
-        metrics_mod.counters.peak("fwd_pipe/max_in_flight", max_in_flight)
-        metrics_mod.counters.add("fwd_pipe/device_idle_gap_s", idle_gap)
+        metrics_mod.counters.add(metrics_mod.PIPE_FWD_DISPATCHED, len(packed))
+        metrics_mod.counters.peak(
+            metrics_mod.PIPE_FWD_MAX_IN_FLIGHT, max_in_flight
+        )
+        metrics_mod.counters.add(
+            metrics_mod.PIPE_FWD_DEVICE_IDLE_GAP_S, idle_gap
+        )
 
         outs: List[np.ndarray] = []
         main = sample.main_key()

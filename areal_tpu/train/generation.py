@@ -134,7 +134,7 @@ class SyncGenerator:
         self._jit[key] = jitted
         return jitted
 
-    def generate(
+    def generate(  # arealint: hot (sync-PPO whole-batch generation)
         self,
         prompts: Sequence[Sequence[int]],
         ghp: GenerationHyperparameters,
@@ -166,6 +166,7 @@ class SyncGenerator:
             top_k=jnp.asarray(np.full((B,), min(ghp.top_k, 1 << 30), np.int32)),
         )
         fn = self._gen_fn(B, Sp, S, max_new, len(stop))
+        # arealint: ok(the single whole-batch fetch after the decode scan — sync generation's one designed sync point)
         out_t, out_lp, n_gen, truncated = jax.device_get(
             fn(
                 eng.params,

@@ -1,0 +1,3 @@
+# Makes tools/ importable so `python -m tools.arealint` works from the
+# repo root. Keep this file empty of logic: the repo's import root is
+# areal_tpu/; tools/ holds dev/CI utilities only.

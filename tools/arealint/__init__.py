@@ -1,0 +1,48 @@
+"""arealint — the repo's JAX/TPU-aware static-analysis framework.
+
+A rule-registry AST linter (stdlib-only, never imports repo code) that
+keeps the async-RL stack's performance and correctness invariants
+enforceable in tier-1 CI: async hygiene, host-sync-free hot paths,
+retrace/donation discipline, and the env-knob / counter / fault-point
+catalogs. See docs/static_analysis.md for the rule catalog and policies.
+
+Usage::
+
+    python -m tools.arealint [paths...] [--format json]
+    from tools.arealint import scan_paths, scan_source, RULES
+"""
+
+from tools.arealint.core import (  # noqa: F401
+    Config,
+    Finding,
+    RULES,
+    Rule,
+    SEVERITY_ERROR,
+    SEVERITY_WARN,
+    default_config,
+    default_repo_root,
+    has_errors,
+    rule,
+    scan_paths,
+    scan_source,
+)
+
+# Importing the rule modules registers their rules.
+from tools.arealint import rules_async  # noqa: E402,F401
+from tools.arealint import rules_jax  # noqa: E402,F401
+from tools.arealint import rules_hygiene  # noqa: E402,F401
+
+from tools.arealint.baseline import (  # noqa: F401
+    DEFAULT_BASELINE,
+    BaselineError,
+    apply_baseline,
+    load_baseline,
+    norm_path,
+)
+
+LEGACY_ASYNC_RULES = (
+    "bare-gather",
+    "discarded-task",
+    "live-checkpoint-rmtree",
+    "sleep-in-async",
+)

@@ -1,0 +1,123 @@
+"""CLI: ``python -m tools.arealint [paths...]``.
+
+Exit codes (stable — CI keys off them):
+
+- ``0`` — clean, or only ``warn``-severity findings
+- ``1`` — at least one ``error``-severity finding survived the baseline
+- ``2`` — usage error (bad flag, unknown rule, malformed baseline)
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import List, Optional
+
+from tools.arealint import (
+    DEFAULT_BASELINE, BaselineError, RULES, apply_baseline, default_repo_root,
+    load_baseline, scan_paths,
+)
+
+DEFAULT_PATHS = ["areal_tpu"]
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.arealint",
+        description="JAX/TPU-aware static analysis for the areal_tpu stack "
+        "(docs/static_analysis.md)",
+    )
+    ap.add_argument(
+        "paths", nargs="*", default=None,
+        help=f"files/dirs to scan (default: {' '.join(DEFAULT_PATHS)})",
+    )
+    ap.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (json is stable for tooling)",
+    )
+    ap.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: <repo>/{DEFAULT_BASELINE})",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline (report every finding)",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = _build_parser()
+    args = ap.parse_args(argv)  # argparse exits 2 on usage errors
+
+    if args.list_rules:
+        width = max(len(r) for r in RULES)
+        for r in RULES.values():
+            print(f"{r.id:<{width}}  {r.severity:<5}  {r.doc}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(
+                f"unknown rule(s): {', '.join(unknown)} "
+                f"(see --list-rules)", file=sys.stderr,
+            )
+            return 2
+
+    root = default_repo_root()
+    paths = args.paths or [str(root / p) for p in DEFAULT_PATHS]
+    findings = scan_paths(paths, rules=rules)
+
+    entries: List[dict] = []
+    if not args.no_baseline:
+        bl_path = (
+            pathlib.Path(args.baseline)
+            if args.baseline else root / DEFAULT_BASELINE
+        )
+        if args.baseline or bl_path.is_file():
+            try:
+                entries = load_baseline(bl_path)
+            except BaselineError as e:
+                print(str(e), file=sys.stderr)
+                return 2
+    findings, stale = apply_baseline(findings, entries, root=root)
+
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = len(findings) - n_err
+
+    if args.format == "json":
+        print(json.dumps({
+            "version": 1,
+            "findings": [f.to_dict() for f in findings],
+            "stale_baseline": stale,
+            "errors": n_err,
+            "warnings": n_warn,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f"{f.path}:{f.line}: [{f.rule}] {f.severity}: {f.message}")
+        for e in stale:
+            print(
+                f"stale baseline entry (violation fixed — delete it): "
+                f"{e['path']} [{e['rule']}] ({e['reason']})"
+            )
+        if findings:
+            print(f"\n{n_err} error(s), {n_warn} warning(s).")
+        else:
+            print("arealint clean.")
+    return 1 if n_err else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

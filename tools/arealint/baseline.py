@@ -1,0 +1,104 @@
+"""Checked-in baseline: lets a NEW rule land enforced without first fixing
+(or arguing about) every legacy finding.
+
+``tools/arealint_baseline.json``::
+
+    {
+      "version": 1,
+      "entries": [
+        {"rule": "env-knob", "path": "areal_tpu/x/y.py",
+         "reason": "why this legacy finding is tolerated", "max": 2}
+      ]
+    }
+
+Semantics:
+
+- An entry suppresses up to ``max`` findings (default 1) of ``rule`` in
+  ``path`` (repo-relative, posix separators). The ``reason`` is REQUIRED.
+- An entry that matches zero findings is **stale**: the violation was
+  fixed, so the entry must be deleted. Stale entries are reported by the
+  CLI (and surfaced in ``--format json`` under ``stale_baseline``) —
+  baselines only ever shrink.
+- Baselining is for legacy findings at rule-introduction time. New code
+  uses an inline ``# arealint: ok(<reason>)`` (visible at the call site)
+  or gets fixed.
+"""
+
+import json
+import pathlib
+from typing import Iterable, List, Optional, Tuple
+
+from tools.arealint.core import Finding
+
+DEFAULT_BASELINE = "tools/arealint_baseline.json"
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (CLI exit 2 — a usage error, not a lint
+    failure)."""
+
+
+def load_baseline(path) -> List[dict]:
+    path = pathlib.Path(path)
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        raise BaselineError(f"cannot read baseline {path}: {e}") from e
+    if not isinstance(data, dict) or not isinstance(
+        data.get("entries"), list
+    ):
+        raise BaselineError(
+            f"baseline {path} must be an object with an 'entries' list"
+        )
+    entries = data["entries"]
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict):
+            raise BaselineError(f"baseline entry #{i} is not an object")
+        for key in ("rule", "path", "reason"):
+            if not isinstance(e.get(key), str) or not e[key].strip():
+                raise BaselineError(
+                    f"baseline entry #{i} needs a non-empty {key!r} "
+                    "(every baselined finding records WHY it is tolerated)"
+                )
+        if "max" in e and (not isinstance(e["max"], int) or e["max"] < 1):
+            raise BaselineError(
+                f"baseline entry #{i}: 'max' must be a positive int"
+            )
+    return entries
+
+
+def norm_path(path: str, root: Optional[pathlib.Path] = None) -> str:
+    """Repo-relative posix path when under ``root``; unchanged otherwise."""
+    p = pathlib.PurePosixPath(str(path).replace("\\", "/"))
+    if root is not None:
+        rootp = str(root).replace("\\", "/").rstrip("/") + "/"
+        s = str(p)
+        if s.startswith(rootp):
+            return s[len(rootp):]
+    return str(p)
+
+
+def apply_baseline(
+    findings: Iterable[Finding],
+    entries: List[dict],
+    root: Optional[pathlib.Path] = None,
+) -> Tuple[List[Finding], List[dict]]:
+    """Returns ``(remaining_findings, stale_entries)``."""
+    used = [0] * len(entries)
+    remaining: List[Finding] = []
+    for f in findings:
+        fpath = norm_path(f.path, root)
+        matched = False
+        for i, e in enumerate(entries):
+            if (
+                e["rule"] == f.rule
+                and norm_path(e["path"]) == fpath
+                and used[i] < e.get("max", 1)
+            ):
+                used[i] += 1
+                matched = True
+                break
+        if not matched:
+            remaining.append(f)
+    stale = [e for i, e in enumerate(entries) if used[i] == 0]
+    return remaining, stale

@@ -1,0 +1,339 @@
+"""arealint core: Finding/Rule types, the rule registry, suppression
+parsing, and the scan driver.
+
+Design (docs/static_analysis.md):
+
+- Rules are plain functions registered with :func:`rule`; each receives a
+  :class:`FileContext` (source + AST + catalogs) and yields
+  ``(lineno, message)`` pairs. The driver turns them into
+  :class:`Finding`\\ s, applies inline suppressions, and sorts by line.
+- Everything is stdlib-only and purely static: no areal_tpu import, no
+  jax import — the linter must run in a bare CI container and never
+  execute repo code.
+- Per-rule severity: ``error`` findings fail the CLI (exit 1), ``warn``
+  findings are reported but non-fatal.
+- Inline suppression: ``# arealint: ok(<reason>)`` on the finding line or
+  on a comment-only line directly above. The reason is REQUIRED — a bare
+  ``# arealint: ok`` / empty ``ok()`` does not suppress and is itself
+  flagged (rule ``suppression-missing-reason``). The legacy
+  ``# async-hygiene: ok`` token still suppresses the four migrated async
+  rules so annotations that predate the framework keep working.
+- ``# arealint: hot`` on a ``def`` line (or the comment line above it)
+  marks a function as a hot-path root for the host-sync rule.
+"""
+
+import ast
+import dataclasses
+import pathlib
+import re
+from typing import (
+    Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple,
+)
+
+SUPPRESS_RE = re.compile(r"#\s*arealint:\s*ok\(\s*(?P<reason>[^)]*?)\s*\)")
+SUPPRESS_BARE_RE = re.compile(r"#\s*arealint:\s*ok\b(?!\s*\()")
+HOT_RE = re.compile(r"#\s*arealint:\s*hot\b")
+LEGACY_SUPPRESS = "# async-hygiene: ok"
+# The four rules migrated from tools/check_async_hygiene.py honor the
+# legacy suppression token too (annotations in the tree predate arealint).
+LEGACY_RULES = frozenset(
+    {"bare-gather", "discarded-task", "live-checkpoint-rmtree",
+     "sleep-in-async"}
+)
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARN = "warn"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+    severity: str = SEVERITY_ERROR
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# --------------------------------------------------------------------- #
+# Catalogs (metrics counters, fault points) — parsed from the repo's
+# catalog modules with ast, never imported.
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class Config:
+    """Catalog + repo context a scan runs against. Tests build one by hand
+    to lint fixture snippets against a synthetic catalog; the CLI loads
+    the real catalogs from the repo."""
+
+    # Registered counter name VALUES (e.g. "ft/evictions") and the
+    # UPPERCASE constant NAMES that hold them (e.g. "FT_EVICTIONS").
+    counter_values: Optional[frozenset] = None
+    counter_names: Optional[frozenset] = None
+    # Registered fault injection points (base/faults.py FAULT_POINTS).
+    fault_points: Optional[frozenset] = None
+    repo_root: Optional[pathlib.Path] = None
+
+    @classmethod
+    def from_repo(cls, root: Optional[pathlib.Path] = None) -> "Config":
+        root = pathlib.Path(root) if root else default_repo_root()
+        cfg = cls(repo_root=root)
+        metrics_py = root / "areal_tpu" / "base" / "metrics.py"
+        faults_py = root / "areal_tpu" / "base" / "faults.py"
+        if metrics_py.is_file():
+            names, values = _module_str_constants(metrics_py)
+            cfg.counter_names = frozenset(names)
+            cfg.counter_values = frozenset(values)
+        if faults_py.is_file():
+            cfg.fault_points = _fault_points(faults_py)
+        return cfg
+
+
+def default_repo_root() -> pathlib.Path:
+    # tools/arealint/core.py -> tools/arealint -> tools -> repo
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+def _module_str_constants(path: pathlib.Path) -> Tuple[List[str], List[str]]:
+    """Module-level ``UPPER_NAME = "literal"`` assignments: the catalog
+    convention of base/metrics.py."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    names, values = [], []
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if (
+            isinstance(t, ast.Name)
+            and t.id == t.id.upper()
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            names.append(t.id)
+            values.append(node.value.value)
+    return names, values
+
+
+def _fault_points(path: pathlib.Path) -> Optional[frozenset]:
+    """The ``FAULT_POINTS`` tuple in base/faults.py."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "FAULT_POINTS"
+            and isinstance(node.value, (ast.Tuple, ast.List, ast.Set))
+        ):
+            return frozenset(
+                e.value for e in node.value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+    return None
+
+
+_DEFAULT_CONFIG: Optional[Config] = None
+
+
+def default_config() -> Config:
+    global _DEFAULT_CONFIG
+    if _DEFAULT_CONFIG is None:
+        _DEFAULT_CONFIG = Config.from_repo()
+    return _DEFAULT_CONFIG
+
+
+# --------------------------------------------------------------------- #
+# File context
+# --------------------------------------------------------------------- #
+
+
+class FileContext:
+    """One file's parse state handed to every rule."""
+
+    def __init__(self, src: str, path: str, tree: ast.AST, config: Config):
+        self.src = src
+        self.path = path.replace("\\", "/")
+        self.lines = src.splitlines()
+        self.tree = tree
+        self.config = config
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    self._parents[child] = node
+        return self._parents
+
+    def enclosing_function(self, node: ast.AST):
+        """Nearest enclosing FunctionDef/AsyncFunctionDef, or None."""
+        parents = self.parents()
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = parents.get(cur)
+        return None
+
+    def hot_marked(self, fdef) -> bool:
+        """``# arealint: hot`` on the def line or the comment line above
+        the first decorator/def."""
+        first = min(
+            [fdef.lineno] + [d.lineno for d in fdef.decorator_list]
+        )
+        for ln in (fdef.lineno, first - 1):
+            text = self.line_text(ln)
+            if ln != fdef.lineno and not text.strip().startswith("#"):
+                continue
+            if HOT_RE.search(text):
+                return True
+        return False
+
+    def path_endswith(self, *suffixes: str) -> bool:
+        return any(self.path.endswith(s) for s in suffixes)
+
+
+def walk_excluding_nested(fdef) -> Iterator[ast.AST]:
+    """Nodes of a function's OWN body — nested function/lambda bodies are
+    separate execution contexts and are excluded (they are scanned when
+    the call graph reaches them)."""
+
+    def _walk(node):
+        for child in ast.iter_child_nodes(node):
+            yield child
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield from _walk(child)
+
+    for stmt in fdef.body:
+        yield stmt
+        if not isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            yield from _walk(stmt)
+
+
+# --------------------------------------------------------------------- #
+# Rule registry
+# --------------------------------------------------------------------- #
+
+CheckFn = Callable[[FileContext], Iterable[Tuple[int, str]]]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    doc: str
+    check: CheckFn
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, severity: str, doc: str):
+    """Register a rule. ``doc`` is the one-line catalog entry shown by
+    ``--list-rules`` and docs/static_analysis.md."""
+    assert severity in (SEVERITY_ERROR, SEVERITY_WARN), severity
+
+    def deco(fn: CheckFn) -> CheckFn:
+        assert rule_id not in RULES, f"duplicate rule id {rule_id}"
+        RULES[rule_id] = Rule(rule_id, severity, doc, fn)
+        return fn
+
+    return deco
+
+
+# --------------------------------------------------------------------- #
+# Suppression
+# --------------------------------------------------------------------- #
+
+
+def is_suppressed(ctx: FileContext, rule_id: str, lineno: int) -> bool:
+    """Valid ``# arealint: ok(<reason>)`` on the line (or a comment-only
+    line above); legacy ``# async-hygiene: ok`` for the migrated rules."""
+    for ln in (lineno, lineno - 1):
+        text = ctx.line_text(ln)
+        if ln != lineno and not text.strip().startswith("#"):
+            continue
+        m = SUPPRESS_RE.search(text)
+        if m and m.group("reason").strip():
+            return True
+        if rule_id in LEGACY_RULES and LEGACY_SUPPRESS in text:
+            return True
+    return False
+
+
+# --------------------------------------------------------------------- #
+# Scan driver
+# --------------------------------------------------------------------- #
+
+
+def _resolve_rules(rules: Optional[Sequence[str]]) -> List[Rule]:
+    if rules is None:
+        return list(RULES.values())
+    unknown = [r for r in rules if r not in RULES]
+    if unknown:
+        raise KeyError(f"unknown rule(s): {', '.join(unknown)}")
+    return [RULES[r] for r in rules]
+
+
+def scan_source(
+    src: str,
+    path: str = "<string>",
+    rules: Optional[Sequence[str]] = None,
+    config: Optional[Config] = None,
+) -> List[Finding]:
+    config = config if config is not None else default_config()
+    selected = _resolve_rules(rules)
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                path.replace("\\", "/"), e.lineno or 0, "parse-error",
+                f"could not parse: {e.msg}", SEVERITY_ERROR,
+            )
+        ]
+    ctx = FileContext(src, path, tree, config)
+    out: List[Finding] = []
+    for r in selected:
+        for lineno, message in r.check(ctx):
+            if not is_suppressed(ctx, r.id, lineno):
+                out.append(Finding(ctx.path, lineno, r.id, message, r.severity))
+    out.sort(key=lambda f: (f.line, f.rule))
+    return out
+
+
+def scan_paths(
+    paths: Iterable,
+    rules: Optional[Sequence[str]] = None,
+    config: Optional[Config] = None,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            findings.extend(
+                scan_source(f.read_text(), str(f), rules=rules, config=config)
+            )
+    return findings
+
+
+def has_errors(findings: Iterable[Finding]) -> bool:
+    return any(f.severity == SEVERITY_ERROR for f in findings)

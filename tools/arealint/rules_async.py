@@ -1,0 +1,145 @@
+"""Async-hygiene rules, migrated from tools/check_async_hygiene.py.
+
+The four bug classes behind the fleet-wedging failures the fault-tolerance
+subsystem fixed (docs/fault_tolerance.md): a bare ``asyncio.gather`` aborts
+the whole fan-out on one dead peer; a discarded ``create_task`` can be
+GC'd mid-flight and its exceptions vanish; ``shutil.rmtree`` outside the
+checkpoint commit helper can destroy the only restore point; ``time.sleep``
+inside ``async def`` stalls every heartbeat and in-flight rollout on the
+loop.
+"""
+
+import ast
+
+from tools.arealint.core import FileContext, SEVERITY_ERROR, rule
+
+# The one module where deleting checkpoint-capable dirs is legal: the
+# commit protocol itself.
+RMTREE_ALLOWED_SUFFIXES = ("base/recover.py",)
+
+
+def _is_gather(call: ast.Call) -> bool:
+    """``asyncio.gather(...)`` and bare ``gather(...)`` (from-import), but
+    not e.g. ``SequenceSample.gather`` (a data join)."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "gather":
+        return isinstance(f.value, ast.Name) and f.value.id == "asyncio"
+    return isinstance(f, ast.Name) and f.id == "gather"
+
+
+def _is_spawn(call: ast.Call) -> bool:
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else ""
+    )
+    return name in ("create_task", "ensure_future")
+
+
+def _is_rmtree(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "rmtree":
+        return isinstance(f.value, ast.Name) and f.value.id == "shutil"
+    return isinstance(f, ast.Name) and f.id == "rmtree"
+
+
+def _is_time_sleep(call: ast.Call) -> bool:
+    f = call.func
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr == "sleep"
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "time"
+    )
+
+
+def _is_bare_sleep(call: ast.Call) -> bool:
+    """``sleep(...)`` via from-import — blocking unless awaited (an awaited
+    bare ``sleep`` is asyncio's, imported the same way)."""
+    return isinstance(call.func, ast.Name) and call.func.id == "sleep"
+
+
+@rule(
+    "bare-gather", SEVERITY_ERROR,
+    "asyncio.gather without return_exceptions: one failed awaitable aborts "
+    "the whole fan-out and every sibling result is lost",
+)
+def check_bare_gather(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_gather(node):
+            if not any(k.arg == "return_exceptions" for k in node.keywords):
+                yield (
+                    node.lineno,
+                    "asyncio.gather without return_exceptions — one failed "
+                    "awaitable aborts the whole fan-out",
+                )
+
+
+@rule(
+    "discarded-task", SEVERITY_ERROR,
+    "create_task/ensure_future result discarded: the unreferenced task may "
+    "be GC'd mid-flight and its exceptions vanish",
+)
+def check_discarded_task(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Expr)
+            and isinstance(node.value, ast.Call)
+            and _is_spawn(node.value)
+        ):
+            yield (
+                node.lineno,
+                "create_task result discarded — task is unreferenced "
+                "(may be GC'd) and never awaited (exceptions vanish)",
+            )
+
+
+@rule(
+    "live-checkpoint-rmtree", SEVERITY_ERROR,
+    "shutil.rmtree outside base/recover's commit helpers can destroy the "
+    "only committed checkpoint",
+)
+def check_rmtree(ctx: FileContext):
+    if ctx.path_endswith(*RMTREE_ALLOWED_SUFFIXES):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_rmtree(node):
+            yield (
+                node.lineno,
+                "shutil.rmtree outside base/recover's commit helpers — "
+                "a crash mid-save can destroy the only committed "
+                "checkpoint; stage + commit via areal_tpu.base.recover",
+            )
+
+
+@rule(
+    "sleep-in-async", SEVERITY_ERROR,
+    "time.sleep inside async def blocks the event loop (use await "
+    "asyncio.sleep)",
+)
+def check_sleep_in_async(ctx: FileContext):
+    """``time.sleep`` (attribute or from-import form) reachable from an
+    ``async def`` body — nested SYNC defs are excluded (they run where
+    they are called, which may be an executor thread)."""
+    found = []
+
+    def walk_async_body(node, awaited=False):
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return  # a new (possibly sync) execution context
+        if isinstance(node, ast.Call) and (
+            _is_time_sleep(node) or (_is_bare_sleep(node) and not awaited)
+        ):
+            found.append((
+                node.lineno,
+                "time.sleep inside async def blocks the event loop — "
+                "use await asyncio.sleep",
+            ))
+        for child in ast.iter_child_nodes(node):
+            walk_async_body(child, awaited=isinstance(node, ast.Await))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            for stmt in node.body:
+                walk_async_body(stmt)
+    yield from found

@@ -1,0 +1,226 @@
+"""Repo-hygiene rules: the env-knob catalog, the metrics-counter catalog,
+the fault-point catalog, and suppression-comment hygiene.
+
+The survivability planes (docs/fault_tolerance.md) are driven by AREAL_*
+env knobs and observed through ``metrics.counters`` / ``faults`` injection
+points. All three have a single source of truth:
+
+- env knobs are read in ``areal_tpu/base/constants.py`` (or via a
+  ``worker_base._env_*`` tolerant parser) so every knob has a documented
+  default in one place;
+- counter names are UPPERCASE constants in ``areal_tpu/base/metrics.py``;
+- fault points are listed in ``FAULT_POINTS`` in ``areal_tpu/base/faults.py``.
+
+A name used but not registered is exactly how a knob/counter silently
+falls out of the docs and the ``get_env_vars`` forwarding list — these
+rules make the catalogs load-bearing.
+"""
+
+import ast
+from typing import Optional
+
+from tools.arealint.core import (
+    SUPPRESS_BARE_RE, SUPPRESS_RE, FileContext, SEVERITY_ERROR,
+    SEVERITY_WARN, rule,
+)
+
+ENV_CATALOG_SUFFIXES = ("base/constants.py",)
+ENV_HELPER_FILE = "system/worker_base.py"
+OS_ALIASES = ("os", "_os")
+
+
+def _is_os_environ(node: ast.AST) -> bool:
+    if (
+        isinstance(node, ast.Attribute)
+        and node.attr == "environ"
+        and isinstance(node.value, ast.Name)
+        and node.value.id in OS_ALIASES
+    ):
+        return True
+    # from os import environ
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def _env_read(node: ast.AST) -> Optional[str]:
+    """An env READ form; writes (assignment/setdefault/pop/del) stay legal
+    everywhere — propagating knobs to child processes is not a read."""
+    if isinstance(node, ast.Call):
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "getenv"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in OS_ALIASES
+        ):
+            return "os.getenv"
+        # from os import getenv
+        if isinstance(f, ast.Name) and f.id == "getenv":
+            return "getenv"
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "get"
+            and _is_os_environ(f.value)
+        ):
+            return "os.environ.get"
+    if (
+        isinstance(node, ast.Subscript)
+        and _is_os_environ(node.value)
+        and isinstance(node.ctx, ast.Load)
+    ):
+        return "os.environ[...]"
+    if isinstance(node, ast.Compare) and any(
+        isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+    ):
+        if any(_is_os_environ(c) for c in node.comparators):
+            return "'in os.environ'"
+    return None
+
+
+@rule(
+    "env-knob", SEVERITY_ERROR,
+    "os.environ/os.getenv read outside the knob catalog "
+    "(base/constants.py accessors or a worker_base._env_* parser) — "
+    "undocumented knobs bypass defaults, docs, and worker env forwarding",
+)
+def check_env_knob(ctx: FileContext):
+    if ctx.path_endswith(*ENV_CATALOG_SUFFIXES):
+        return
+    in_helper_file = ctx.path_endswith(ENV_HELPER_FILE)
+    for node in ast.walk(ctx.tree):
+        form = _env_read(node)
+        if form is None:
+            continue
+        if in_helper_file:
+            enc = ctx.enclosing_function(node)
+            if enc is not None and enc.name.startswith("_env_"):
+                continue
+        yield (
+            node.lineno,
+            f"{form} outside the knob catalog — add an accessor with a "
+            "default and docstring to areal_tpu/base/constants.py (or use "
+            "a worker_base._env_* parser) so the knob stays documented "
+            "and forwarded to workers",
+        )
+
+
+# --------------------------------------------------------------------- #
+# metrics counter registry
+# --------------------------------------------------------------------- #
+
+COUNTER_METHODS = ("add", "peak", "get", "clear")
+
+
+@rule(
+    "unregistered-counter", SEVERITY_ERROR,
+    "metrics.counters.add/peak/get/clear with a name that is not a "
+    "registered constant in the base/metrics.py catalog",
+)
+def check_counters(ctx: FileContext):
+    values = ctx.config.counter_values
+    names = ctx.config.counter_names
+    if values is None or ctx.path_endswith("base/metrics.py"):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        if not (
+            isinstance(f, ast.Attribute) and f.attr in COUNTER_METHODS
+        ):
+            continue
+        v = f.value
+        is_counters = (
+            (isinstance(v, ast.Name) and v.id == "counters")
+            or (isinstance(v, ast.Attribute) and v.attr == "counters")
+        )
+        if not is_counters:
+            continue
+        a0 = node.args[0]
+        if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+            if a0.value not in values:
+                yield (
+                    a0.lineno,
+                    f"counter {a0.value!r} is not registered in the "
+                    "catalog in areal_tpu/base/metrics.py — add a named "
+                    "constant there (and use it here) so dashboards/tests "
+                    "have one authoritative name list",
+                )
+        elif isinstance(a0, (ast.Attribute, ast.Name)):
+            last = a0.attr if isinstance(a0, ast.Attribute) else a0.id
+            if last == last.upper() and last.isidentifier() and names:
+                if last not in names:
+                    yield (
+                        a0.lineno,
+                        f"counter constant {last!r} is not defined in "
+                        "areal_tpu/base/metrics.py — register it in the "
+                        "catalog",
+                    )
+        # f-strings / variables: dynamic names (e.g. tracing.span's
+        # "<span>_s") cannot be checked statically; skipped.
+
+
+# --------------------------------------------------------------------- #
+# fault injection point registry
+# --------------------------------------------------------------------- #
+
+FAULT_FUNCS = ("maybe_fail", "maybe_trip", "maybe_fail_async", "inject")
+
+
+@rule(
+    "unregistered-fault-point", SEVERITY_ERROR,
+    "faults.maybe_fail/maybe_trip/maybe_fail_async/inject with a point "
+    "name missing from FAULT_POINTS in base/faults.py",
+)
+def check_fault_points(ctx: FileContext):
+    points = ctx.config.fault_points
+    if points is None or ctx.path_endswith("base/faults.py"):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else ""
+        )
+        if name not in FAULT_FUNCS:
+            continue
+        a0 = node.args[0]
+        if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+            if a0.value not in points:
+                yield (
+                    a0.lineno,
+                    f"fault point {a0.value!r} is not registered in "
+                    "FAULT_POINTS in areal_tpu/base/faults.py — register "
+                    "it (and its docstring-table row) so scripted "
+                    "scenarios and docs stay in sync",
+                )
+
+
+# --------------------------------------------------------------------- #
+# suppression hygiene
+# --------------------------------------------------------------------- #
+
+
+@rule(
+    "suppression-missing-reason", SEVERITY_WARN,
+    "'# arealint: ok' without a reason — suppressions must say WHY "
+    "(# arealint: ok(<reason>)); a bare token does not suppress",
+)
+def check_suppressions(ctx: FileContext):
+    for i, line in enumerate(ctx.lines, 1):
+        m = SUPPRESS_RE.search(line)
+        if m is not None:
+            if not m.group("reason").strip():
+                yield (
+                    i,
+                    "inline suppression without a reason — write "
+                    "'# arealint: ok(<why this is deliberate>)'; the "
+                    "empty form does not suppress",
+                )
+        elif SUPPRESS_BARE_RE.search(line):
+            yield (
+                i,
+                "inline suppression without a reason — write "
+                "'# arealint: ok(<why this is deliberate>)'; the bare "
+                "token does not suppress",
+            )

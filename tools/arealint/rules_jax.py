@@ -1,0 +1,449 @@
+"""JAX/TPU performance-invariant rules.
+
+These encode the bug classes the ROADMAP's "fast as the hardware allows"
+goal cannot survive silently (docs/static_analysis.md has the catalog):
+
+- **host-sync-in-hot-path** — a hidden host↔device sync (``device_get``,
+  ``.item()``, ``float(step(...))``, ``np.asarray(fn(...))``) inside a
+  function reachable from a jitted or ``# arealint: hot``-annotated root
+  serializes the dispatch-ahead pipeline (docs/pipelined_data_plane.md):
+  the device drains while the host blocks.
+- **retrace-hazard** — building a fresh jitted callable per call/iteration
+  (``jax.jit(f)(x)`` inline, ``jax.jit`` inside a loop) throws away the
+  trace cache and re-traces every time; non-hashable operands at
+  ``static_argnums`` positions fail or retrace per call; a closure-captured
+  ``jnp`` array is baked into the trace as a constant and silently
+  re-embedded on every rebuild.
+- **donation-after-use** — reading an argument after it was donated to a
+  jitted call (``donate_argnums``): XLA may have aliased its buffer in
+  place, so the read observes garbage (or errors) — and only on hardware,
+  never under the CPU tests.
+
+Reachability is intra-file and name-based (calls ``f(...)`` / ``self.f(...)``
+resolve to same-file ``def f``). Cross-module hot paths are annotated at
+their entry point with ``# arealint: hot`` instead.
+"""
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.arealint.core import (
+    FileContext, SEVERITY_ERROR, SEVERITY_WARN, rule, walk_excluding_nested,
+)
+
+JIT_NAMES = ("jit", "pjit")
+JNP_CTORS = (
+    "array", "asarray", "zeros", "ones", "full", "arange", "linspace", "eye",
+)
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    """``jax.jit(...)`` / ``jax.pjit(...)`` / bare ``jit(...)``/``pjit(...)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in JIT_NAMES:
+        return isinstance(f.value, ast.Name) and f.value.id == "jax"
+    return isinstance(f, ast.Name) and f.id in JIT_NAMES
+
+
+def _has_jit_decorator(fdef) -> bool:
+    """``@jax.jit`` / ``@jit`` / ``@functools.partial(jax.jit, ...)``."""
+    for dec in fdef.decorator_list:
+        if isinstance(dec, ast.Attribute) and dec.attr in JIT_NAMES:
+            return True
+        if isinstance(dec, ast.Name) and dec.id in JIT_NAMES:
+            return True
+        if isinstance(dec, ast.Call):
+            if _is_jit_call(dec):
+                return True
+            f = dec.func
+            is_partial = (
+                isinstance(f, ast.Attribute) and f.attr == "partial"
+            ) or (isinstance(f, ast.Name) and f.id == "partial")
+            if is_partial and dec.args:
+                a0 = dec.args[0]
+                if isinstance(a0, ast.Attribute) and a0.attr in JIT_NAMES:
+                    return True
+                if isinstance(a0, ast.Name) and a0.id in JIT_NAMES:
+                    return True
+    return False
+
+
+def _all_functions(ctx: FileContext) -> List[ast.AST]:
+    return [
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``x`` -> "x", ``self.params`` -> "self.params" (Name chains only)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+# --------------------------------------------------------------------- #
+# host-sync-in-hot-path
+# --------------------------------------------------------------------- #
+
+
+def _sync_match(node: ast.AST) -> Optional[str]:
+    """A call that forces (or strongly implies) a host↔device sync."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in ("device_get", "block_until_ready") and isinstance(
+            f.value, ast.Name
+        ) and f.value.id == "jax":
+            return f"jax.{f.attr}"
+        if f.attr == "block_until_ready" and not node.args:
+            return ".block_until_ready()"
+        if f.attr == "item" and not node.args:
+            return ".item()"
+        if (
+            f.attr in ("asarray", "array", "copy")
+            and isinstance(f.value, ast.Name)
+            and f.value.id in ("np", "numpy", "onp")
+            and node.args
+            and isinstance(node.args[0], ast.Call)
+        ):
+            # np.asarray(fn(...)): materializing a call result on host —
+            # the canonical accidental fetch. np.asarray(name) stays quiet
+            # (usually host data already).
+            return f"np.{f.attr}(<call result>)"
+    if (
+        isinstance(f, ast.Name)
+        and f.id == "float"
+        and len(node.args) == 1
+        and isinstance(node.args[0], ast.Call)
+    ):
+        # float(step(...)): blocks on the device scalar. float(name) stays
+        # quiet (commonly an already-fetched host scalar).
+        return "float(<call result>)"
+    return None
+
+
+@rule(
+    "host-sync-in-hot-path", SEVERITY_ERROR,
+    "host<->device sync (device_get / .item() / float(call) / "
+    "np.asarray(call) / block_until_ready) reachable from a jitted or "
+    "'# arealint: hot' root — serializes the dispatch-ahead pipeline",
+)
+def check_host_sync(ctx: FileContext):
+    funcs = _all_functions(ctx)
+    by_name: Dict[str, List[ast.AST]] = {}
+    for f in funcs:
+        by_name.setdefault(f.name, []).append(f)
+
+    hot: Set[ast.AST] = set()
+    for f in funcs:
+        if _has_jit_decorator(f) or ctx.hot_marked(f):
+            hot.add(f)
+    # functions handed to jax.jit(fn, ...) by name are traced bodies
+    for node in ast.walk(ctx.tree):
+        if _is_jit_call(node) and node.args and isinstance(
+            node.args[0], ast.Name
+        ):
+            hot.update(by_name.get(node.args[0].id, []))
+
+    # intra-file call graph: f(...) and self.f(...) resolve by bare name
+    calls: Dict[ast.AST, Set[str]] = {}
+    for f in funcs:
+        names: Set[str] = set()
+        for node in ast.walk(f):
+            if isinstance(node, ast.Call):
+                cf = node.func
+                if isinstance(cf, ast.Name):
+                    names.add(cf.id)
+                elif isinstance(cf, ast.Attribute) and isinstance(
+                    cf.value, ast.Name
+                ) and cf.value.id in ("self", "cls"):
+                    names.add(cf.attr)
+        calls[f] = names
+
+    reach: Set[ast.AST] = set(hot)
+    work = list(hot)
+    while work:
+        f = work.pop()
+        for name in calls.get(f, ()):
+            for g in by_name.get(name, ()):
+                if g not in reach:
+                    reach.add(g)
+                    work.append(g)
+
+    for f in sorted(reach, key=lambda n: n.lineno):
+        for node in walk_excluding_nested(f):
+            m = _sync_match(node)
+            if m:
+                yield (
+                    node.lineno,
+                    f"{m} in {f.name}() forces a host<->device sync on a "
+                    "hot path (reachable from a jitted or '# arealint: hot' "
+                    "root) — move it off the step path, batch it into the "
+                    "deferred stats fetch, or annotate a deliberate sync "
+                    "with '# arealint: ok(<reason>)'",
+                )
+
+
+# --------------------------------------------------------------------- #
+# retrace-hazard
+# --------------------------------------------------------------------- #
+
+
+def _static_positions(jit_call: ast.Call) -> Tuple[int, ...]:
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                )
+    return ()
+
+
+@rule(
+    "retrace-hazard", SEVERITY_WARN,
+    "jax.jit built per call/iteration (trace cache discarded), non-hashable "
+    "operand at a static_argnums position, or a closure-captured jnp array "
+    "baked into the trace",
+)
+def check_retrace(ctx: FileContext):
+    seen: Set[Tuple[int, str]] = set()
+
+    def emit(lineno: int, msg: str):
+        key = (lineno, msg)
+        if key not in seen:
+            seen.add(key)
+            yield (lineno, msg)
+
+    # (a) jit built inside a loop — every iteration re-traces from scratch
+    in_loop: Set[int] = set()
+    for loop in ast.walk(ctx.tree):
+        if isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+            for node in ast.walk(loop):
+                if _is_jit_call(node):
+                    in_loop.add(id(node))
+                    yield from emit(
+                        node.lineno,
+                        "jax.jit/pjit built inside a loop — the compiled "
+                        "trace is discarded every iteration; hoist the "
+                        "jitted callable out of the loop and reuse it",
+                    )
+
+    # (b) immediate invocation inside a function: jax.jit(f)(x) builds a
+    # fresh callable (and trace cache) on every call of the enclosing
+    # function. (c) non-hashable operands at its static positions.
+    for f in _all_functions(ctx):
+        for node in walk_excluding_nested(f):
+            if isinstance(node, ast.Call) and _is_jit_call(node.func):
+                # already reported by (a) — one defect, one finding
+                if id(node.func) not in in_loop:
+                    yield from emit(
+                        node.lineno,
+                        "jax.jit(...)(...) immediately invoked inside a "
+                        "function — a fresh jitted callable (empty trace "
+                        "cache) is built on every call of "
+                        f"{f.name}(); cache the jitted callable instead",
+                    )
+                for p in _static_positions(node.func):
+                    if p < len(node.args) and isinstance(
+                        node.args[p], (ast.List, ast.Dict, ast.Set)
+                    ):
+                        yield from emit(
+                            node.args[p].lineno,
+                            f"non-hashable operand at static_argnums "
+                            f"position {p} — static arguments are hashed "
+                            "into the trace-cache key; pass a hashable "
+                            "(tuple/int/str) or make the argument traced",
+                        )
+
+    # (d) closure-captured jnp arrays: jitting a local def that reads an
+    # enclosing-scope name bound to a jnp constructor call
+    for f in _all_functions(ctx):
+        local_defs = {
+            n.name: n for n in f.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        jnp_names: Dict[str, int] = {}
+        for node in walk_excluding_nested(f):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and isinstance(node.value.func.value, ast.Name)
+                and node.value.func.value.id == "jnp"
+                and node.value.func.attr in JNP_CTORS
+            ):
+                jnp_names[node.targets[0].id] = node.lineno
+        if not jnp_names:
+            continue
+        for node in walk_excluding_nested(f):
+            if _is_jit_call(node) and node.args and isinstance(
+                node.args[0], ast.Name
+            ):
+                target = local_defs.get(node.args[0].id)
+                if target is None:
+                    continue
+                free = _free_loads(target)
+                captured = sorted(free & set(jnp_names))
+                for name in captured:
+                    yield from emit(
+                        node.lineno,
+                        f"jitted local function {target.name}() closes "
+                        f"over jnp array {name!r} (built at line "
+                        f"{jnp_names[name]}) — the array is baked into "
+                        "the trace as a constant and re-embedded on every "
+                        "rebuild; pass it as an argument instead",
+                    )
+
+
+def _free_loads(fdef) -> Set[str]:
+    """Names loaded in fdef that are neither its params nor stored in it."""
+    bound: Set[str] = {a.arg for a in fdef.args.args}
+    bound.update(a.arg for a in fdef.args.kwonlyargs)
+    if fdef.args.vararg:
+        bound.add(fdef.args.vararg.arg)
+    if fdef.args.kwarg:
+        bound.add(fdef.args.kwarg.arg)
+    loads: Set[str] = set()
+    for node in ast.walk(fdef):
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                loads.add(node.id)
+            else:
+                bound.add(node.id)
+    return loads - bound
+
+
+# --------------------------------------------------------------------- #
+# donation-after-use
+# --------------------------------------------------------------------- #
+
+
+def _donated_positions(jit_call: ast.Call) -> Tuple[int, ...]:
+    for kw in jit_call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                )
+    return ()
+
+
+def _pos(node: ast.AST) -> Tuple[int, int]:
+    return (node.lineno, node.col_offset)
+
+
+def _end_pos(node: ast.AST) -> Tuple[int, int]:
+    return (
+        getattr(node, "end_lineno", node.lineno),
+        getattr(node, "end_col_offset", node.col_offset),
+    )
+
+
+@rule(
+    "donation-after-use", SEVERITY_ERROR,
+    "an argument listed in donate_argnums is read after the jitted call — "
+    "its buffer may be aliased/invalidated on device (fails only on "
+    "hardware, never under the CPU tests)",
+)
+def check_donation(ctx: FileContext):
+    parents = ctx.parents()
+    for f in _all_functions(ctx):
+        # donated jitted callables bound in this scope
+        donors: Dict[str, Tuple[int, ...]] = {}
+        for node in walk_excluding_nested(f):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and _is_jit_call(node.value)
+            ):
+                pos = _donated_positions(node.value)
+                if pos:
+                    donors[node.targets[0].id] = pos
+
+        # calls that donate: tracked (donated expr, call end position)
+        tracked: List[Tuple[str, Tuple[int, int], int]] = []
+        for node in walk_excluding_nested(f):
+            if not isinstance(node, ast.Call):
+                continue
+            positions: Tuple[int, ...] = ()
+            if isinstance(node.func, ast.Name) and node.func.id in donors:
+                positions = donors[node.func.id]
+            elif _is_jit_call(node.func):
+                positions = _donated_positions(node.func)
+            if not positions:
+                continue
+            # rebinding at the call site (x, y = step(x, y, ...)) keeps the
+            # name valid: it now holds the NEW buffer
+            rebound: Set[str] = set()
+            parent = parents.get(node)
+            if isinstance(parent, ast.Assign):
+                for t in parent.targets:
+                    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                    for e in elts:
+                        d = _dotted(e)
+                        if d:
+                            rebound.add(d)
+            for p in positions:
+                if p < len(node.args):
+                    d = _dotted(node.args[p])
+                    if d and d not in rebound:
+                        tracked.append((d, _end_pos(node), node.lineno))
+        if not tracked:
+            continue
+
+        # loads/stores of tracked exprs after each donating call
+        exprs = {t[0] for t in tracked}
+        events: List[Tuple[Tuple[int, int], str, str]] = []
+        for node in walk_excluding_nested(f):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                d = _dotted(node)
+                if d in exprs:
+                    kind = (
+                        "load"
+                        if isinstance(node.ctx, ast.Load) else "store"
+                    )
+                    # an Attribute chain's inner Name is a Load even when
+                    # the chain is stored (params.grad = x); climb to the
+                    # outermost chain and skip only if THAT is a store —
+                    # params.mean() is still a read of the donated buffer
+                    parent = parents.get(node)
+                    if isinstance(parent, ast.Attribute):
+                        top = parent
+                        while isinstance(parents.get(top), ast.Attribute):
+                            top = parents[top]
+                        if not isinstance(top.ctx, ast.Load):
+                            continue
+                    events.append((_pos(node), kind, d))
+        events.sort()
+        for expr, call_end, call_line in tracked:
+            for pos, kind, d in events:
+                if d != expr or pos <= call_end:
+                    continue
+                if kind == "store":
+                    break
+                yield (
+                    pos[0],
+                    f"{expr!r} was donated to the jitted call on line "
+                    f"{call_line} (donate_argnums) and is read afterwards "
+                    "— the buffer may already be aliased in place; rebind "
+                    "the result or copy before donating",
+                )
+                break

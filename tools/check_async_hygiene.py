@@ -1,182 +1,47 @@
 #!/usr/bin/env python3
-"""Static async-hygiene pass over the orchestration layer.
+"""Static async-hygiene pass — now a thin shim over :mod:`tools.arealint`.
 
-Flags the exact bug class behind the fleet-wedging failure this repo's
-fault-tolerance subsystem fixes (docs/fault_tolerance.md):
+The four rules this script introduced (bare ``asyncio.gather``, discarded
+``create_task``, ``shutil.rmtree`` outside the checkpoint commit helper,
+``time.sleep`` inside ``async def``) live in the arealint framework as
+first-class rules (``tools/arealint/rules_async.py``); this entry point is
+kept so existing invocations and ``tests/test_async_hygiene.py`` keep
+working unchanged::
 
-1. **Bare ``asyncio.gather(...)``** without ``return_exceptions`` — one dead
-   peer throws, the whole fan-out aborts, and every sibling result is lost
-   (the old ``flush_and_update_weights`` hot-loop).
-2. **Discarded ``create_task``/``ensure_future``** — a task spawned as a
-   bare expression statement is never awaited *and* unreferenced: the event
-   loop may garbage-collect it mid-flight and its exceptions vanish.
-3. **``shutil.rmtree`` outside the checkpoint commit helper** — the exact
-   bug behind the destroyed-restore-point failure: deleting a path that can
-   hold a live checkpoint before (or instead of) an atomic commit means a
-   preemption mid-save loses the only recovery state.  All deletion of
-   checkpoint-capable dirs goes through ``areal_tpu/base/recover.py``
-   (``prepare_staging`` / ``commit_checkpoint`` / ``discard_checkpoint``).
-4. **``time.sleep`` inside ``async def``** — blocks the event loop: every
-   heartbeat, probe, and in-flight rollout on that loop stalls for the
-   whole sleep (use ``await asyncio.sleep``).
+    python tools/check_async_hygiene.py [paths...]     # exits 1 on findings
 
-Suppress a deliberate violation with ``# async-hygiene: ok`` on the call's
-first line.  Run from the CLI (exits 1 on findings)::
-
-    python tools/check_async_hygiene.py [paths...]
-
-or from tests via :func:`scan_paths` (tier-1:
-``tests/test_async_hygiene.py`` keeps ``areal_tpu/system/`` and
-``areal_tpu/train/`` clean).
+For the full rule set (JAX host-sync/retrace/donation hazards, env-knob
+and registry hygiene) run ``python -m tools.arealint`` instead — see
+docs/static_analysis.md. Suppress a deliberate violation with
+``# async-hygiene: ok`` (legacy) or ``# arealint: ok(<reason>)`` on the
+call's first line.
 """
 
-import ast
 import pathlib
 import sys
-from typing import List, NamedTuple
 
-SUPPRESS = "# async-hygiene: ok"
+_REPO = str(pathlib.Path(__file__).resolve().parent.parent)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.arealint import (  # noqa: E402
+    Finding,
+    LEGACY_ASYNC_RULES,
+    scan_paths as _scan_paths,
+    scan_source as _scan_source,
+)
+
+__all__ = ["Finding", "scan_source", "scan_paths", "main"]
+
 DEFAULT_PATHS = ["areal_tpu/system", "areal_tpu/train"]
-# The one module where deleting checkpoint-capable dirs is legal: the
-# commit protocol itself.
-RMTREE_ALLOWED_SUFFIXES = ("base/recover.py",)
 
 
-class Finding(NamedTuple):
-    path: str
-    line: int
-    rule: str
-    message: str
-
-    def __str__(self):
-        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+def scan_source(src, path="<string>"):
+    return _scan_source(src, path, rules=LEGACY_ASYNC_RULES)
 
 
-def _is_gather(call: ast.Call) -> bool:
-    """Match ``asyncio.gather(...)`` and bare ``gather(...)`` (from-import),
-    but not e.g. ``SequenceSample.gather`` (a data join)."""
-    f = call.func
-    if isinstance(f, ast.Attribute) and f.attr == "gather":
-        return isinstance(f.value, ast.Name) and f.value.id == "asyncio"
-    return isinstance(f, ast.Name) and f.id == "gather"
-
-
-def _is_spawn(call: ast.Call) -> bool:
-    f = call.func
-    name = f.attr if isinstance(f, ast.Attribute) else (
-        f.id if isinstance(f, ast.Name) else ""
-    )
-    return name in ("create_task", "ensure_future")
-
-
-def _is_rmtree(call: ast.Call) -> bool:
-    """Match ``shutil.rmtree(...)`` and bare ``rmtree(...)`` (from-import)."""
-    f = call.func
-    if isinstance(f, ast.Attribute) and f.attr == "rmtree":
-        return isinstance(f.value, ast.Name) and f.value.id == "shutil"
-    return isinstance(f, ast.Name) and f.id == "rmtree"
-
-
-def _is_time_sleep(call: ast.Call) -> bool:
-    f = call.func
-    return (
-        isinstance(f, ast.Attribute)
-        and f.attr == "sleep"
-        and isinstance(f.value, ast.Name)
-        and f.value.id == "time"
-    )
-
-
-def _is_bare_sleep(call: ast.Call) -> bool:
-    """``sleep(...)`` via from-import — blocking unless awaited (an awaited
-    bare ``sleep`` is asyncio's, imported the same way)."""
-    return isinstance(call.func, ast.Name) and call.func.id == "sleep"
-
-
-def _async_sleep_findings(tree: ast.AST, lines, path: str) -> List["Finding"]:
-    """``time.sleep`` (attribute or from-import form) reachable from an
-    ``async def`` body — nested SYNC defs are excluded (they run where they
-    are called, which may be an executor thread)."""
-    found: List[Finding] = []
-
-    def walk_async_body(node, awaited=False):
-        if isinstance(
-            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-        ):
-            return  # a new (possibly sync) execution context
-        if (
-            isinstance(node, ast.Call)
-            and (
-                _is_time_sleep(node)
-                or (_is_bare_sleep(node) and not awaited)
-            )
-            and not _suppressed(lines, node)
-        ):
-            found.append(Finding(
-                path, node.lineno, "sleep-in-async",
-                "time.sleep inside async def blocks the event loop — "
-                "use await asyncio.sleep",
-            ))
-        for child in ast.iter_child_nodes(node):
-            walk_async_body(child, awaited=isinstance(node, ast.Await))
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.AsyncFunctionDef):
-            for stmt in node.body:
-                walk_async_body(stmt)
-    return found
-
-
-def _suppressed(lines: List[str], node: ast.AST) -> bool:
-    line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
-    return SUPPRESS in line
-
-
-def scan_source(src: str, path: str = "<string>") -> List[Finding]:
-    findings: List[Finding] = []
-    lines = src.splitlines()
-    tree = ast.parse(src, filename=path)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and _is_gather(node):
-            if not any(k.arg == "return_exceptions" for k in node.keywords):
-                if not _suppressed(lines, node):
-                    findings.append(Finding(
-                        path, node.lineno, "bare-gather",
-                        "asyncio.gather without return_exceptions — one "
-                        "failed awaitable aborts the whole fan-out",
-                    ))
-        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call) \
-                and _is_spawn(node.value):
-            if not _suppressed(lines, node):
-                findings.append(Finding(
-                    path, node.lineno, "discarded-task",
-                    "create_task result discarded — task is unreferenced "
-                    "(may be GC'd) and never awaited (exceptions vanish)",
-                ))
-        if isinstance(node, ast.Call) and _is_rmtree(node):
-            allowed = any(
-                path.replace("\\", "/").endswith(sfx)
-                for sfx in RMTREE_ALLOWED_SUFFIXES
-            )
-            if not allowed and not _suppressed(lines, node):
-                findings.append(Finding(
-                    path, node.lineno, "live-checkpoint-rmtree",
-                    "shutil.rmtree outside base/recover's commit helpers — "
-                    "a crash mid-save can destroy the only committed "
-                    "checkpoint; stage + commit via areal_tpu.base.recover",
-                ))
-    findings.extend(_async_sleep_findings(tree, lines, path))
-    return findings
-
-
-def scan_paths(paths) -> List[Finding]:
-    findings: List[Finding] = []
-    for p in paths:
-        p = pathlib.Path(p)
-        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
-        for f in files:
-            findings.extend(scan_source(f.read_text(), str(f)))
-    return findings
+def scan_paths(paths):
+    return _scan_paths(paths, rules=LEGACY_ASYNC_RULES)
 
 
 def main(argv) -> int:
