@@ -6,6 +6,7 @@ wrong answer, append feedback tokens and ask again; reward discounts by turn.
 
 import asyncio
 import dataclasses
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -111,6 +112,12 @@ class MathMultiTurnAgent(Agent):
                         "rewards": np.asarray([reward], np.float32),
                         "version_start": np.asarray(act.version_start, np.int32),
                         "version_end": np.asarray(act.version_end, np.int32),
+                    },
+                    # per-turn lifecycle stamps (docs/observability.md)
+                    metadata={
+                        "submit_time": [act.submit_time],
+                        "first_chunk_time": [act.first_chunk_time],
+                        "reward_time": [time.time()],
                     },
                 )
             )

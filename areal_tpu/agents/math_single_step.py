@@ -82,6 +82,7 @@ class MathSingleStepAgent(Agent):
             return []
         answers = self._decode(act.output_ids)
         _, success, *_ = await env.step((qid, answers))
+        reward_time = time.time()  # lifecycle stamp: reward computed
         rewards = [
             ((float(s) - 0.5) * 2 - self.reward_bias) * self.reward_scaling
             for s in success
@@ -137,6 +138,15 @@ class MathSingleStepAgent(Agent):
                 "version_start": np.asarray(act.version_start, np.int32),
                 "version_end": np.asarray(act.version_end, np.int32),
                 "birth_time": np.asarray([birth_time], np.int64),
+            },
+            # lifecycle stamps ride metadata (host-only; never packed into
+            # the device batch): consumption turns them into queue-wait /
+            # e2e-latency / time-to-first-chunk histograms
+            # (docs/observability.md)
+            metadata={
+                "submit_time": [act.submit_time],
+                "first_chunk_time": [act.first_chunk_time],
+                "reward_time": [reward_time],
             },
         )
         return [sample]

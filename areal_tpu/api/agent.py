@@ -38,6 +38,13 @@ class BundledGenerationOutputs:
     # set when generation failed (outputs are empty placeholders) — agents
     # raise GenerationFailedError so the sample is requeued, not rejected
     error: Optional[str] = None
+    # lifecycle stamps (docs/observability.md): when the group's generation
+    # was submitted to the fleet and when its first chunk came back
+    # (unix seconds; 0.0 = unstamped). Agents thread them into the
+    # trajectory's metadata so consumption can attribute end-to-end
+    # latency and time-to-first-chunk.
+    submit_time: float = 0.0
+    first_chunk_time: float = 0.0
 
     @property
     def seqs(self) -> List[List[int]]:

@@ -133,7 +133,11 @@ def gen_server_main(cfg, server_idx: int):
     )
 
     async def main():
-        from areal_tpu.system.worker_base import ExperimentStatusWatch, Heartbeat
+        from areal_tpu.system.worker_base import (
+            ExperimentStatusWatch,
+            Heartbeat,
+            TelemetryExporter,
+        )
 
         port = network.find_free_port()
         host = "127.0.0.1"
@@ -156,8 +160,18 @@ def gen_server_main(cfg, server_idx: int):
         hb = Heartbeat(
             cfg.experiment_name, cfg.trial_name, f"gen_server/{server_idx}"
         ).start()
+        tele = TelemetryExporter(
+            cfg.experiment_name, cfg.trial_name,
+            f"gen_server/{server_idx}", "gen_server",
+            step_fn=lambda: max(engine.version, 0),
+            gauges_fn=lambda: {
+                "gen_running": float(engine.n_running()),
+                "gen_pending": float(engine.n_pending()),
+            },
+        ).maybe_start()
         while watch.alive():
             await asyncio.sleep(1.0)
+        tele.stop()
         hb.stop()
         await runner.cleanup()
 
@@ -190,7 +204,11 @@ def gserver_manager_main(cfg):
     )
 
     async def main():
-        from areal_tpu.system.worker_base import ExperimentStatusWatch, Heartbeat
+        from areal_tpu.system.worker_base import (
+            ExperimentStatusWatch,
+            Heartbeat,
+            TelemetryExporter,
+        )
 
         manager = GserverManager(mcfg)
         # wait for all advertised gen servers
@@ -203,8 +221,24 @@ def gserver_manager_main(cfg):
         await serve_manager(manager, "127.0.0.1", network.find_free_port())
         watch = ExperimentStatusWatch(cfg.experiment_name, cfg.trial_name)
         hb = Heartbeat(cfg.experiment_name, cfg.trial_name, "gserver_manager").start()
+        tele = TelemetryExporter(
+            cfg.experiment_name, cfg.trial_name,
+            "gserver_manager", "manager",
+            step_fn=lambda: max(manager.version, 0),
+            gauges_fn=lambda: {
+                "rollouts_running": float(manager.rollout_stat.running),
+                "rollouts_submitted": float(manager.rollout_stat.submitted),
+                "rollouts_accepted": float(manager.rollout_stat.accepted),
+            },
+            # per-server breaker states feed the fleet/ servers_* tallies
+            # and the ops CLI's breaker column
+            server_states_fn=lambda: {
+                u: s["state"] for u, s in manager.fleet.snapshot().items()
+            },
+        ).maybe_start()
         while watch.alive():
             await asyncio.sleep(1.0)
+        tele.stop()
         hb.stop()
 
     asyncio.run(main())
@@ -250,15 +284,30 @@ def rollout_worker_main(cfg, worker_idx: int):
         new_tokens_per_chunk=cfg.rollout.new_tokens_per_chunk,
         max_concurrent_tasks=cfg.rollout.max_concurrent_tasks,
     )
-    from areal_tpu.system.worker_base import ExperimentStatusWatch, Heartbeat
+    from areal_tpu.system.worker_base import (
+        ExperimentStatusWatch,
+        Heartbeat,
+        TelemetryExporter,
+    )
 
     watch = ExperimentStatusWatch(cfg.experiment_name, cfg.trial_name)
     hb = Heartbeat(
         cfg.experiment_name, cfg.trial_name, f"rollout_worker/{worker_idx}"
     ).start()
+    tele = TelemetryExporter(
+        cfg.experiment_name, cfg.trial_name,
+        f"rollout_worker/{worker_idx}", "rollout",
+        step_fn=lambda: worker.push_cnt,
+        gauges_fn=lambda: {
+            "rollout_tasks_running": float(worker.n_tasks()),
+            "rollout_requeued": float(worker.requeued_cnt),
+            "rollout_dropped": float(worker.dropped_cnt),
+        },
+    ).maybe_start()
     try:
         asyncio.run(worker.run_async(should_stop=lambda: not watch.alive()))
     finally:
+        tele.stop()
         hb.stop()
 
 
@@ -341,7 +390,18 @@ def trainer_main(cfg):
     if not recovered:
         # publish v0 weights so the fleet starts from the trainer's init
         worker.publish_weights()
-    worker.run(shutdown=shutdown)
+    tele = None
+    if multihost.is_main():
+        tele = worker_base.TelemetryExporter(
+            cfg.experiment_name, cfg.trial_name, "trainer", "trainer",
+            step_fn=lambda: worker.step,
+            gauges_fn=worker.telemetry_gauges,
+        ).maybe_start()
+    try:
+        worker.run(shutdown=shutdown)
+    finally:
+        if tele is not None:
+            tele.stop()
     if worker.preempted:
         sys.exit(worker_base.EXIT_PREEMPTED)
 

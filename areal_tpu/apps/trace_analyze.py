@@ -17,6 +17,7 @@ import sys
 
 from areal_tpu.base.trace_analyzer import (
     BUCKETS,
+    TraceAnalyzerUnavailable,
     analyze_xspace,
     find_xplane_files,
 )
@@ -31,8 +32,12 @@ def _load(path):
         print(f"no .xplane.pb under {path}", file=sys.stderr)
         return None
     summaries = []
-    for f in files:
-        summaries.extend(analyze_xspace(f))
+    try:
+        for f in files:
+            summaries.extend(analyze_xspace(f))
+    except TraceAnalyzerUnavailable as e:
+        print(str(e), file=sys.stderr)
+        return None
     if not summaries:
         print(
             f"{path}: xplane files parsed but no device/op plane found",

@@ -31,6 +31,9 @@ TRAIN_GUARD_ENV = "AREAL_TRAIN_GUARD"         # on-device finite-ness guard (def
 PREEMPT_DEADLINE_ENV = "AREAL_PREEMPT_DEADLINE_S"  # SIGTERM -> ckpt-save budget
 WATCHDOG_TIMEOUT_ENV = "AREAL_WATCHDOG_TIMEOUT_S"  # 0/unset disables the watchdog
 WATCHDOG_ABORT_ENV = "AREAL_WATCHDOG_ABORT"   # dump AND exit so the scheduler restarts
+# Fleet telemetry plane (docs/observability.md): per-worker counter/
+# histogram snapshot export interval.
+TELEMETRY_EXPORT_ENV = "AREAL_TELEMETRY_EXPORT"
 
 
 # --------------------------------------------------------------------- #
@@ -179,6 +182,25 @@ def native_disabled() -> bool:
     """``AREAL_DISABLE_NATIVE``: skip building/loading the C packer
     extension (pure-python fallback)."""
     return env_flag("AREAL_DISABLE_NATIVE", False)
+
+
+DEFAULT_TELEMETRY_INTERVAL_S = 15.0
+
+
+def telemetry_export_interval() -> float:
+    """``AREAL_TELEMETRY_EXPORT`` (default off): per-worker telemetry
+    snapshot export period in seconds. Unset/"0"/"false"/"off" disables
+    the exporter entirely (zero overhead); "true"/"on" enables it at the
+    default 15 s; a number sets the period explicitly."""
+    raw = env_str(TELEMETRY_EXPORT_ENV)
+    if raw is None or raw.strip().lower() in _OFF_STRINGS:
+        return 0.0
+    if raw.strip().lower() in ("true", "on", "1"):
+        # "1" means "enabled", not a 1-second firehose: sub-default
+        # periods must be asked for explicitly (e.g. "0.5")
+        return DEFAULT_TELEMETRY_INTERVAL_S
+    val = env_float(TELEMETRY_EXPORT_ENV, DEFAULT_TELEMETRY_INTERVAL_S)
+    return max(val, 0.0)
 
 
 def watchdog_abort_enabled() -> bool:
@@ -333,6 +355,7 @@ def get_env_vars(**extra) -> dict:
         PREEMPT_DEADLINE_ENV,
         WATCHDOG_TIMEOUT_ENV,
         WATCHDOG_ABORT_ENV,
+        TELEMETRY_EXPORT_ENV,
         "JAX_PLATFORMS",
         "XLA_FLAGS",
         "TPU_VISIBLE_DEVICES",

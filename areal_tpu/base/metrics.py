@@ -13,15 +13,163 @@ monotonic host counters the bench/tests read to PROVE overlap happened
 time alone.
 """
 
+import bisect
 import json
 import os
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from areal_tpu.base import logging
 
 logger = logging.getLogger("metrics")
+
+
+# --------------------------------------------------------------------- #
+# Metric kinds. Every registered key has exactly one kind, declared in
+# the METRIC_KINDS catalog below (unknown keys default to ``sum``); the
+# per-interval ``delta()`` view and the fleet aggregator merge by kind
+# (sum: subtract/add, peak: report/max, histogram: bucket-wise merge)
+# instead of guessing from name suffixes.
+# --------------------------------------------------------------------- #
+
+KIND_SUM = "sum"
+KIND_PEAK = "peak"
+KIND_HISTOGRAM = "histogram"
+
+
+def _log_spaced(lo: float, hi: float, per_decade: int) -> List[float]:
+    import math
+
+    k0 = round(math.log10(lo) * per_decade)
+    k1 = round(math.log10(hi) * per_decade)
+    return [round(10 ** (k / per_decade), 10) for k in range(k0, k1 + 1)]
+
+
+# Default bucket edges for duration-like histograms: 100 µs … 10 000 s,
+# 4 buckets per decade (±~33% relative resolution — enough to tell p50
+# from p99 of any latency this system produces, small enough to ship in
+# every exporter snapshot).
+DEFAULT_HISTOGRAM_BOUNDARIES: List[float] = _log_spaced(1e-4, 1e4, 4)
+
+# Integer-centered edges for version-lag histograms: staleness is a small
+# integer and log buckets would smear 0/1/2 (the values the paper's
+# bounded-staleness story is about) into one bucket.
+VERSION_LAG_BOUNDARIES: List[float] = [
+    0.5, 1.5, 2.5, 3.5, 4.5, 6.5, 8.5, 12.5, 16.5, 24.5, 32.5, 48.5,
+    64.5, 96.5, 128.5,
+]
+
+
+class Histogram:
+    """Fixed-boundary histogram: mergeable across processes, cheap to
+    observe (one bisect + three adds), summarizable to count/sum/mean and
+    interpolated percentiles. NOT thread-safe on its own — the owning
+    :class:`CounterRegistry` serializes access under its lock.
+
+    ``counts`` has ``len(boundaries) + 1`` entries; entry ``i`` counts
+    values ``<= boundaries[i]`` (and greater than the previous edge), the
+    last entry is the overflow bucket.
+    """
+
+    __slots__ = ("boundaries", "counts", "sum", "count", "min", "max")
+
+    def __init__(self, boundaries: Optional[List[float]] = None):
+        self.boundaries = list(
+            boundaries if boundaries is not None
+            else DEFAULT_HISTOGRAM_BOUNDARIES
+        )
+        assert self.boundaries == sorted(self.boundaries), "edges must ascend"
+        self.counts = [0] * (len(self.boundaries) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.counts[bisect.bisect_left(self.boundaries, v)] += 1
+        self.sum += v
+        self.count += 1
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    def merge(self, other: "Histogram") -> None:
+        if other.boundaries != self.boundaries:
+            raise ValueError(
+                "cannot merge histograms with different boundaries "
+                f"({len(self.boundaries)} vs {len(other.boundaries)} edges)"
+            )
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.sum += other.sum
+        self.count += other.count
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+
+    def percentile(self, q: float) -> float:
+        """Interpolated q-th percentile (q in [0, 100]); 0.0 when empty.
+        Bucket-local linear interpolation, clamped to the observed
+        min/max so all-identical observations report exactly that value."""
+        if self.count == 0:
+            return 0.0
+        rank = q / 100.0 * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                lo = self.boundaries[i - 1] if i > 0 else 0.0
+                hi = (
+                    self.boundaries[i]
+                    if i < len(self.boundaries)
+                    else self.max
+                )
+                frac = (rank - seen) / c
+                est = lo + (hi - lo) * max(0.0, min(frac, 1.0))
+                return max(self.min, min(est, self.max))
+            seen += c
+        return self.max
+
+    def summary(self) -> Dict[str, float]:
+        if self.count == 0:
+            return {"count": 0.0}
+        return {
+            "count": float(self.count),
+            "sum": self.sum,
+            "mean": self.sum / self.count,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+        }
+
+    def state(self) -> dict:
+        """JSON-serializable full state (for the telemetry exporter)."""
+        return {
+            "boundaries": self.boundaries,
+            "counts": list(self.counts),
+            "sum": self.sum,
+            "count": self.count,
+            "min": self.min if self.count else None,
+            "max": self.max if self.count else None,
+        }
+
+    @classmethod
+    def from_state(cls, d: dict) -> "Histogram":
+        h = cls(boundaries=d["boundaries"])
+        h.counts = [int(c) for c in d["counts"]]
+        h.sum = float(d["sum"])
+        h.count = int(d["count"])
+        h.min = float("inf") if d.get("min") is None else float(d["min"])
+        h.max = float("-inf") if d.get("max") is None else float(d["max"])
+        return h
+
+    def copy(self) -> "Histogram":
+        return Histogram.from_state(self.state())
 
 
 class CounterRegistry:
@@ -29,13 +177,32 @@ class CounterRegistry:
 
     Thread-safe (the train prefetcher packs on a background thread).
     ``add`` accumulates, ``peak`` keeps a running maximum (pipeline depth),
-    ``snapshot``/``delta`` give dict views the trainer folds into its
-    per-step stats under ``pipe/``.
+    ``observe`` records into a fixed-boundary histogram, ``snapshot``/
+    ``delta`` give scalar dict views the trainer folds into its per-step
+    stats under ``pipe/``, and ``export_state`` serializes everything for
+    the per-worker telemetry exporter.
+
+    Metric kinds come from the module-level METRIC_KINDS catalog (plus
+    ``register_kind`` for dynamic names); unknown keys default to ``sum``.
     """
 
-    def __init__(self):
+    def __init__(self, kinds: Optional[Dict[str, str]] = None):
         self._lock = threading.Lock()
         self._vals: Dict[str, float] = {}
+        self._hists: Dict[str, Histogram] = {}
+        # per-registry overrides; the catalog below is the shared default
+        self._kinds: Dict[str, str] = dict(kinds or {})
+
+    def kind(self, name: str) -> str:
+        k = self._kinds.get(name)
+        if k is None:
+            k = METRIC_KINDS.get(name, KIND_SUM)
+        return k
+
+    def register_kind(self, name: str, kind: str) -> None:
+        assert kind in (KIND_SUM, KIND_PEAK, KIND_HISTOGRAM), kind
+        with self._lock:
+            self._kinds[name] = kind
 
     def add(self, name: str, value: float = 1.0) -> None:
         with self._lock:
@@ -43,12 +210,30 @@ class CounterRegistry:
 
     def peak(self, name: str, value: float) -> None:
         with self._lock:
+            self._kinds.setdefault(name, KIND_PEAK)
             if float(value) > self._vals.get(name, float("-inf")):
                 self._vals[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one observation into the histogram ``name`` (created on
+        first use with the catalog's boundaries for that key)."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = Histogram(HISTOGRAM_BOUNDARIES.get(name))
+                self._hists[name] = h
+                self._kinds.setdefault(name, KIND_HISTOGRAM)
+            h.observe(value)
 
     def get(self, name: str, default: float = 0.0) -> float:
         with self._lock:
             return self._vals.get(name, default)
+
+    def histogram(self, name: str) -> Optional[Histogram]:
+        """Copy of one histogram (None when nothing was observed)."""
+        with self._lock:
+            h = self._hists.get(name)
+            return h.copy() if h is not None else None
 
     def clear(self, name: str) -> None:
         """Drop one counter. Peaks (``peak``) are process-lifetime maxima —
@@ -57,23 +242,47 @@ class CounterRegistry:
         for a maximum."""
         with self._lock:
             self._vals.pop(name, None)
+            self._hists.pop(name, None)
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             return dict(self._vals)
 
     def delta(self, before: Dict[str, float]) -> Dict[str, float]:
-        """Per-interval view: current snapshot minus ``before`` (peaks are
-        reported as-is — a maximum has no meaningful difference)."""
+        """Per-interval scalar view: current snapshot minus ``before`` for
+        sum-kind keys; peak-kind keys report as-is (a maximum has no
+        meaningful difference). Histograms are not part of the scalar
+        delta — read them via ``histogram``/``histogram_summaries``."""
         now = self.snapshot()
         return {
-            k: (v if k.endswith("max_in_flight") else v - before.get(k, 0.0))
+            k: (v if self.kind(k) == KIND_PEAK else v - before.get(k, 0.0))
             for k, v in now.items()
+        }
+
+    def histogram_summaries(self) -> Dict[str, Dict[str, float]]:
+        """``{name: {count, sum, mean, min, max, p50, p95, p99}}`` for every
+        histogram with at least one observation."""
+        with self._lock:
+            hists = {k: h.copy() for k, h in self._hists.items() if h.count}
+        return {k: h.summary() for k, h in hists.items()}
+
+    def export_state(self) -> dict:
+        """Full serializable state: scalar counters with their kinds plus
+        histogram bucket states — the payload the per-worker telemetry
+        exporter publishes and the fleet aggregator merges."""
+        with self._lock:
+            vals = dict(self._vals)
+            hists = {k: h.state() for k, h in self._hists.items()}
+        return {
+            "counters": vals,
+            "kinds": {k: self.kind(k) for k in vals},
+            "histograms": hists,
         }
 
     def reset(self) -> None:
         with self._lock:
             self._vals.clear()
+            self._hists.clear()
 
 
 # The process-global registry (≈ the reference's monotonic perf counters in
@@ -143,11 +352,62 @@ GUARD_CKPT_FALLBACKS = "guard/ckpt_fallbacks"      # committed sibling promoted 
 GUARD_WATCHDOG_DUMPS = "guard/watchdog_dumps"      # hang watchdog dumped thread stacks
 
 
+# --------------------------------------------------------------------- #
+# Trajectory lifecycle histograms (docs/observability.md): every accepted
+# rollout is stamped submit → first-chunk → reward → enqueue on its way
+# through partial_rollout → push_pull_stream → buffer, and consumption
+# (buffer.record_batch_consumption at the trainer's multihost commit
+# point) turns the stamps into distributions — the
+# paper's staleness/latency story as measured percentiles, not averages.
+# --------------------------------------------------------------------- #
+
+STALENESS_VERSIONS = "staleness_versions"  # trainer version - version_start at consumption
+QUEUE_WAIT_S = "queue_wait_s"              # rollout enqueue -> trainer consumption
+E2E_LATENCY_S = "e2e_latency_s"            # generation submit -> trainer consumption
+TTFC_S = "ttfc_s"                          # generation submit -> first chunk back
+REWARD_LAG_S = "reward_lag_s"              # generation submit -> reward computed
+
+
+# --------------------------------------------------------------------- #
+# Per-role activity counters: the always-on heartbeat numbers each worker
+# publishes through the telemetry exporter, so a fleet/ record proves
+# every role did work (failure counters stay zero in a healthy run).
+# --------------------------------------------------------------------- #
+
+ROLLOUT_PUSHED = "rollout/pushed"          # trajectories pushed to the trainer
+ROLLOUT_ACCEPTED = "rollout/accepted"      # rollouts finished accepted
+GEN_SERVED = "gen/served"                  # generate requests completed
+GEN_TOKENS = "gen/tokens"                  # tokens generated
+MANAGER_SCHEDULED = "manager/schedule_requests"
+MANAGER_ALLOCATED = "manager/allocated"    # rollouts admitted by the gate
+TRAIN_STEPS = "train/steps"                # optimizer steps taken
+
+
+# Per-key metric kinds; unknown keys default to KIND_SUM. The arealint
+# ``unregistered-counter`` rule keys off the UPPERCASE constants above;
+# this map adds the KIND so delta()/the fleet aggregator merge correctly.
+METRIC_KINDS: Dict[str, str] = {
+    PIPE_FWD_MAX_IN_FLIGHT: KIND_PEAK,
+    STALENESS_VERSIONS: KIND_HISTOGRAM,
+    QUEUE_WAIT_S: KIND_HISTOGRAM,
+    E2E_LATENCY_S: KIND_HISTOGRAM,
+    TTFC_S: KIND_HISTOGRAM,
+    REWARD_LAG_S: KIND_HISTOGRAM,
+}
+
+# Non-default bucket edges per histogram key (default: the log-spaced
+# duration edges).
+HISTOGRAM_BOUNDARIES: Dict[str, List[float]] = {
+    STALENESS_VERSIONS: VERSION_LAG_BOUNDARIES,
+}
+
+
 class MetricLogger:
     def __init__(self, logdir: str, backends: tuple = ("jsonl", "tensorboard")):
         os.makedirs(logdir, exist_ok=True)
         self._jsonl = None
         self._tb = None
+        self._tb_failed_keys: set = set()
         if "jsonl" in backends:
             self._jsonl = open(os.path.join(logdir, "metrics.jsonl"), "a")
         if "tensorboard" in backends:
@@ -188,10 +448,24 @@ class MetricLogger:
                 try:
                     self._tb.add_scalar(k, v, step, walltime=wall_time)
                 except Exception:
-                    pass
+                    # a non-scalar (or a broken writer) must not spam once
+                    # per step, but the FIRST failure per key is logged —
+                    # silently pass-ing every exception hid whole metric
+                    # families from tensorboard without a trace
+                    if k not in self._tb_failed_keys:
+                        self._tb_failed_keys.add(k)
+                        logger.warning(
+                            "tensorboard add_scalar(%r) failed; further "
+                            "failures for this key are suppressed",
+                            k, exc_info=True,
+                        )
 
     def close(self):
+        """Idempotent: a trainer's exit path may close through both its
+        own finally and the caller's teardown."""
         if self._jsonl:
             self._jsonl.close()
+            self._jsonl = None
         if self._tb:
             self._tb.close()
+            self._tb = None

@@ -496,6 +496,14 @@ def default_repository() -> NameRecordRepository:
     return _DEFAULT
 
 
+def set_repository(repo: NameRecordRepository):
+    """Install an already-built repository as the module default — the
+    save/restore counterpart of :func:`reconfigure` for benches and tests
+    that temporarily swap backends."""
+    global _DEFAULT
+    _DEFAULT = repo
+
+
 # Module-level convenience API mirroring the reference usage style
 # (``name_resolve.add(...)`` etc).
 def add(*args, **kwargs):

@@ -65,3 +65,12 @@ def metric_server(experiment_name, trial_name, name) -> str:
 
 def training_samples(experiment_name, trial_name) -> str:
     return f"{trial_root(experiment_name, trial_name)}/training_samples"
+
+
+def telemetry(experiment_name, trial_name, worker_name) -> str:
+    """Per-worker telemetry snapshot (JSON) published by the exporter."""
+    return f"{trial_root(experiment_name, trial_name)}/telemetry/{worker_name}"
+
+
+def telemetry_root(experiment_name, trial_name) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/telemetry"

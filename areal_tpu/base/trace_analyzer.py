@@ -27,6 +27,34 @@ import glob
 import os
 from typing import Dict, List, Optional, Tuple
 
+
+class TraceAnalyzerUnavailable(RuntimeError):
+    """The installed jax/jaxlib does not bundle the ``ProfileData`` XSpace
+    reader (``jax.profiler.ProfileData`` appeared in jaxlib 0.4.x and has
+    moved between releases). Callers that can degrade (bench sections, the
+    CLI, pytest) catch/skip on this instead of crashing on AttributeError
+    deep inside an analysis pass."""
+
+
+def _profile_data():
+    """The ``ProfileData`` class, or raise :class:`TraceAnalyzerUnavailable`."""
+    try:
+        import jax.profiler as jp
+
+        return jp.ProfileData
+    except (ImportError, AttributeError) as e:
+        raise TraceAnalyzerUnavailable(
+            f"jax.profiler.ProfileData unavailable in this jax build: {e!r}"
+        ) from e
+
+
+def profile_data_available() -> bool:
+    try:
+        _profile_data()
+        return True
+    except TraceAnalyzerUnavailable:
+        return False
+
 # bucket keys mirror monitor.py's CUDAKernelTimeCategory values
 COMPUTE, P2P, COLL, MEM, IDLE, MISC = (
     "compute", "p2p_comm", "coll_comm", "memoryIO", "idle", "misc"
@@ -129,10 +157,10 @@ def _op_lines(plane):
 
 def analyze_xspace(path: str) -> List[TraceSummary]:
     """One summary per device plane in the XSpace file (CPU traces: the
-    PJRT client plane stands in for the device)."""
-    import jax.profiler as jp
-
-    return analyze_profile_data(jp.ProfileData.from_file(path))
+    PJRT client plane stands in for the device). Raises
+    :class:`TraceAnalyzerUnavailable` when this jax build has no
+    ProfileData reader."""
+    return analyze_profile_data(_profile_data().from_file(path))
 
 
 def analyze_profile_data(pd) -> List[TraceSummary]:
@@ -210,13 +238,18 @@ def find_xplane_files(root: str) -> List[str]:
 
 
 def summarize_latest(root: str) -> Optional[dict]:
-    """Analyze the newest trace under ``root``; None when there is none."""
+    """Analyze the newest trace under ``root``; None when there is none
+    (or when this jax build cannot read xplane files — a bench section's
+    trace breakdown degrades to absent, it must not fail the run)."""
     files = find_xplane_files(root)
     if not files:
         return None
     summaries = []
-    for f in files:
-        summaries.extend(s.as_dict() for s in analyze_xspace(f))
+    try:
+        for f in files:
+            summaries.extend(s.as_dict() for s in analyze_xspace(f))
+    except TraceAnalyzerUnavailable:
+        return None
     if not summaries:
         return None
     return {"files": files, "planes": summaries}

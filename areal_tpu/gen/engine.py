@@ -297,6 +297,10 @@ class GenerationEngine:
     def n_running(self) -> int:
         return sum(s is not None for s in self._slots)
 
+    def n_pending(self) -> int:
+        with self._pending_lock:
+            return len(self._pending)
+
     def n_compiles(self) -> int:
         """Total jitted specializations (stability tested: bounded by the
         admit buckets + decode chunk sizes, NOT by prompt lengths)."""

@@ -27,6 +27,7 @@ from typing import Dict, Optional
 from aiohttp import web
 
 from areal_tpu.base import constants, hbm
+from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.gen.engine import GenerationEngine, GenOutput, GenRequest
 
 logger = logging.getLogger("areal_tpu.gen.server")
@@ -177,6 +178,10 @@ class GenerationHTTPServer:
             self._futures.pop(req.rid, None)
             return web.json_response({"error": str(e)}, status=400)
         out: GenOutput = await fut
+        # telemetry-plane activity counters (exported per worker; the
+        # /metrics_json gauges below remain the pull-path view)
+        metrics_mod.counters.add(metrics_mod.GEN_SERVED)
+        metrics_mod.counters.add(metrics_mod.GEN_TOKENS, len(out.output_ids))
         return web.json_response(
             {
                 "rid": out.rid,

@@ -17,13 +17,80 @@ at scale:
 """
 
 import logging
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
 
 from areal_tpu.api.data import SequenceSample
+from areal_tpu.base import metrics as metrics_mod
 
 logger = logging.getLogger("areal_tpu.buffer")
+
+
+def _meta_time(sample: SequenceSample, key: str) -> Optional[float]:
+    """Earliest positive lifecycle stamp under ``metadata[key]`` (samples
+    gathered from several groups carry one stamp per item), or None when
+    unstamped (sync data, tests)."""
+    vals = (sample.metadata or {}).get(key)
+    if not vals:
+        return None
+    try:
+        ts = [float(v) for v in vals if v and float(v) > 0]
+    except (TypeError, ValueError):
+        return None
+    return min(ts) if ts else None
+
+
+def record_batch_consumption(
+    samples: List[SequenceSample], current_version: int
+) -> None:
+    """Fold a committed batch's lifecycle stamps into the process-global
+    histograms. Consumption is THE measurement point of the
+    staleness/latency story — what the optimizer actually trains on, as
+    distributions — so the trainer calls this only past its multihost
+    commit point (every host keeps its batch): ``pop_batch`` itself must
+    not record, because a popped batch is re-put when a sibling host's
+    queue was starved or over-stale, and recording there would count the
+    same trajectories twice."""
+    for s in samples:
+        record_consumption(s, current_version)
+
+
+def record_consumption(sample: SequenceSample, current_version: int) -> None:
+    """Fold one consumed sample's lifecycle stamps into the process-global
+    histograms (docs/observability.md): staleness in versions, queue wait
+    (rollout enqueue -> here), end-to-end latency (generation submit ->
+    here), time-to-first-chunk, and submit -> reward lag. Stamps are unix
+    seconds from the rollout worker's clock — same-host in the local
+    launcher; cross-host skew is NTP-bounded and dwarfed by the
+    seconds-scale latencies being measured."""
+    now = time.time()
+    v = sample_version_start(sample)
+    if v is not None:
+        metrics_mod.counters.observe(
+            metrics_mod.STALENESS_VERSIONS, max(current_version - v, 0)
+        )
+    submit = _meta_time(sample, "submit_time")
+    enqueue = _meta_time(sample, "enqueue_time")
+    first_chunk = _meta_time(sample, "first_chunk_time")
+    reward = _meta_time(sample, "reward_time")
+    if enqueue is not None:
+        metrics_mod.counters.observe(
+            metrics_mod.QUEUE_WAIT_S, max(now - enqueue, 0.0)
+        )
+    if submit is not None:
+        metrics_mod.counters.observe(
+            metrics_mod.E2E_LATENCY_S, max(now - submit, 0.0)
+        )
+        if first_chunk is not None:
+            metrics_mod.counters.observe(
+                metrics_mod.TTFC_S, max(first_chunk - submit, 0.0)
+            )
+        if reward is not None:
+            metrics_mod.counters.observe(
+                metrics_mod.REWARD_LAG_S, max(reward - submit, 0.0)
+            )
 
 
 def sample_version_start(sample: SequenceSample) -> Optional[int]:

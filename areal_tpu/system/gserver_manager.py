@@ -441,6 +441,7 @@ class GserverManager:
     async def _schedule_request(self, request: web.Request) -> web.Response:
         meta = await request.json()
         async with self._lock:
+            metrics_mod.counters.add(metrics_mod.MANAGER_SCHEDULED)
             prev_url = meta.get("previous_server_url")
             if (
                 prev_url
@@ -477,6 +478,7 @@ class GserverManager:
                 self.rollout_stat.submitted += 1
                 self.rollout_stat.running += 1
                 self._active_rollouts.add(str(d.get("qid")))
+                metrics_mod.counters.add(metrics_mod.MANAGER_ALLOCATED)
                 return web.json_response({"success": True, "reason": ""})
             reason = []
             if not has_capacity:
@@ -543,6 +545,21 @@ class GserverManager:
     async def _health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
 
+    def fleet_telemetry(self) -> Optional[dict]:
+        """Aggregate of every published worker telemetry snapshot (the
+        manager is the fleet's second consumer besides the trainer: an
+        operator scraping /metrics_json sees the same merged view without
+        reaching into the trainer's jsonl). None when the telemetry plane
+        is disabled or nothing has published yet."""
+        from areal_tpu.base import constants
+        from areal_tpu.system import telemetry
+
+        if constants.telemetry_export_interval() <= 0:
+            return None
+        return telemetry.collect_fleet_scalars(
+            self.config.experiment_name, self.config.trial_name
+        )
+
     async def _metrics(self, request: web.Request) -> web.Response:
         return web.json_response(
             {
@@ -554,6 +571,12 @@ class GserverManager:
                 "healthy_servers": self.fleet.healthy_urls(),
                 "fleet": self.fleet.snapshot(),
                 "request_counts": dict(self._request_counts),
+                # off-loop: collect_fleet_scalars sweeps the name_resolve
+                # backend (an os.walk + file reads when file-backed), which
+                # must not stall the loop serving /schedule_request
+                "fleet_telemetry": await asyncio.to_thread(
+                    self.fleet_telemetry
+                ),
             }
         )
 

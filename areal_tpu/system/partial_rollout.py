@@ -11,6 +11,7 @@ for staleness accounting; the n samples of one qid are grouped into one
 import asyncio
 import dataclasses
 import logging
+import time
 import uuid
 from typing import Dict, List, Optional
 
@@ -102,6 +103,7 @@ class PartialRolloutManager:
         prev_version = None
         no_eos = True
         server_failures = 0
+        first_chunk_time = 0.0  # lifecycle stamp: first chunk back
         while len(acc_out) < gconfig.max_new_tokens:
             url, version = await self._schedule(
                 session, qid, len(prompt_ids), gconfig.n,
@@ -158,6 +160,8 @@ class PartialRolloutManager:
                 continue
             acc_out.extend(res.output_ids)
             acc_lp.extend(res.output_logprobs)
+            if not first_chunk_time:
+                first_chunk_time = time.time()
             if version_start < 0:
                 version_start = res.version
             version_end = res.version
@@ -170,7 +174,10 @@ class PartialRolloutManager:
                 break
             # "length" (chunk exhausted) or "interrupted": re-schedule with
             # the accumulated tokens
-        return acc_out, acc_lp, no_eos, version_start, version_end
+        return (
+            acc_out, acc_lp, no_eos, version_start, version_end,
+            first_chunk_time,
+        )
 
     async def _handle_group(
         self, qid: str, prompt_ids: List[int], gconfig: GenerationHyperparameters
@@ -179,6 +186,7 @@ class PartialRolloutManager:
         # would strand a manager capacity slot forever (finish_rollout never
         # fires) and eventually deadlock the staleness gate.
         error = None
+        submit_time = time.time()  # lifecycle stamp: group submitted
         try:
             async with GenAPIClient(timeout=self.timeout) as client:
                 async with aiohttp.ClientSession(
@@ -200,9 +208,12 @@ class PartialRolloutManager:
         except Exception as e:
             logger.exception("generation for qid %s failed", qid)
             error = repr(e)
-            results = [([], [], True, -1, -1) for _ in range(gconfig.n)]
+            results = [([], [], True, -1, -1, 0.0) for _ in range(gconfig.n)]
         finally:
             self._tasks.pop(qid, None)
+        # the group's first-chunk time is the earliest member's (0.0 when
+        # no chunk ever came back)
+        chunk_times = [r[5] for r in results if r[5]]
         bundle = BundledGenerationOutputs(
             qid=qid,
             prompt_ids=list(prompt_ids),
@@ -212,6 +223,8 @@ class PartialRolloutManager:
             version_start=[r[3] for r in results],
             version_end=[r[4] for r in results],
             error=error,
+            submit_time=submit_time,
+            first_chunk_time=min(chunk_times) if chunk_times else 0.0,
         )
         await self.reply_queue.put(bundle)
 

@@ -9,6 +9,7 @@ completion. Structure ported intact — this layer is device-agnostic.
 
 import asyncio
 import logging
+import time
 from collections import deque
 from typing import Deque, Dict, List, Optional
 
@@ -138,10 +139,15 @@ class RolloutWorker:
                     self._handle_rollout_failure(qid, prompt, e)
                     trajs, accepted = [], False
             for t in trajs:
+                # lifecycle stamp: entering the rollout -> trainer stream;
+                # consumption turns (pop - enqueue) into queue_wait_s
+                t.metadata["enqueue_time"] = [time.time()] * len(t.ids)
                 if self.pusher.push(t.as_json_compatible()):
                     self.push_cnt += 1
+                    metrics_mod.counters.add(metrics_mod.ROLLOUT_PUSHED)
             if accepted:
                 self.accepted_cnt += 1
+                metrics_mod.counters.add(metrics_mod.ROLLOUT_ACCEPTED)
             try:
                 # release the manager's capacity slot (and the sticky qid →
                 # server mapping) in every outcome; a requeued sample
@@ -259,6 +265,12 @@ class RolloutWorker:
                     await self.prm.run_step()
         finally:
             dispatch.cancel()
+
+    def n_tasks(self) -> int:
+        """Live rollout task count — the telemetry gauge accessor. Safe to
+        read from the exporter thread: one ``len()`` of a dict mutated only
+        on the event loop (a momentarily stale value is fine for a gauge)."""
+        return len(self._tasks)
 
     async def drain(self, timeout: float = 300.0):
         """Wait for all in-flight rollout tasks to finish; tasks that miss

@@ -30,7 +30,7 @@ import numpy as np
 from areal_tpu.api.data import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model import PPOHyperparameters, make_interface
 from areal_tpu.experiments import graphs
-from areal_tpu.system.buffer import SequenceBuffer
+from areal_tpu.system.buffer import SequenceBuffer, record_batch_consumption
 from areal_tpu.system.function_executor import FunctionExecutor
 from areal_tpu.base import constants, hbm, name_resolve, names, recover, tracing
 from areal_tpu.base import metrics as metrics_mod
@@ -278,6 +278,9 @@ class AsyncPPOTrainerWorker:
                 self._buffer.put(s, current_version=self.actor_engine.version)
             if multihost.allreduce_max(np.int64(time.time() - t0 > timeout)):
                 return None  # agreed timeout: all hosts give up together
+        # consumption histograms only past the commit point — batches
+        # re-put above (starved/over-stale sibling) must not double-count
+        record_batch_consumption(batch, self.actor_engine.version)
         # only the keys the train MFCs consume — agent extras like
         # packed_prompts/birth_time stay out of the device batch
         # (≈ MFC input_keys, realhf/api/core/dfg.py:56)
@@ -333,6 +336,7 @@ class AsyncPPOTrainerWorker:
             int(getattr(self, "_last_batch_groups", 0))
         )
         self.step += 1
+        metrics_mod.counters.add(metrics_mod.TRAIN_STEPS)
 
         if self.step % self.control.weight_sync_freq_steps == 0:
             self.publish_weights()
@@ -411,6 +415,58 @@ class AsyncPPOTrainerWorker:
         k = self.control.guard_rollback_steps
         if k and self._consec_anomalies >= k:
             self._rollback_to_committed()
+        # fleet telemetry rides the same once-per-logging-interval cadence:
+        # one name_resolve sweep + merge, folded into the jsonl/tb sinks
+        if pending:
+            self._maybe_log_fleet(pending[-1][0], pending[-1][1])
+
+    def telemetry_gauges(self) -> Dict[str, float]:
+        """Instantaneous trainer gauges for the telemetry plane: intake
+        queue depths plus the HBM gauges (kill checks stay in run_step —
+        a telemetry read must never kill the worker)."""
+        g: Dict[str, float] = {
+            "buffer_depth": float(len(self._buffer)),
+            "buffer_dropped_stale": float(self._buffer.n_dropped_stale),
+            "buffer_dropped_capacity": float(self._buffer.n_dropped_capacity),
+            "samples_consumed": float(self.samples_consumed),
+        }
+        if hasattr(self.stream, "qsize"):
+            try:
+                g["stream_qsize"] = float(self.stream.qsize())
+            except Exception:
+                pass
+        try:
+            g.update({k: float(v) for k, v in self._hbm.check(kill=False).items()})
+        except Exception:
+            pass
+        return g
+
+    def _maybe_log_fleet(self, step: int, wall: float):
+        """Pull every worker's published telemetry snapshot, merge by
+        metric kind, and fold the ``fleet/`` namespace into the metric
+        sinks. The trainer substitutes its LIVE registry for its own
+        published snapshot so this interval's consumption histograms land
+        in the same record. No-op (zero cost) when the telemetry knob is
+        off or this is not the main host."""
+        if self.metrics is None or not multihost.is_main():
+            return
+        if constants.telemetry_export_interval() <= 0:
+            return
+        from areal_tpu.system import telemetry
+
+        local = telemetry.build_snapshot(
+            "trainer", "trainer", step=self.step,
+            gauges=self.telemetry_gauges(),
+        )
+        try:
+            scalars = telemetry.collect_fleet_scalars(
+                self.experiment_name, self.trial_name, local_snapshot=local
+            )
+        except Exception:
+            logger.warning("fleet telemetry aggregation failed", exc_info=True)
+            return
+        if scalars:
+            self.metrics.log(scalars, step, prefix="fleet", wall_time=wall)
 
     def _rollback_to_committed(self) -> bool:
         """K consecutive anomalous steps: the live params/opt state are

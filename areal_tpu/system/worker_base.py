@@ -179,6 +179,127 @@ def last_heartbeat(
 
 
 # --------------------------------------------------------------------- #
+# Telemetry plane (docs/observability.md)
+# --------------------------------------------------------------------- #
+
+
+class TelemetryExporter:
+    """Background thread publishing this worker's full telemetry snapshot
+    (counters + histograms + open spans + role gauges) through name_resolve
+    next to the heartbeat, every ``interval`` seconds.
+
+    Gated by ``AREAL_TELEMETRY_EXPORT`` (``constants.
+    telemetry_export_interval``): when the knob is off (the default),
+    :meth:`maybe_start` is a no-op — no thread, no snapshot building, zero
+    overhead. ``stop()`` publishes one final snapshot so the last state of
+    a cleanly-exiting worker is visible to the aggregator/ops CLI.
+
+    ``step_fn`` reports the worker's notion of progress (train step,
+    pushed count, ...); ``gauges_fn`` returns instantaneous role gauges
+    (queue depth, running rollouts, HBM bytes); ``server_states_fn``
+    (manager only) returns per-gen-server breaker states. All three are
+    called on the exporter thread and must be cheap and exception-safe —
+    a failing callback degrades to a snapshot without that section.
+    """
+
+    def __init__(
+        self,
+        experiment_name: str,
+        trial_name: str,
+        worker_name: str,
+        role: str,
+        interval: Optional[float] = None,
+        step_fn: Optional[Callable[[], int]] = None,
+        gauges_fn: Optional[Callable[[], dict]] = None,
+        server_states_fn: Optional[Callable[[], dict]] = None,
+        registry=None,
+    ):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self.worker_name = worker_name
+        self.role = role
+        self.interval = (
+            interval
+            if interval is not None
+            else constants.telemetry_export_interval()
+        )
+        self._step_fn = step_fn
+        self._gauges_fn = gauges_fn
+        self._server_states_fn = server_states_fn
+        self._registry = registry
+        self.published = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.interval > 0
+
+    def _call(self, fn, default):
+        if fn is None:
+            return default
+        try:
+            return fn()
+        except Exception:
+            logger.warning(
+                "telemetry %s callback failed", self.worker_name,
+                exc_info=True,
+            )
+            return default
+
+    def publish_once(self) -> dict:
+        from areal_tpu.system import telemetry
+
+        snap = telemetry.build_snapshot(
+            self.worker_name,
+            self.role,
+            step=int(self._call(self._step_fn, 0) or 0),
+            registry=self._registry,
+            gauges=self._call(self._gauges_fn, {}),
+            server_states=self._call(self._server_states_fn, None),
+        )
+        telemetry.publish_snapshot(
+            self.experiment_name, self.trial_name, snap
+        )
+        self.published += 1
+        return snap
+
+    def _loop(self):
+        while True:
+            try:
+                self.publish_once()
+            except Exception:
+                logger.warning("telemetry publish failed", exc_info=True)
+            if self._stop.wait(self.interval):
+                return
+
+    def maybe_start(self) -> "TelemetryExporter":
+        """Start the export thread iff the knob enables it (no-op
+        otherwise) — callers wire it unconditionally next to Heartbeat."""
+        if self.enabled and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop,
+                name=f"telemetry:{self.worker_name}",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self):
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._thread = None
+        try:
+            # final flush: counters bumped since the last tick (e.g. the
+            # trainer's last-step histograms) must reach the aggregator
+            self.publish_once()
+        except Exception:
+            logger.warning("final telemetry publish failed", exc_info=True)
+
+
+# --------------------------------------------------------------------- #
 # Preemption plane
 # --------------------------------------------------------------------- #
 

@@ -32,12 +32,27 @@ one scalar to drain the queue. The generation engine syncs once per decode
 chunk by design; chunks of 128 amortize that to <1 ms/token.
 """
 
+import contextlib
 import dataclasses
 import json
 import os
 import time
 
 import numpy as np
+
+
+@contextlib.contextmanager
+def _env(name, val):
+    """Set one env var for an A/B arm, restoring the previous value."""
+    prev = os.environ.get(name)
+    os.environ[name] = val
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prev
 
 
 def _mk_sample(cfg, lens, rng):
@@ -357,8 +372,6 @@ def _bench_fwd_pipe(peak):
     not on real hardware, flip the env defaults in base/constants.py).
     Every sub-A/B is individually guarded so the section always returns
     structured JSON."""
-    import contextlib
-
     import jax
 
     from areal_tpu.api.data import MicroBatchSpec, SequenceSample
@@ -369,18 +382,6 @@ def _bench_fwd_pipe(peak):
     from areal_tpu.models.config import ModelConfig
     from areal_tpu.parallel.mesh import ParallelConfig
     from areal_tpu.train.engine import OptimizerConfig, TrainEngine
-
-    @contextlib.contextmanager
-    def _env(name, val):
-        prev = os.environ.get(name)
-        os.environ[name] = val
-        try:
-            yield
-        finally:
-            if prev is None:
-                os.environ.pop(name, None)
-            else:
-                os.environ[name] = prev
 
     cfg = ModelConfig(
         n_layers=12, n_q_heads=12, n_kv_heads=4, head_dim=64, hidden_dim=768,
@@ -503,8 +504,6 @@ def _bench_guard(peak):
     like the fwd_pipe section: ``vs_baseline`` = guard_off / guard_on wall
     time (≈1.0 expected; if real hardware shows a regression, flip the env
     default in base/constants.py)."""
-    import contextlib
-
     import jax
 
     from areal_tpu.api.data import MicroBatchSpec, SequenceSample
@@ -513,18 +512,6 @@ def _bench_guard(peak):
     from areal_tpu.models.config import ModelConfig
     from areal_tpu.parallel.mesh import ParallelConfig
     from areal_tpu.train.engine import OptimizerConfig, TrainEngine
-
-    @contextlib.contextmanager
-    def _env(name, val):
-        prev = os.environ.get(name)
-        os.environ[name] = val
-        try:
-            yield
-        finally:
-            if prev is None:
-                os.environ.pop(name, None)
-            else:
-                os.environ[name] = prev
 
     cfg = ModelConfig(
         n_layers=6, n_q_heads=8, n_kv_heads=4, head_dim=64, hidden_dim=512,
@@ -566,6 +553,107 @@ def _bench_guard(peak):
         "guard_on_s": round(on, 5),
         "overhead_pct": round((on - off) / max(off, 1e-9) * 100, 2),
         "vs_baseline": round(off / max(on, 1e-9), 4),
+        "n_steps": n_steps,
+    }
+
+
+def _bench_telemetry(peak):
+    """A/B the fleet telemetry exporter (AREAL_TELEMETRY_EXPORT,
+    docs/observability.md): the exporter is a background thread that
+    serializes the counter/histogram registry and writes one name_resolve
+    key per period — nothing rides the train-step path, so ``vs_baseline``
+    = exporter_off / exporter_on wall time should be ≈ 1.0. Both arms run
+    the identical step loop INCLUDING the per-batch consumption
+    ``observe()`` calls (those are knob-independent: the buffer stamps
+    lifecycle histograms whether or not anyone exports them); only the
+    publishing thread differs. The on-arm publishes through a real
+    file-backed name_resolve at an aggressive 0.25 s period — 60x the
+    default rate, so a ≈1.0 here bounds the production overhead hard."""
+    import tempfile
+
+    import jax
+
+    from areal_tpu.api.data import MicroBatchSpec
+    from areal_tpu.base import constants as const
+    from areal_tpu.base import metrics as metrics_mod
+    from areal_tpu.base import name_resolve
+    from areal_tpu.interfaces.sft import sft_loss_fn
+    from areal_tpu.models.config import ModelConfig
+    from areal_tpu.parallel.mesh import ParallelConfig
+    from areal_tpu.train.engine import OptimizerConfig, TrainEngine
+    from areal_tpu.system.worker_base import TelemetryExporter
+
+    cfg = ModelConfig(
+        n_layers=6, n_q_heads=8, n_kv_heads=4, head_dim=64, hidden_dim=512,
+        intermediate_dim=1408, vocab_size=32768, use_attention_bias=True,
+        dtype="bfloat16", remat_policy="none", layer_scan_unroll=6,
+    )
+    rng = np.random.default_rng(0)
+    sample = _mk_sample(cfg, [512] * 8, rng)
+    spec = MicroBatchSpec(n_mbs=2, max_tokens_per_mb=2048)
+    n_steps = 8
+
+    eng = TrainEngine(
+        cfg, ParallelConfig(), OptimizerConfig(lr=1e-5),
+        param_dtype="bfloat16",
+    )
+    eng.init_random(0)
+    eng.setup_optimizer(100)
+    eng.train_batch(sample, spec, sft_loss_fn, fetch_stats=False)
+    jax.block_until_ready(eng.params)                  # warm/compile
+
+    def time_steps():
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            eng.train_batch(sample, spec, sft_loss_fn, fetch_stats=False)
+            # a consumed batch's worth of lifecycle stamps (identical in
+            # both arms — observe() is knob-independent)
+            for _ in range(8):
+                metrics_mod.counters.observe(
+                    metrics_mod.STALENESS_VERSIONS, 1
+                )
+                metrics_mod.counters.observe(metrics_mod.QUEUE_WAIT_S, 0.05)
+                metrics_mod.counters.observe(metrics_mod.E2E_LATENCY_S, 1.5)
+        jax.block_until_ready(eng.params)
+        return (time.perf_counter() - t0) / n_steps
+
+    with _env(const.TELEMETRY_EXPORT_ENV, "0"):
+        tele = TelemetryExporter("bench", "t0", "trainer", "trainer")
+        tele.maybe_start()                              # no-op: knob off
+        off = time_steps()
+        tele.stop()
+
+    prev_repo = name_resolve.default_repository()
+    tmpdir = tempfile.mkdtemp(prefix="bench_telemetry_")
+    published = 0
+    try:
+        name_resolve.reconfigure(
+            name_resolve.NameResolveConfig(type="file", root=tmpdir)
+        )
+        with _env(const.TELEMETRY_EXPORT_ENV, "0.25"):
+            tele = TelemetryExporter(
+                "bench", "t0", "trainer", "trainer",
+                step_fn=lambda: n_steps,
+            ).maybe_start()
+            on = time_steps()
+            tele.stop()
+            published = tele.published
+    finally:
+        name_resolve.set_repository(prev_repo)
+        import shutil
+
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    eng.params = eng.opt_state = None
+    import gc
+
+    gc.collect()
+    return {
+        "exporter_off_s": round(off, 5),
+        "exporter_on_s": round(on, 5),
+        "overhead_pct": round((on - off) / max(off, 1e-9) * 100, 2),
+        "vs_baseline": round(off / max(on, 1e-9), 4),
+        "snapshots_published": published,
+        "export_period_s": 0.25,
         "n_steps": n_steps,
     }
 
@@ -961,6 +1049,7 @@ def main():
         ("bwd_pipe",
          lambda: _bench_bwd_pipe(cfg_small, cfg_32k, peak), True),
         ("guard", lambda: _bench_guard(peak), True),
+        ("telemetry", lambda: _bench_telemetry(peak), True),
     ):
         if not want(name):
             continue

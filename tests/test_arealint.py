@@ -408,6 +408,32 @@ class TestRegistryRules:
         fs = findings_of(src, rules=["unregistered-counter"])
         assert len(fs) == 1 and "FT_TYPO_NAME" in fs[0].message
 
+    def test_histogram_observe_must_be_registered(self):
+        """The telemetry plane's histogram kind goes through the same
+        catalog: counters.observe with an uncataloged key is flagged, a
+        cataloged literal or constant passes, and the repo's REAL catalog
+        carries the histogram constants (parsed, not imported)."""
+        src = textwrap.dedent(
+            """
+            from areal_tpu.base import metrics as metrics_mod
+
+            metrics_mod.counters.observe("ft/evictions", 1.0)
+            metrics_mod.counters.observe("staleness_not_in_catalog", 2)
+            metrics_mod.counters.observe(metrics_mod.FT_EVICTIONS, 3)
+            """
+        )
+        fs = findings_of(src, rules=["unregistered-counter"])
+        assert len(fs) == 1 and "staleness_not_in_catalog" in fs[0].message
+        # the real catalog registers the trajectory histogram keys
+        real = Config.from_repo()
+        for name, value in [
+            ("STALENESS_VERSIONS", "staleness_versions"),
+            ("QUEUE_WAIT_S", "queue_wait_s"),
+            ("E2E_LATENCY_S", "e2e_latency_s"),
+        ]:
+            assert name in real.counter_names
+            assert value in real.counter_values
+
     def test_fault_point_must_be_registered(self):
         src = textwrap.dedent(
             """

@@ -6,12 +6,20 @@ guarantee the reference enforces on arrival: samples older than
 ``max_head_offpolicyness`` versions NEVER reach the optimizer.
 """
 
+import time
+
 import numpy as np
 import pytest
 
 from areal_tpu.api.data import SequenceSample
+from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.base import name_resolve, names
-from areal_tpu.system.buffer import SequenceBuffer, sample_version_start
+from areal_tpu.system.buffer import (
+    SequenceBuffer,
+    record_batch_consumption,
+    record_consumption,
+    sample_version_start,
+)
 from areal_tpu.system.gserver_manager import GserverManager, GserverManagerConfig
 
 
@@ -119,6 +127,161 @@ class TestSequenceBuffer:
         buf.put(_traj("v3", version_start=3), current_version=3)
         assert len(buf) == 2 and buf.n_dropped_capacity == 1
         assert [s.ids[0] for s in buf.pop_batch(5)] == ["v2", "v3"]
+
+
+LIFECYCLE_KEYS = (
+    metrics_mod.STALENESS_VERSIONS,
+    metrics_mod.QUEUE_WAIT_S,
+    metrics_mod.E2E_LATENCY_S,
+    metrics_mod.TTFC_S,
+    metrics_mod.REWARD_LAG_S,
+)
+
+
+class TestConsumptionAttribution:
+    """The trainer's batch-commit point is THE measurement point of the
+    staleness/latency story (docs/observability.md): lifecycle stamps
+    riding trajectory metadata become process-global histograms the
+    telemetry plane exports. ``pop_batch`` itself records nothing — a
+    popped batch can be re-put on the multihost starved/over-stale path,
+    so recording there would double-count."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_histograms(self):
+        for k in LIFECYCLE_KEYS:
+            metrics_mod.counters.clear(k)
+        yield
+        for k in LIFECYCLE_KEYS:
+            metrics_mod.counters.clear(k)
+
+    def _stamped(self, qid, version_start, submit_ago, enqueue_ago,
+                 ttfc=None, reward_lag=None):
+        now = time.time()
+        t = _traj(qid, version_start=version_start)
+        t.metadata["submit_time"] = [now - submit_ago] * 2
+        t.metadata["enqueue_time"] = [now - enqueue_ago] * 2
+        if ttfc is not None:
+            t.metadata["first_chunk_time"] = [now - submit_ago + ttfc] * 2
+        if reward_lag is not None:
+            t.metadata["reward_time"] = [now - submit_ago + reward_lag] * 2
+        return t
+
+    def test_committed_batch_records_distributions(self):
+        buf = SequenceBuffer()
+        buf.put(self._stamped("a", version_start=3, submit_ago=10.0,
+                              enqueue_ago=4.0, ttfc=0.5, reward_lag=8.0),
+                current_version=5)
+        buf.put(self._stamped("b", version_start=5, submit_ago=20.0,
+                              enqueue_ago=2.0, ttfc=1.0, reward_lag=15.0),
+                current_version=5)
+        batch = buf.pop_batch(5, current_version=5)
+        assert len(batch) == 2
+        record_batch_consumption(batch, current_version=5)
+
+        stale = metrics_mod.counters.histogram(metrics_mod.STALENESS_VERSIONS)
+        assert stale.count == 2
+        assert stale.min == 0.0 and stale.max == 2.0   # 5-5 and 5-3
+        # the integer-centered edges keep 0 and 2 in separate buckets
+        assert stale.counts[0] == 1 and stale.counts[2] == 1
+
+        qw = metrics_mod.counters.histogram(metrics_mod.QUEUE_WAIT_S)
+        assert qw.count == 2
+        assert qw.min == pytest.approx(2.0, abs=0.5)
+        assert qw.max == pytest.approx(4.0, abs=0.5)
+
+        e2e = metrics_mod.counters.histogram(metrics_mod.E2E_LATENCY_S)
+        assert e2e.count == 2
+        assert e2e.max == pytest.approx(20.0, abs=0.5)
+        # queue wait is a component of e2e latency
+        assert qw.sum < e2e.sum
+
+        assert metrics_mod.counters.histogram(
+            metrics_mod.TTFC_S
+        ).max == pytest.approx(1.0, abs=0.1)
+        assert metrics_mod.counters.histogram(
+            metrics_mod.REWARD_LAG_S
+        ).max == pytest.approx(15.0, abs=0.5)
+
+    def test_pop_batch_alone_records_nothing(self):
+        """The multihost re-put path (trainer pops, a sibling host was
+        starved, batch goes back in the buffer): popping must not touch
+        the histograms, or the same trajectories count twice when the
+        refilled pop finally commits."""
+        buf = SequenceBuffer()
+        buf.put(self._stamped("reput", version_start=4, submit_ago=10.0,
+                              enqueue_ago=4.0), current_version=5)
+        batch = buf.pop_batch(1, current_version=5)
+        for k in LIFECYCLE_KEYS:
+            assert metrics_mod.counters.histogram(k) is None
+        for s in batch:  # re-put and commit on the second pop
+            buf.put(s, current_version=5)
+        record_batch_consumption(
+            buf.pop_batch(1, current_version=5), current_version=5
+        )
+        assert metrics_mod.counters.histogram(
+            metrics_mod.STALENESS_VERSIONS
+        ).count == 1
+
+    def test_unstamped_samples_only_record_staleness(self):
+        """Sync-PPO/test trajectories carry no stamps: version staleness is
+        still measured (version_start is device data), the wall-clock
+        histograms simply stay empty — no fake zeros."""
+        buf = SequenceBuffer()
+        buf.put(_traj("plain", version_start=4), current_version=6)
+        record_batch_consumption(
+            buf.pop_batch(1, current_version=6), current_version=6
+        )
+        stale = metrics_mod.counters.histogram(metrics_mod.STALENESS_VERSIONS)
+        assert stale.count == 1 and stale.max == 2.0
+        for k in LIFECYCLE_KEYS[1:]:
+            assert metrics_mod.counters.histogram(k) is None
+
+    def test_untagged_unstamped_records_nothing(self):
+        buf = SequenceBuffer()
+        buf.put(_traj("sync", version_start=0, extra_keys=False),
+                current_version=9)
+        record_batch_consumption(
+            buf.pop_batch(1, current_version=9), current_version=9
+        )
+        for k in LIFECYCLE_KEYS:
+            assert metrics_mod.counters.histogram(k) is None
+
+    def test_grouped_sample_uses_earliest_stamp(self):
+        """gather() concatenates per-group metadata; attribution takes the
+        EARLIEST positive stamp (worst case), and zero placeholders from
+        unstamped group members are ignored."""
+        now = time.time()
+        t = _traj("g", version_start=1)
+        t.metadata["enqueue_time"] = [now - 9.0, 0.0]
+        record_consumption(t, current_version=1)
+        qw = metrics_mod.counters.histogram(metrics_mod.QUEUE_WAIT_S)
+        assert qw.count == 1
+        assert qw.max == pytest.approx(9.0, abs=0.5)
+
+    def test_malformed_stamps_tolerated(self):
+        t = _traj("bad", version_start=1)
+        t.metadata["enqueue_time"] = ["not-a-time", None]
+        record_consumption(t, current_version=3)
+        assert metrics_mod.counters.histogram(
+            metrics_mod.QUEUE_WAIT_S
+        ) is None
+        # staleness still recorded: the malformed wall stamps don't block it
+        assert metrics_mod.counters.histogram(
+            metrics_mod.STALENESS_VERSIONS
+        ).count == 1
+
+    def test_clock_skew_clamped_nonnegative(self):
+        now = time.time()
+        t = _traj("skew", version_start=7)
+        t.metadata["enqueue_time"] = [now + 30.0] * 2  # writer clock ahead
+        t.metadata["submit_time"] = [now + 30.0] * 2
+        record_consumption(t, current_version=5)  # version went backwards too
+        assert metrics_mod.counters.histogram(
+            metrics_mod.QUEUE_WAIT_S
+        ).max == 0.0
+        assert metrics_mod.counters.histogram(
+            metrics_mod.STALENESS_VERSIONS
+        ).max == 0.0
 
 
 class TestTrainerIntake:

@@ -1,0 +1,472 @@
+"""Fleet telemetry plane (docs/observability.md): histogram math, the
+metric-kind registry, the per-worker exporter publish/collect roundtrip
+through name_resolve, central aggregation across workers, and the ops CLI
+rendering.
+
+Everything runs against the in-memory name_resolve backend — the same
+publish/collect code paths the multiprocess world exercises over the
+file backend (tests/test_experiment_e2e.py asserts that end to end).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from areal_tpu.base import metrics as metrics_mod
+from areal_tpu.base import name_resolve, names
+from areal_tpu.base.metrics import (
+    DEFAULT_HISTOGRAM_BOUNDARIES,
+    KIND_HISTOGRAM,
+    KIND_PEAK,
+    KIND_SUM,
+    VERSION_LAG_BOUNDARIES,
+    CounterRegistry,
+    Histogram,
+)
+from areal_tpu.system import telemetry
+from areal_tpu.system.worker_base import TelemetryExporter
+
+
+class TestHistogram:
+    def test_default_boundaries_log_spaced_ascending(self):
+        b = DEFAULT_HISTOGRAM_BOUNDARIES
+        assert b == sorted(b)
+        assert b[0] == pytest.approx(1e-4)
+        assert b[-1] == pytest.approx(1e4)
+        # 4 buckets/decade over 8 decades -> 33 edges
+        assert len(b) == 33
+        # neighbouring edges are a constant ratio (log-spaced)
+        ratios = [b[i + 1] / b[i] for i in range(len(b) - 1)]
+        assert all(r == pytest.approx(10 ** 0.25, rel=1e-6) for r in ratios)
+
+    def test_observe_bucket_placement(self):
+        h = Histogram(boundaries=[1.0, 10.0, 100.0])
+        assert len(h.counts) == 4
+        h.observe(0.5)    # <= 1.0 -> bucket 0
+        h.observe(1.0)    # == edge -> bucket 0 (counts values <= edge)
+        h.observe(5.0)    # bucket 1
+        h.observe(100.0)  # bucket 2
+        h.observe(1e6)    # overflow bucket
+        assert h.counts == [2, 1, 1, 1]
+        assert h.count == 5
+        assert h.sum == pytest.approx(0.5 + 1.0 + 5.0 + 100.0 + 1e6)
+        assert h.min == 0.5 and h.max == 1e6
+
+    def test_percentile_empty_and_identical(self):
+        h = Histogram(boundaries=[1.0, 10.0])
+        assert h.percentile(50) == 0.0
+        assert h.summary() == {"count": 0.0}
+        for _ in range(100):
+            h.observe(3.0)
+        # interpolation is clamped to observed min/max: all-identical
+        # observations report exactly that value at every percentile
+        for q in (0, 50, 95, 99, 100):
+            assert h.percentile(q) == pytest.approx(3.0)
+
+    def test_percentile_monotone_and_sane(self):
+        h = Histogram()
+        for i in range(1, 1001):
+            h.observe(i / 1000.0)  # uniform on (0, 1]
+        p50, p95, p99 = h.percentile(50), h.percentile(95), h.percentile(99)
+        assert p50 <= p95 <= p99 <= h.max
+        # +-33% bucket resolution: the estimates stay near truth
+        assert p50 == pytest.approx(0.5, rel=0.45)
+        assert p99 == pytest.approx(0.99, rel=0.45)
+
+    def test_percentile_overflow_bucket_clamped_to_max(self):
+        h = Histogram(boundaries=[1.0])
+        h.observe(50.0)
+        h.observe(70.0)
+        # both live in the unbounded overflow bucket: estimates must come
+        # from the observed range, not infinity
+        assert h.percentile(99) <= 70.0
+        assert h.percentile(1) >= 1.0
+
+    def test_merge(self):
+        a = Histogram(boundaries=[1.0, 10.0])
+        b = Histogram(boundaries=[1.0, 10.0])
+        a.observe(0.5)
+        b.observe(5.0)
+        b.observe(20.0)
+        a.merge(b)
+        assert a.counts == [1, 1, 1]
+        assert a.count == 3
+        assert a.min == 0.5 and a.max == 20.0
+        assert a.sum == pytest.approx(25.5)
+
+    def test_merge_mismatched_boundaries_raises(self):
+        a = Histogram(boundaries=[1.0, 10.0])
+        b = Histogram(boundaries=[2.0, 10.0])
+        with pytest.raises(ValueError):
+            a.merge(b)
+
+    def test_state_roundtrip(self):
+        h = Histogram(boundaries=VERSION_LAG_BOUNDARIES)
+        for v in (0, 0, 1, 2, 7, 200):
+            h.observe(v)
+        r = Histogram.from_state(json.loads(json.dumps(h.state())))
+        assert r.counts == h.counts
+        assert r.count == h.count and r.sum == h.sum
+        assert r.min == h.min and r.max == h.max
+        assert r.summary() == h.summary()
+
+    def test_state_roundtrip_empty(self):
+        r = Histogram.from_state(json.loads(json.dumps(Histogram().state())))
+        assert r.count == 0
+        # empty min/max serialize as None and come back as the identities
+        r.observe(3.0)
+        assert r.min == 3.0 and r.max == 3.0
+
+    def test_version_lag_boundaries_separate_small_integers(self):
+        """Staleness 0/1/2 are the values the bounded-staleness story is
+        about — the integer-centered edges keep them in distinct buckets."""
+        h = Histogram(boundaries=VERSION_LAG_BOUNDARIES)
+        for v, n in ((0, 10), (1, 5), (2, 1)):
+            for _ in range(n):
+                h.observe(v)
+        assert h.counts[0] == 10 and h.counts[1] == 5 and h.counts[2] == 1
+
+
+class TestRegistryKinds:
+    def test_delta_by_kind_not_suffix(self):
+        reg = CounterRegistry()
+        reg.add("a/total", 5)
+        reg.peak("a/depth", 3)
+        before = reg.snapshot()
+        reg.add("a/total", 2)
+        reg.peak("a/depth", 7)
+        d = reg.delta(before)
+        assert d["a/total"] == pytest.approx(2.0)   # sum: subtract
+        assert d["a/depth"] == pytest.approx(7.0)   # peak: report as-is
+
+    def test_catalog_declares_max_in_flight_peak(self):
+        """The endswith("max_in_flight") hack is gone: the kind comes from
+        the METRIC_KINDS catalog even on a registry that never saw peak()."""
+        reg = CounterRegistry()
+        assert reg.kind(metrics_mod.PIPE_FWD_MAX_IN_FLIGHT) == KIND_PEAK
+        assert reg.kind(metrics_mod.FT_EVICTIONS) == KIND_SUM
+        assert reg.kind(metrics_mod.STALENESS_VERSIONS) == KIND_HISTOGRAM
+        assert reg.kind("anything/else") == KIND_SUM
+
+    def test_register_kind_validates(self):
+        reg = CounterRegistry()
+        reg.register_kind("x", KIND_PEAK)
+        assert reg.kind("x") == KIND_PEAK
+        with pytest.raises(AssertionError):
+            reg.register_kind("y", "mean")
+
+    def test_observe_uses_catalog_boundaries(self):
+        reg = CounterRegistry()
+        reg.observe(metrics_mod.STALENESS_VERSIONS, 1)
+        h = reg.histogram(metrics_mod.STALENESS_VERSIONS)
+        assert h.boundaries == VERSION_LAG_BOUNDARIES
+        reg.observe("some/duration_s", 0.1)
+        assert (
+            reg.histogram("some/duration_s").boundaries
+            == DEFAULT_HISTOGRAM_BOUNDARIES
+        )
+
+    def test_export_state_serializable_and_complete(self):
+        reg = CounterRegistry()
+        reg.add("n", 2)
+        reg.peak("depth", 4)
+        reg.observe("lat_s", 0.25)
+        st = json.loads(json.dumps(reg.export_state()))
+        assert st["counters"] == {"n": 2.0, "depth": 4.0}
+        assert st["kinds"] == {"n": KIND_SUM, "depth": KIND_PEAK}
+        assert st["histograms"]["lat_s"]["count"] == 1
+
+    def test_histogram_summaries_and_clear(self):
+        reg = CounterRegistry()
+        reg.observe("h", 1.0)
+        assert reg.histogram_summaries()["h"]["count"] == 1.0
+        reg.clear("h")
+        assert reg.histogram("h") is None
+
+    def test_thread_safety_smoke(self):
+        reg = CounterRegistry()
+        n_threads, n_each = 8, 500
+
+        def work():
+            for i in range(n_each):
+                reg.add("c")
+                reg.peak("p", i)
+                reg.observe("h", i * 1e-3)
+
+        ts = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert reg.get("c") == n_threads * n_each
+        assert reg.get("p") == n_each - 1
+        h = reg.histogram("h")
+        assert h.count == n_threads * n_each
+        assert sum(h.counts) == h.count
+
+
+def _fake_snapshot(worker, role, counters=None, kinds=None, hist_values=(),
+                   gauges=None, server_states=None, step=0, pid=1):
+    reg = CounterRegistry()
+    for k, v in (counters or {}).items():
+        if (kinds or {}).get(k) == KIND_PEAK:
+            reg.peak(k, v)
+        else:
+            reg.add(k, v)
+    for v in hist_values:
+        reg.observe(metrics_mod.QUEUE_WAIT_S, v)
+    snap = telemetry.build_snapshot(
+        worker, role, step=step, registry=reg, gauges=gauges,
+        server_states=server_states,
+    )
+    snap["pid"] = pid
+    return snap
+
+
+class TestAggregator:
+    def test_merge_across_three_workers(self):
+        snaps = [
+            _fake_snapshot(
+                "rollout_worker/0", "rollout",
+                counters={metrics_mod.FT_CLIENT_RETRIES: 2,
+                          metrics_mod.ROLLOUT_PUSHED: 10,
+                          metrics_mod.PIPE_FWD_MAX_IN_FLIGHT: 2},
+                kinds={metrics_mod.PIPE_FWD_MAX_IN_FLIGHT: KIND_PEAK},
+                hist_values=[0.1, 0.2], pid=11,
+            ),
+            _fake_snapshot(
+                "rollout_worker/1", "rollout",
+                counters={metrics_mod.FT_CLIENT_RETRIES: 3,
+                          metrics_mod.ROLLOUT_PUSHED: 5,
+                          metrics_mod.PIPE_FWD_MAX_IN_FLIGHT: 4},
+                kinds={metrics_mod.PIPE_FWD_MAX_IN_FLIGHT: KIND_PEAK},
+                hist_values=[0.4], pid=12,
+            ),
+            _fake_snapshot(
+                "gserver_manager", "manager",
+                counters={metrics_mod.MANAGER_SCHEDULED: 7},
+                gauges={"rollouts_running": 3.0},
+                server_states={"http://a": "closed", "http://b": "open"},
+                pid=13,
+            ),
+        ]
+        agg = telemetry.aggregate(snaps)
+        assert len(agg.workers) == 3
+        # sum kinds add across workers; peak kinds take the fleet max
+        assert agg.counters[metrics_mod.FT_CLIENT_RETRIES] == 5.0
+        assert agg.counters[metrics_mod.ROLLOUT_PUSHED] == 15.0
+        assert agg.counters[metrics_mod.PIPE_FWD_MAX_IN_FLIGHT] == 4.0
+        # histograms merge bucket-wise: fleet percentiles come from ALL
+        # observations, not an average of per-worker percentiles
+        h = agg.histograms[metrics_mod.QUEUE_WAIT_S]
+        assert h.count == 3
+        assert h.min == pytest.approx(0.1) and h.max == pytest.approx(0.4)
+
+        s = agg.scalars()
+        assert s["workers"] == 3.0
+        assert s["worker_pids"] == 3.0
+        assert s[f"{metrics_mod.QUEUE_WAIT_S}/count"] == 3.0
+        assert s[f"{metrics_mod.QUEUE_WAIT_S}/p99"] <= 0.4 + 1e-9
+        # breaker tallies from the manager's server_states
+        assert s["servers_total"] == 2.0
+        assert s["servers_closed"] == 1.0 and s["servers_open"] == 1.0
+        assert s["rollouts_running"] == 3.0
+        # the full ft/ catalog is zero-filled: healthy-fleet zeros are
+        # explicit in the record, not absent
+        assert s[metrics_mod.FT_EVICTIONS] == 0.0
+
+    def test_aggregate_deterministic_order(self):
+        snaps = [
+            _fake_snapshot("b", "rollout", pid=2),
+            _fake_snapshot("a", "rollout", pid=1),
+        ]
+        agg = telemetry.aggregate(snaps)
+        assert [w["worker"] for w in agg.workers] == ["a", "b"]
+
+    def test_malformed_histogram_state_skipped(self):
+        snap = _fake_snapshot("w", "rollout", hist_values=[0.1])
+        snap["histograms"]["bad"] = {"counts": "nope"}
+        agg = telemetry.aggregate([snap])
+        assert "bad" not in agg.histograms
+        assert metrics_mod.QUEUE_WAIT_S in agg.histograms
+
+    def test_mismatched_boundaries_keeps_first(self):
+        a = _fake_snapshot("a", "rollout", hist_values=[0.1])
+        b = _fake_snapshot("b", "rollout", hist_values=[0.2])
+        b["histograms"][metrics_mod.QUEUE_WAIT_S]["boundaries"] = [1.0]
+        b["histograms"][metrics_mod.QUEUE_WAIT_S]["counts"] = [1, 0]
+        agg = telemetry.aggregate([a, b])
+        assert agg.histograms[metrics_mod.QUEUE_WAIT_S].count == 1
+
+    def test_unknown_kind_defaults_to_sum(self):
+        a = _fake_snapshot("a", "r", counters={"custom/key": 1})
+        b = _fake_snapshot("b", "r", counters={"custom/key": 2})
+        for s in (a, b):
+            s["kinds"] = {}
+        agg = telemetry.aggregate([a, b])
+        assert agg.counters["custom/key"] == 3.0
+
+
+class TestExporterRoundtrip:
+    EXP, TRIAL = "telemetry-test", "roundtrip"
+
+    def teardown_method(self):
+        name_resolve.clear_subtree(
+            names.telemetry_root(self.EXP, self.TRIAL)
+        )
+
+    def test_publish_collect_roundtrip(self):
+        reg = CounterRegistry()
+        reg.add(metrics_mod.ROLLOUT_PUSHED, 4)
+        reg.observe(metrics_mod.QUEUE_WAIT_S, 0.2)
+        exp = TelemetryExporter(
+            self.EXP, self.TRIAL, "rollout_worker/0", "rollout",
+            interval=60.0, registry=reg,
+            step_fn=lambda: 17,
+            gauges_fn=lambda: {"rollout_tasks_running": 2.0},
+        )
+        assert exp.enabled
+        exp.publish_once()
+        snaps = telemetry.collect_snapshots(self.EXP, self.TRIAL)
+        assert len(snaps) == 1
+        s = snaps[0]
+        assert s["worker"] == "rollout_worker/0" and s["role"] == "rollout"
+        assert s["step"] == 17
+        assert s["counters"][metrics_mod.ROLLOUT_PUSHED] == 4.0
+        assert s["histograms"][metrics_mod.QUEUE_WAIT_S]["count"] == 1
+        assert s["gauges"]["rollout_tasks_running"] == 2.0
+        # republish replaces (one live snapshot per worker, not a log)
+        reg.add(metrics_mod.ROLLOUT_PUSHED, 1)
+        exp.publish_once()
+        snaps = telemetry.collect_snapshots(self.EXP, self.TRIAL)
+        assert len(snaps) == 1
+        assert snaps[0]["counters"][metrics_mod.ROLLOUT_PUSHED] == 5.0
+
+    def test_disabled_exporter_is_noop(self, monkeypatch):
+        monkeypatch.delenv("AREAL_TELEMETRY_EXPORT", raising=False)
+        exp = TelemetryExporter(
+            self.EXP, self.TRIAL, "w", "rollout", registry=CounterRegistry()
+        )
+        assert not exp.enabled
+        exp.maybe_start()
+        assert exp._thread is None
+        exp.stop()
+        assert exp.published == 0
+        assert telemetry.collect_snapshots(self.EXP, self.TRIAL) == []
+
+    def test_background_thread_publishes_and_final_flush(self):
+        reg = CounterRegistry()
+        exp = TelemetryExporter(
+            self.EXP, self.TRIAL, "w", "rollout",
+            interval=0.05, registry=reg,
+        ).maybe_start()
+        deadline = time.monotonic() + 5.0
+        while exp.published < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert exp.published >= 2
+        # a counter bumped right before stop reaches the final snapshot
+        reg.add(metrics_mod.ROLLOUT_ACCEPTED, 9)
+        exp.stop()
+        assert exp._thread is None
+        snaps = telemetry.collect_snapshots(self.EXP, self.TRIAL)
+        assert snaps[0]["counters"][metrics_mod.ROLLOUT_ACCEPTED] == 9.0
+
+    def test_failing_callback_degrades_not_crashes(self):
+        def boom():
+            raise RuntimeError("gauge source died")
+
+        exp = TelemetryExporter(
+            self.EXP, self.TRIAL, "w", "rollout",
+            interval=60.0, registry=CounterRegistry(),
+            gauges_fn=boom, step_fn=boom,
+        )
+        snap = exp.publish_once()
+        assert snap["gauges"] == {} and snap["step"] == 0
+        assert len(telemetry.collect_snapshots(self.EXP, self.TRIAL)) == 1
+
+    def test_collect_fleet_scalars_substitutes_live_local(self):
+        stale = _fake_snapshot(
+            "trainer", "trainer",
+            counters={metrics_mod.TRAIN_STEPS: 1}, pid=7,
+        )
+        telemetry.publish_snapshot(self.EXP, self.TRIAL, stale)
+        other = _fake_snapshot(
+            "rollout_worker/0", "rollout",
+            counters={metrics_mod.ROLLOUT_PUSHED: 3}, pid=8,
+        )
+        telemetry.publish_snapshot(self.EXP, self.TRIAL, other)
+        live = _fake_snapshot(
+            "trainer", "trainer",
+            counters={metrics_mod.TRAIN_STEPS: 5}, pid=7,
+        )
+        s = telemetry.collect_fleet_scalars(
+            self.EXP, self.TRIAL, local_snapshot=live
+        )
+        # the caller's live registry replaces its own published snapshot
+        # (not double-counted), everyone else's published state merges in
+        assert s[metrics_mod.TRAIN_STEPS] == 5.0
+        assert s[metrics_mod.ROLLOUT_PUSHED] == 3.0
+        assert s["workers"] == 2.0
+
+    def test_collect_fleet_scalars_none_when_empty(self):
+        assert (
+            telemetry.collect_fleet_scalars("telemetry-test", "nothing")
+            is None
+        )
+
+    def test_malformed_published_snapshot_skipped(self):
+        name_resolve.add(
+            names.telemetry(self.EXP, self.TRIAL, "corrupt"),
+            "{not json", replace=True,
+        )
+        good = _fake_snapshot("ok", "rollout", pid=3)
+        telemetry.publish_snapshot(self.EXP, self.TRIAL, good)
+        snaps = telemetry.collect_snapshots(self.EXP, self.TRIAL)
+        assert [s["worker"] for s in snaps] == ["ok"]
+
+
+class TestObsCLI:
+    EXP, TRIAL = "telemetry-test", "obs"
+
+    def teardown_method(self):
+        name_resolve.clear_subtree(
+            names.telemetry_root(self.EXP, self.TRIAL)
+        )
+
+    def _publish_world(self):
+        telemetry.publish_snapshot(self.EXP, self.TRIAL, _fake_snapshot(
+            "trainer", "trainer",
+            counters={metrics_mod.TRAIN_STEPS: 12}, hist_values=[0.5],
+            step=12, pid=21,
+        ))
+        telemetry.publish_snapshot(self.EXP, self.TRIAL, _fake_snapshot(
+            "gserver_manager", "manager",
+            counters={metrics_mod.MANAGER_SCHEDULED: 40},
+            server_states={"http://a": "closed"}, pid=22,
+        ))
+
+    def test_render_table(self):
+        from areal_tpu.apps import obs
+
+        self._publish_world()
+        agg = telemetry.aggregate(
+            telemetry.collect_snapshots(self.EXP, self.TRIAL)
+        )
+        out = obs.render(agg)
+        assert "trainer" in out and "gserver_manager" in out
+        assert "steps=12" in out            # role headline counter
+        assert "scheduled=40" in out
+        assert "http://a" in out and "closed" in out
+        assert metrics_mod.QUEUE_WAIT_S in out  # distribution table row
+
+    def test_render_frame_json(self):
+        from areal_tpu.apps import obs
+
+        self._publish_world()
+        frame = obs.render_frame(self.EXP, self.TRIAL, as_json=True)
+        d = json.loads(frame)
+        assert d["workers"] == 2.0
+        assert d[metrics_mod.TRAIN_STEPS] == 12.0
+        assert obs.render_frame(self.EXP, "no-such-trial", False) is None

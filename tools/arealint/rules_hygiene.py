@@ -107,13 +107,14 @@ def check_env_knob(ctx: FileContext):
 # metrics counter registry
 # --------------------------------------------------------------------- #
 
-COUNTER_METHODS = ("add", "peak", "get", "clear")
+COUNTER_METHODS = ("add", "peak", "get", "clear", "observe")
 
 
 @rule(
     "unregistered-counter", SEVERITY_ERROR,
-    "metrics.counters.add/peak/get/clear with a name that is not a "
-    "registered constant in the base/metrics.py catalog",
+    "metrics.counters.add/peak/get/clear/observe with a name that is not "
+    "a registered constant in the base/metrics.py catalog (histogram keys "
+    "included)",
 )
 def check_counters(ctx: FileContext):
     values = ctx.config.counter_values
