@@ -119,7 +119,9 @@ def paged_decode_attention(
 
             # contiguous q-head chunks of H/tp cover whole GQA groups
             # (H/tp = n_rep * Hkv/tp), so per-shard n_rep is unchanged
-            return jax.shard_map(
+            from areal_tpu.ops.pallas.compat import shard_map
+
+            return shard_map(
                 _kernel, mesh=mesh,
                 in_specs=(
                     P(None, "model", None),                    # q

@@ -1,0 +1,72 @@
+"""Pallas/SPMD API drift shims.
+
+``pltpu.CompilerParams`` is the current spelling of the TPU pallas_call
+compiler-params struct; older jax builds (<= 0.4.x) ship the same struct
+as ``pltpu.TPUCompilerParams``, and very old ones have neither. Mirrors
+the ``jax.profiler.ProfileData`` treatment in ``base/trace_analyzer.py``:
+resolve whichever spelling the installed jax has, degrade to "unavailable"
+instead of crashing with AttributeError deep inside a kernel build, and
+let tests skip via :func:`compiler_params_available`.
+
+The kernels only ever pass ``dimension_semantics`` and
+``vmem_limit_bytes`` — both present in every spelling this shim accepts.
+
+Same drift class for the SPMD entry point the kernel wrappers shard
+through: ``jax.shard_map`` (kwarg ``check_vma``) is the current
+spelling; 0.4.x ships ``jax.experimental.shard_map.shard_map`` (kwarg
+``check_rep``, same meaning: disable the replication/varying-axes
+check). :func:`shard_map` resolves whichever exists and translates the
+kwarg.
+"""
+
+from typing import Optional
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+# The struct under its current name, else the legacy name, else None.
+CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
+# Same drift for the memory-space enum: ``pltpu.MemorySpace`` today,
+# ``pltpu.TPUMemorySpace`` on 0.4.x. Both spell the member we use ``ANY``.
+MemorySpace = getattr(
+    pltpu, "MemorySpace", getattr(pltpu, "TPUMemorySpace", None)
+)
+ANY_MEMORY_SPACE = getattr(MemorySpace, "ANY", None)
+
+
+def compiler_params_available() -> bool:
+    """True when the installed jax exposes the compiler-params struct
+    under either spelling."""
+    return CompilerParams is not None
+
+
+def memory_space_available() -> bool:
+    """True when the installed jax exposes the memory-space enum (with
+    an ``ANY`` member) under either spelling — required by kernels that
+    keep a ref in HBM via ``BlockSpec(memory_space=...)``."""
+    return ANY_MEMORY_SPACE is not None
+
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
+
+
+def compiler_params(**kwargs) -> Optional[object]:
+    """A compiler-params instance for ``pallas_call(compiler_params=...)``,
+    or None (= pallas defaults) when the struct is unavailable. Passing
+    no kwargs also returns None — an empty params struct is equivalent
+    and None keeps old-jax behavior identical."""
+    if CompilerParams is None or not kwargs:
+        return None
+    return CompilerParams(**kwargs)

@@ -61,6 +61,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from areal_tpu.ops.pallas.compat import compiler_params as _compiler_params
+
 NEG_INF = -2.3819763e38
 LANES = 128
 LOG2E = 1.4426950408889634
@@ -469,7 +471,7 @@ def _flash_forward(
         2 * n_rep * block_q * block_k * 4
         + sum(4 * s.shape[0] * s.shape[1] for s in scratch_shapes)
     )
-    compiler_params = pltpu.CompilerParams(
+    compiler_params = _compiler_params(
         **({"vmem_limit_bytes": min(tile_bytes + 48 * 2**20, 114 * 2**20)}
            if tile_bytes > 24 * 2**20 or block_q >= 2048 else {})
     )
@@ -1057,7 +1059,7 @@ def _flash_backward(
                     scratch_shapes=scratch_shapes,
                 ),
                 out_shape=out_shapes,
-                compiler_params=pltpu.CompilerParams(
+                compiler_params=_compiler_params(
                     dimension_semantics=("parallel", "arbitrary"),
                     **({"vmem_limit_bytes": limit} if limit else {}),
                 ),
@@ -1118,7 +1120,7 @@ def _flash_backward(
                 scratch_shapes=scratch_shapes,
             ),
             out_shape=out_shapes,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_compiler_params(
                 dimension_semantics=("parallel", "arbitrary", "arbitrary"),
                 **({"vmem_limit_bytes": limit} if limit else {}),
             ),
@@ -1175,7 +1177,7 @@ def _flash_backward(
         out_shape=jax.ShapeDtypeStruct((H, T, D), q.dtype),
         # split-backward p/ds tiles need the same scoped-vmem raise as the
         # forward at big (rep-folded) blocks
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             **({"vmem_limit_bytes": 100 * 2**20}
                if n_rep * block_q >= 2048 else {})
         ),
@@ -1229,7 +1231,7 @@ def _flash_backward(
             jax.ShapeDtypeStruct((Hkv, T, D), k.dtype),
             jax.ShapeDtypeStruct((Hkv, T, D), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             **({"vmem_limit_bytes": 100 * 2**20}
                if block_k >= 2048 or n_rep * block_q >= 2048 else {})
         ),

@@ -33,6 +33,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from areal_tpu.ops.pallas import compat
+from areal_tpu.ops.pallas.compat import ANY_MEMORY_SPACE as _ANY_MEMORY_SPACE
+from areal_tpu.ops.pallas.compat import compiler_params as _compiler_params
+
 NEG_INF = -2.3819763e38
 LANES = 128
 
@@ -248,6 +252,17 @@ def decode(
     """The pool rides in whole (ANY memory space); the kernel issues its own
     per-page DMAs keyed by the scalar-prefetched layer index and page table
     — the caller's layer scan never slices or reshapes the pool."""
+    if _ANY_MEMORY_SPACE is None or not compat.compiler_params_available():
+        # fail loudly at the boundary, not deep inside the kernel build:
+        # the pool ref must stay in ANY/HBM, and the double-buffered page
+        # scratch NEEDS the vmem_limit_bytes raise (silently dropping it
+        # would die in the XLA compile with a scoped-vmem error)
+        raise RuntimeError(
+            "pallas paged decode unavailable: the installed jax lacks "
+            "pltpu MemorySpace/TPUMemorySpace or CompilerParams/"
+            "TPUCompilerParams — use the XLA gather path "
+            "(use_pallas=False)"
+        )
     B, Hq, D = q.shape
     L, P, _, Hkv, page, _ = pages.shape
     M = table.shape[1]
@@ -290,7 +305,7 @@ def decode(
                 pl.BlockSpec((sb, Hq, D), lambda b, j, ly, t, l: (b, 0, 0)),
                 pl.BlockSpec((sb, Hkv, D), lambda b, j, ly, t, l: (b, 0, 0)),
                 pl.BlockSpec((sb, Hkv, D), lambda b, j, ly, t, l: (b, 0, 0)),
-                pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+                pl.BlockSpec(memory_space=_ANY_MEMORY_SPACE),
             ],
             out_specs=pl.BlockSpec(
                 (sb, Hq, D), lambda b, j, ly, t, l: (b, 0, 0)
@@ -308,7 +323,7 @@ def decode(
         # the double-buffered page scratch alone can exceed the 16 MB
         # default scoped-vmem budget; size the limit from the actual
         # scratch + generous op margin (v5e VMEM is 128 MB)
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             vmem_limit_bytes=(
                 2 * 2 * sb * Hkv * kp * page * D * pages.dtype.itemsize
                 + 32 * 2**20

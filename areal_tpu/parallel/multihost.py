@@ -172,6 +172,7 @@ def _gather(x: np.ndarray) -> np.ndarray:
     _collective_rounds += 1
     from jax.experimental import multihost_utils
 
+    # arealint: ok(deliberate host collective: numpy in, numpy out — the per-step agreement rounds train_batch budgets via collective_rounds())
     return np.asarray(multihost_utils.process_allgather(np.asarray(x)))
 
 

@@ -1,0 +1,320 @@
+"""Tier-1 tests for the arealint project indexer + call graph
+(tools/arealint/project.py, callgraph.py).
+
+The fixture package exercises exactly the resolution features
+docs/static_analysis.md guarantees: relative imports, ``import as``
+aliasing, re-exports through ``__init__.py``, class methods with base
+classes, constructor-typed locals, and an import cycle — plus the
+degradation contract: an edge the index cannot follow produces NO edge
+and NO finding, never a false positive.
+"""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.arealint import scan_sources  # noqa: E402
+from tools.arealint.callgraph import (  # noqa: E402
+    build_call_graph, thread_context,
+)
+from tools.arealint.project import Project  # noqa: E402
+
+pytestmark = pytest.mark.arealint
+
+
+def dedent(s):
+    return textwrap.dedent(s).lstrip()
+
+
+# The fixture package: pkg/{__init__,core,util,alias_user,cyc_a,cyc_b}.py
+FIXTURE = {
+    "pkg/__init__.py": dedent(
+        """
+        from pkg.core import Engine, run_step
+        from pkg.util import helper as exported_helper
+        """
+    ),
+    "pkg/core.py": dedent(
+        """
+        from . import util
+        from .util import helper, helper as h2
+
+        class Base:
+            def shared(self):
+                return util.leaf()
+
+        class Engine(Base):
+            def step(self, x):
+                self.prep(x)
+                return helper(x)
+
+            def prep(self, x):
+                return h2(x)
+
+            def dyn(self, x):
+                return x.whatever()       # unresolvable: no edge
+
+        def run_step(e, x):
+            eng = Engine()
+            eng.step(x)
+            e.step(x)                     # untyped param: no edge
+            return external_lib.call(x)   # unresolvable: no edge
+        """
+    ),
+    "pkg/util.py": dedent(
+        """
+        def helper(x):
+            return leaf()
+
+        def leaf():
+            return 1
+        """
+    ),
+    "pkg/alias_user.py": dedent(
+        """
+        import pkg.util as u
+        from pkg import exported_helper
+
+        def use_alias(x):
+            u.helper(x)
+            exported_helper(x)
+        """
+    ),
+    "pkg/cyc_a.py": dedent(
+        """
+        from pkg import cyc_b
+
+        def ping(n):
+            return cyc_b.pong(n)
+        """
+    ),
+    "pkg/cyc_b.py": dedent(
+        """
+        from pkg import cyc_a
+
+        def pong(n):
+            return cyc_a.ping(n - 1)
+        """
+    ),
+}
+
+
+@pytest.fixture(scope="module")
+def graph():
+    proj = Project.from_sources(FIXTURE)
+    return build_call_graph(proj)
+
+
+class TestResolution:
+    def test_module_names_and_index(self, graph):
+        proj = graph.project
+        assert set(proj.modules) == {
+            "pkg", "pkg.core", "pkg.util", "pkg.alias_user",
+            "pkg.cyc_a", "pkg.cyc_b",
+        }
+        assert proj.function("pkg.util.helper") is not None
+        assert proj.function("pkg.core.Engine.step") is not None
+
+    def test_relative_and_from_imports(self, graph):
+        # core.Engine.step -> util.helper via ``from .util import helper``
+        assert "pkg.util.helper" in graph.edges["pkg.core.Engine.step"]
+        # core.Engine.prep -> util.helper via the ``as h2`` alias
+        assert "pkg.util.helper" in graph.edges["pkg.core.Engine.prep"]
+        # Base.shared -> util.leaf via ``from . import util``
+        assert "pkg.util.leaf" in graph.edges["pkg.core.Base.shared"]
+
+    def test_self_method_edges(self, graph):
+        assert "pkg.core.Engine.prep" in graph.edges["pkg.core.Engine.step"]
+
+    def test_import_as_module_alias(self, graph):
+        # ``import pkg.util as u`` then ``u.helper(x)``
+        assert "pkg.util.helper" in graph.edges["pkg.alias_user.use_alias"]
+
+    def test_reexport_through_init(self, graph):
+        # ``from pkg import exported_helper`` follows the __init__ alias
+        # chain back to pkg.util.helper
+        assert "pkg.util.helper" in graph.edges["pkg.alias_user.use_alias"]
+        assert graph.project.resolve("pkg.exported_helper") == (
+            "pkg.util.helper"
+        )
+        assert graph.project.resolve("pkg.Engine") == "pkg.core.Engine"
+
+    def test_constructor_typed_local(self, graph):
+        # ``eng = Engine(); eng.step(x)`` resolves through the local type
+        assert "pkg.core.Engine.step" in graph.edges["pkg.core.run_step"]
+
+    def test_import_cycle_resolves_without_hanging(self, graph):
+        assert "pkg.cyc_b.pong" in graph.edges["pkg.cyc_a.ping"]
+        assert "pkg.cyc_a.ping" in graph.edges["pkg.cyc_b.pong"]
+        # reachability across the cycle terminates
+        reach = graph.reachable(["pkg.cyc_a.ping"])
+        assert {"pkg.cyc_a.ping", "pkg.cyc_b.pong"} <= reach
+
+    def test_beyond_top_relative_import_degrades(self):
+        # ``from .. import util`` in the ROOT package walks past the top
+        # of the tree (ImportError at runtime) — it must not bind, and
+        # calls through it must not fabricate edges
+        proj = Project.from_sources({
+            "pkg/__init__.py": "from .. import util\n",
+            "pkg/util.py": "def f():\n    return 1\n",
+            "pkg/user.py": dedent(
+                """
+                from pkg import util
+
+                def g():
+                    return util.f()
+                """
+            ),
+        })
+        assert "util" not in proj.modules["pkg"].imports
+        # the legitimate import in user.py still resolves
+        g = build_call_graph(proj)
+        assert "pkg.util.f" in g.edges["pkg.user.g"]
+
+    def test_unresolvable_degrades_to_no_edge(self, graph):
+        edges = graph.edges.get("pkg.core.run_step", set())
+        # external_lib.call and the untyped e.step produce no edges
+        assert not any("external_lib" in e for e in edges)
+        unresolved = graph.unresolved.get("pkg.core.run_step", set())
+        assert "external_lib.call" in unresolved
+        # dynamic attribute call: no edge from dyn
+        assert "pkg.core.Engine.dyn" not in graph.edges or not any(
+            "whatever" in e for e in graph.edges["pkg.core.Engine.dyn"]
+        )
+
+
+class TestRootInference:
+    def test_sibling_prefix_dirs_share_one_root(self, tmp_path):
+        """/x/foo and /x/foobar must anchor at /x — a string-prefix
+        common-parent would pick /x/foo and silently break every
+        cross-package edge."""
+        for rel, src in {
+            "foo/__init__.py": "",
+            "foo/a.py": "from foobar.b import f\ndef g(x):\n    return f(x)\n",
+            "foobar/__init__.py": "",
+            "foobar/b.py": "def f(x):\n    return x\n",
+        }.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(src)
+        proj = Project.from_paths([str(tmp_path)])
+        assert proj.resolve("foobar.b.f") == "foobar.b.f"
+        g = build_call_graph(proj)
+        assert "foobar.b.f" in g.edges.get("foo.a.g", set())
+
+
+class TestDegradationNoFindings:
+    def test_unresolvable_hot_path_stays_quiet(self):
+        """A hot root whose callee cannot be resolved produces NO
+        cross-module finding — unresolved edges degrade, they do not
+        guess."""
+        srcs = {
+            "a.py": dedent(
+                """
+                import jax
+                from vendor_lib import mystery
+
+                def step(x):  # arealint: hot
+                    return mystery(x)
+                """
+            ),
+            "b.py": dedent(
+                """
+                import jax
+
+                def mystery(x):
+                    return jax.device_get(x)
+                """
+            ),
+        }
+        # b.mystery is NOT what a.step calls (a imports vendor_lib's), so
+        # no cross-module finding may appear
+        found = [
+            f for f in scan_sources(srcs)
+            if f.rule == "host-sync-cross-module"
+        ]
+        assert found == []
+
+    def test_resolvable_version_fires(self):
+        srcs = {
+            "a.py": dedent(
+                """
+                import jax
+                from b import mystery
+
+                def step(x):  # arealint: hot
+                    return mystery(x)
+                """
+            ),
+            "b.py": dedent(
+                """
+                import jax
+
+                def mystery(x):
+                    return jax.device_get(x)
+                """
+            ),
+        }
+        found = [
+            f for f in scan_sources(srcs)
+            if f.rule == "host-sync-cross-module"
+        ]
+        assert len(found) == 1 and found[0].path == "b.py"
+
+
+class TestThreadContext:
+    def test_thread_target_closure(self):
+        srcs = {
+            "w.py": dedent(
+                """
+                import threading
+
+                class Worker:
+                    def start(self):
+                        self._t = threading.Thread(target=self._loop)
+                        self._t.start()
+
+                    def _loop(self):
+                        tick()
+
+                def tick():
+                    pass
+
+                async def consume():
+                    pass
+                """
+            ),
+        }
+        proj = Project.from_sources(srcs)
+        g = build_call_graph(proj)
+        assert g.thread_entries == {"w.Worker._loop"}
+        ctx = thread_context(g)
+        assert "w.tick" in ctx
+        assert "w.consume" not in ctx
+
+    def test_local_def_target(self):
+        srcs = {
+            "l.py": dedent(
+                """
+                import threading
+
+                def spawn():
+                    def runner():
+                        work()
+                    t = threading.Thread(target=runner)
+                    t.start()
+
+                def work():
+                    pass
+                """
+            ),
+        }
+        g = build_call_graph(Project.from_sources(srcs))
+        assert any(".<local>.runner" in e for e in g.thread_entries)
+        assert "l.work" in thread_context(g)

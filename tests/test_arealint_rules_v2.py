@@ -1,0 +1,659 @@
+"""Fixture tests for the arealint v2 rule families: concurrency/races
+(``rules_concurrency.py``) and cross-module dataflow
+(``rules_dataflow.py``).
+
+Every rule gets at least one positive fixture (fires on the bug
+pattern) and one negative (stays quiet on the idiomatic pattern) —
+the acceptance contract from docs/static_analysis.md. All fixtures run
+through ``scan_sources`` so BOTH layers (file + project) execute
+exactly as the CLI would.
+"""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.arealint import scan_sources  # noqa: E402
+
+pytestmark = pytest.mark.arealint
+
+
+def dedent(s):
+    return textwrap.dedent(s).lstrip()
+
+
+def rules_of(sources):
+    return [f.rule for f in scan_sources(sources)]
+
+
+def findings(sources, rule):
+    return [f for f in scan_sources(sources) if f.rule == rule]
+
+
+# ------------------------------------------------------------------ #
+# thread-unsafe-shared-state
+# ------------------------------------------------------------------ #
+
+
+class TestThreadUnsafeSharedState:
+    def test_fires_on_unlocked_thread_write_async_read(self):
+        src = dedent(
+            """
+            import threading
+
+            class Exporter:
+                def start(self):
+                    self._t = threading.Thread(target=self._loop)
+                    self._t.start()
+
+                def _loop(self):
+                    self.latest = compute()
+
+                async def read(self):
+                    return self.latest
+            """
+        )
+        found = findings({"w.py": src}, "thread-unsafe-shared-state")
+        assert len(found) == 1
+        assert "self.latest" in found[0].message
+        assert "read()" in found[0].message
+
+    def test_fires_on_module_global(self):
+        src = dedent(
+            """
+            import threading
+
+            latest = None
+
+            def start():
+                threading.Thread(target=_loop).start()
+
+            def _loop():
+                global latest
+                latest = compute()
+
+            async def read():
+                return latest
+            """
+        )
+        found = findings({"g.py": src}, "thread-unsafe-shared-state")
+        assert len(found) == 1
+        assert "latest" in found[0].message
+
+    def test_quiet_when_async_local_shadows_global(self):
+        # assignment without ``global`` makes the name local — reading it
+        # is not a global read (Python scoping, not a data race)
+        src = dedent(
+            """
+            import threading
+
+            count = 0
+
+            def start():
+                threading.Thread(target=_loop).start()
+
+            def _loop():
+                global count
+                count = 1
+
+            async def consumer():
+                count = local_compute()
+                return count
+            """
+        )
+        assert findings({"g.py": src}, "thread-unsafe-shared-state") == []
+
+    def test_quiet_on_async_store_only(self):
+        # written-from-thread / READ-from-async is the contract; an
+        # async-side store must not be mis-cited as a read
+        src = dedent(
+            """
+            import threading
+
+            class C:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self.x = 1
+
+                async def reset(self):
+                    self.x = 0
+            """
+        )
+        assert findings({"w.py": src}, "thread-unsafe-shared-state") == []
+
+    def test_quiet_when_global_locked_on_both_sides(self):
+        src = dedent(
+            """
+            import threading
+
+            _lock = threading.Lock()
+            _state = None
+
+            def start():
+                threading.Thread(target=_loop).start()
+
+            def _loop():
+                global _state
+                with _lock:
+                    _state = compute()
+
+            async def read():
+                with _lock:
+                    return _state
+            """
+        )
+        assert findings({"g.py": src}, "thread-unsafe-shared-state") == []
+
+    def test_quiet_when_both_sides_locked(self):
+        src = dedent(
+            """
+            import threading
+
+            class Safe:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    with self._lock:
+                        self.latest = compute()
+
+                async def read(self):
+                    with self._lock:
+                        return self.latest
+            """
+        )
+        assert findings({"w.py": src}, "thread-unsafe-shared-state") == []
+
+    def test_quiet_when_lock_inherited_from_other_module(self):
+        # the lock lives in Base's module; this module cannot classify
+        # self._lock, so the unknown context manager counts as held
+        # (degrade-don't-guess, never a finding on correctly-locked code)
+        srcs = {
+            "base.py": dedent(
+                """
+                import threading
+
+                class Base:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                """
+            ),
+            "w.py": dedent(
+                """
+                import threading
+                from base import Base
+
+                class Exporter(Base):
+                    def start(self):
+                        threading.Thread(target=self._loop).start()
+
+                    def _loop(self):
+                        with self._lock:
+                            self.latest = compute()
+
+                    async def read(self):
+                        with self._lock:
+                            return self.latest
+                """
+            ),
+        }
+        assert findings(srcs, "thread-unsafe-shared-state") == []
+
+    def test_quiet_on_explicit_acquire_release(self):
+        # acquire()/release() bookending instead of ``with`` — the body
+        # conservatively counts as lock-held (no flow tracking needed to
+        # stay quiet on correctly-locked code)
+        src = dedent(
+            """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self._lock.acquire()
+                    self.state = compute()
+                    self._lock.release()
+
+                async def read(self):
+                    with self._lock:
+                        return self.state
+            """
+        )
+        assert findings({"w.py": src}, "thread-unsafe-shared-state") == []
+
+    def test_quiet_on_internally_synchronized_attrs(self):
+        # queue.Queue / threading.Event attrs are the sanctioned handoff
+        src = dedent(
+            """
+            import queue
+            import threading
+
+            class Handoff:
+                def __init__(self):
+                    self.q = queue.Queue()
+                    self.stop = threading.Event()
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self.q.put(compute())
+                    self.stop.set()
+
+                async def read(self):
+                    return self.q.get_nowait()
+            """
+        )
+        assert findings({"w.py": src}, "thread-unsafe-shared-state") == []
+
+    def test_inline_suppression_with_reason(self):
+        src = dedent(
+            """
+            import threading
+
+            class Flag:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self.done = True  # arealint: ok(monotonic bool flag, torn read impossible)
+
+                async def read(self):
+                    return self.done
+            """
+        )
+        assert findings({"w.py": src}, "thread-unsafe-shared-state") == []
+
+
+# ------------------------------------------------------------------ #
+# asyncio-from-thread
+# ------------------------------------------------------------------ #
+
+
+class TestAsyncioFromThread:
+    def test_fires_on_create_task_and_queue_and_call_soon(self):
+        src = dedent(
+            """
+            import asyncio
+            import threading
+
+            class Bridge:
+                def __init__(self, loop):
+                    self.q = asyncio.Queue()
+                    self.loop = loop
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    asyncio.create_task(work())
+                    self.q.put_nowait(1)
+                    self.loop.call_soon(cb)
+            """
+        )
+        found = findings({"b.py": src}, "asyncio-from-thread")
+        assert len(found) == 3
+        msgs = " | ".join(f.message for f in found)
+        assert "create_task" in msgs
+        assert "put_nowait" in msgs
+        assert "call_soon" in msgs
+
+    def test_call_soon_gated_on_loop_receiver(self):
+        # .call_soon on an arbitrary object is not asyncio; only
+        # loop-typed receivers fire
+        src = dedent(
+            """
+            import threading
+
+            class W:
+                def __init__(self, sched, loop):
+                    self.sched = sched
+                    self.loop = loop
+
+                def start(self):
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    self.sched.call_soon(tick)
+                    self.loop.call_soon(tick)
+            """
+        )
+        found = findings({"s.py": src}, "asyncio-from-thread")
+        assert len(found) == 1
+        assert "call_soon" in found[0].message
+
+    def test_nested_def_asyncio_run_does_not_exempt_outer(self):
+        # asyncio.run inside a nested def is a separate execution
+        # context; the outer thread target's create_task is still a race
+        src = dedent(
+            """
+            import asyncio
+            import threading
+
+            class B:
+                def start(self):
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    def bridge(coro):
+                        asyncio.run(coro)
+                    asyncio.create_task(work())
+            """
+        )
+        found = findings({"t.py": src}, "asyncio-from-thread")
+        assert len(found) == 1
+        assert "create_task" in found[0].message
+
+    def test_quiet_on_threadsafe_bridges_and_loop_starters(self):
+        src = dedent(
+            """
+            import asyncio
+            import threading
+
+            class Good:
+                def __init__(self, loop):
+                    self.q = asyncio.Queue()
+                    self.loop = loop
+
+                def start(self):
+                    threading.Thread(target=self._bridge).start()
+                    threading.Thread(target=self._own_loop).start()
+
+                def _bridge(self):
+                    asyncio.run_coroutine_threadsafe(work(), self.loop)
+                    self.loop.call_soon_threadsafe(cb)
+
+                def _own_loop(self):
+                    # starts its own loop: everything below runs in it
+                    asyncio.run(main())
+
+                async def consume(self):
+                    # loop context: asyncio primitives are fine here
+                    await self.q.get()
+                    asyncio.create_task(work())
+            """
+        )
+        # (the discarded create_task in consume() is a DIFFERENT rule)
+        assert findings({"b.py": src}, "asyncio-from-thread") == []
+
+
+# ------------------------------------------------------------------ #
+# lock-order
+# ------------------------------------------------------------------ #
+
+
+class TestLockOrder:
+    def test_fires_on_lexical_abba(self):
+        src = dedent(
+            """
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def one():
+                with A:
+                    with B:
+                        pass
+
+            def two():
+                with B:
+                    with A:
+                        pass
+            """
+        )
+        found = findings({"l.py": src}, "lock-order")
+        assert len(found) == 2  # both sides of the cycle are reported
+        assert all("reverse order" in f.message for f in found)
+
+    def test_fires_across_calls(self):
+        # one() holds A and calls helper() which takes B; two() nests
+        # B-then-A directly — the cycle is only visible through the graph
+        src = dedent(
+            """
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def helper():
+                with B:
+                    pass
+
+            def one():
+                with A:
+                    helper()
+
+            def two():
+                with B:
+                    with A:
+                        pass
+            """
+        )
+        found = findings({"l.py": src}, "lock-order")
+        assert found, "cross-call ABBA must be detected"
+
+    def test_quiet_on_consistent_order(self):
+        src = dedent(
+            """
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def one():
+                with A:
+                    with B:
+                        pass
+
+            def two():
+                with A:
+                    with B:
+                        pass
+            """
+        )
+        assert findings({"l.py": src}, "lock-order") == []
+
+
+# ------------------------------------------------------------------ #
+# await-in-lock (file rule)
+# ------------------------------------------------------------------ #
+
+
+class TestAwaitInLock:
+    def test_fires_on_await_under_threading_lock(self):
+        src = dedent(
+            """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                async def bad(self):
+                    with self._lock:
+                        await fetch()
+            """
+        )
+        found = findings({"c.py": src}, "await-in-lock")
+        assert len(found) == 1
+        assert "_lock" in found[0].message
+
+    def test_quiet_on_asyncio_lock_and_await_outside(self):
+        src = dedent(
+            """
+            import asyncio
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._alock = asyncio.Lock()
+                    self._tlock = threading.Lock()
+
+                async def good(self):
+                    async with self._alock:
+                        await fetch()
+                    with self._tlock:
+                        x = quick()
+                    await push(x)
+            """
+        )
+        assert findings({"c.py": src}, "await-in-lock") == []
+
+
+# ------------------------------------------------------------------ #
+# donation-cross-call
+# ------------------------------------------------------------------ #
+
+
+class TestDonationCrossCall:
+    def test_fires_when_helper_donates_callers_variable(self):
+        src = dedent(
+            """
+            import jax
+
+            def helper(params, grads):
+                step = jax.jit(apply, donate_argnums=(0,))
+                return step(params, grads)
+
+            def train(params, grads):
+                new = helper(params, grads)
+                return params
+            """
+        )
+        found = findings({"t.py": src}, "donation-cross-call")
+        assert len(found) == 1
+        assert "'params'" in found[0].message
+        assert "helper()" in found[0].message
+
+    def test_quiet_when_helper_rebinds_param_before_donating(self):
+        # the helper donates its OWN rebound buffer, not the caller's
+        src = dedent(
+            """
+            import jax
+
+            def helper(x):
+                jf = jax.jit(f, donate_argnums=(0,))
+                x = x * 2
+                return jf(x)
+
+            def caller(a):
+                y = helper(a)
+                return a + y
+            """
+        )
+        assert findings({"t.py": src}, "donation-cross-call") == []
+
+    def test_quiet_when_rebound_at_call(self):
+        src = dedent(
+            """
+            import jax
+
+            def helper(params, grads):
+                step = jax.jit(apply, donate_argnums=(0,))
+                return step(params, grads)
+
+            def train(params, grads):
+                params = helper(params, grads)
+                return params
+            """
+        )
+        assert findings({"t.py": src}, "donation-cross-call") == []
+
+    def test_fires_when_stored_alias_survives_donation(self):
+        src = dedent(
+            """
+            import jax
+
+            class Cache:
+                def keep(self, p):
+                    self.snapshot = p
+
+            def run(cache: Cache, params, grads):
+                cache.keep(params)
+                step = jax.jit(apply, donate_argnums=(0,))
+                return step(params, grads)
+            """
+        )
+        found = findings({"s.py": src}, "donation-cross-call")
+        assert len(found) == 1
+        assert "stored" in found[0].message
+
+    def test_quiet_when_helper_does_not_store(self):
+        src = dedent(
+            """
+            import jax
+
+            class Cache:
+                def note(self, p):
+                    return p.shape
+
+            def run(cache: Cache, params, grads):
+                cache.note(params)
+                step = jax.jit(apply, donate_argnums=(0,))
+                return step(params, grads)
+            """
+        )
+        assert findings({"s.py": src}, "donation-cross-call") == []
+
+
+# ------------------------------------------------------------------ #
+# jit-weak-type-drift
+# ------------------------------------------------------------------ #
+
+
+class TestJitWeakTypeDrift:
+    def test_fires_when_sites_disagree_on_literalness(self):
+        src = dedent(
+            """
+            import jax
+
+            @jax.jit
+            def scale(x, f):
+                return x * f
+
+            def a(x):
+                return scale(x, 0.5)
+
+            def b(x, f):
+                return scale(x, f)
+            """
+        )
+        found = findings({"j.py": src}, "jit-weak-type-drift")
+        assert len(found) == 1
+        assert "float literal" in found[0].message
+        assert found[0].severity == "warn"
+
+    def test_quiet_when_sites_agree(self):
+        src = dedent(
+            """
+            import jax
+
+            @jax.jit
+            def scale(x, f):
+                return x * f
+
+            def a(x, f):
+                return scale(x, f)
+
+            def b(x, g):
+                return scale(x, g)
+            """
+        )
+        assert findings({"j.py": src}, "jit-weak-type-drift") == []

@@ -124,3 +124,21 @@ def test_checker_flags_time_sleep_in_async():
     findings = [f for f in mod.scan_source(src) if f.rule == "sleep-in-async"]
     assert len(findings) == 3
     assert all("blocks the event loop" in f.message for f in findings)
+
+
+def test_stub_is_deprecated_but_forwards():
+    """The retired entry point still works (forwards to arealint's four
+    migrated rules) and says so: a deprecation notice on stderr, findings
+    + exit codes unchanged. Deleted one release after arealint v2."""
+    import subprocess
+    import sys
+
+    clean = os.path.join(REPO, "areal_tpu", "base", "faults.py")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_async_hygiene.py"),
+         clean],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "deprecated" in r.stderr
+    assert "python -m tools.arealint" in r.stderr

@@ -1,36 +1,60 @@
 """arealint — the repo's JAX/TPU-aware static-analysis framework.
 
-A rule-registry AST linter (stdlib-only, never imports repo code) that
-keeps the async-RL stack's performance and correctness invariants
-enforceable in tier-1 CI: async hygiene, host-sync-free hot paths,
-retrace/donation discipline, and the env-knob / counter / fault-point
-catalogs. See docs/static_analysis.md for the rule catalog and policies.
+A whole-program static analyzer (stdlib-only, never imports repo code)
+that keeps the async-RL stack's performance and correctness invariants
+enforceable in tier-1 CI. Two rule layers share one driver:
+
+- **file rules** — per-file AST checks (async hygiene, intra-file
+  host-sync/retrace/donation, env-knob / counter / fault-point
+  catalogs, await-in-lock);
+- **project rules** — whole-program checks over a cross-module,
+  name-qualified call graph (``project.py`` + ``callgraph.py``):
+  cross-module host-sync, thread/asyncio race rules, donation dataflow
+  across call boundaries, jit weak-type drift.
+
+See docs/static_analysis.md for the rule catalog, call-graph semantics,
+and severity policy.
 
 Usage::
 
-    python -m tools.arealint [paths...] [--format json]
-    from tools.arealint import scan_paths, scan_source, RULES
+    python -m tools.arealint [paths...] [--format json|sarif] [--jobs N]
+    from tools.arealint import scan_paths, scan_source, scan_sources
 """
 
 from tools.arealint.core import (  # noqa: F401
     Config,
     Finding,
+    PROJECT_RULES,
     RULES,
     Rule,
     SEVERITY_ERROR,
     SEVERITY_WARN,
+    all_rules,
     default_config,
     default_repo_root,
+    excluded_rules_for_path,
     has_errors,
+    is_test_path,
+    project_rule,
     rule,
     scan_paths,
+    scan_project_files,
     scan_source,
+    scan_sources,
+)
+from tools.arealint.project import Project  # noqa: F401
+from tools.arealint.callgraph import (  # noqa: F401
+    CallGraph,
+    build_call_graph,
+    thread_context,
 )
 
 # Importing the rule modules registers their rules.
 from tools.arealint import rules_async  # noqa: E402,F401
 from tools.arealint import rules_jax  # noqa: E402,F401
 from tools.arealint import rules_hygiene  # noqa: E402,F401
+from tools.arealint import rules_concurrency  # noqa: E402,F401
+from tools.arealint import rules_dataflow  # noqa: E402,F401
 
 from tools.arealint.baseline import (  # noqa: F401
     DEFAULT_BASELINE,

@@ -5,39 +5,56 @@ Exit codes (stable — CI keys off them):
 - ``0`` — clean, or only ``warn``-severity findings
 - ``1`` — at least one ``error``-severity finding survived the baseline
 - ``2`` — usage error (bad flag, unknown rule, malformed baseline)
+
+The default scan covers the stack AND its tooling/tests (``areal_tpu/
+tools/ tests/``); test files run under the relaxed profile
+(docs/static_analysis.md "Path profiles"). ``--jobs N`` fans the
+per-file pass out over a process pool — output order is deterministic
+either way.
 """
 
 import argparse
 import json
+import os
 import pathlib
 import sys
 from typing import List, Optional
 
 from tools.arealint import (
-    DEFAULT_BASELINE, BaselineError, RULES, apply_baseline, default_repo_root,
-    load_baseline, scan_paths,
+    DEFAULT_BASELINE, BaselineError, RULES, all_rules, apply_baseline,
+    default_repo_root, load_baseline, scan_paths,
 )
 
-DEFAULT_PATHS = ["areal_tpu"]
+DEFAULT_PATHS = ["areal_tpu", "tools", "tests"]
+
+
+def _default_jobs() -> int:
+    # leave a core for the driver; the project pass is serial anyway
+    return max(1, min(4, (os.cpu_count() or 2) - 1))
 
 
 def _build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="python -m tools.arealint",
-        description="JAX/TPU-aware static analysis for the areal_tpu stack "
-        "(docs/static_analysis.md)",
+        description="JAX/TPU-aware whole-program static analysis for the "
+        "areal_tpu stack (docs/static_analysis.md)",
     )
     ap.add_argument(
         "paths", nargs="*", default=None,
         help=f"files/dirs to scan (default: {' '.join(DEFAULT_PATHS)})",
     )
     ap.add_argument(
-        "--format", choices=("text", "json"), default="text",
-        help="output format (json is stable for tooling)",
+        "--format", choices=("text", "json", "sarif"), default="text",
+        help="output format (json/sarif are stable for tooling)",
     )
     ap.add_argument(
         "--rules", default=None,
         help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--jobs", type=int, default=None, metavar="N",
+        help="process-pool width for the per-file pass "
+        f"(default: {_default_jobs()} on this machine; 1 = serial)",
     )
     ap.add_argument(
         "--baseline", default=None,
@@ -48,8 +65,12 @@ def _build_parser() -> argparse.ArgumentParser:
         help="ignore the baseline (report every finding)",
     )
     ap.add_argument(
+        "--no-project", action="store_true",
+        help="skip the whole-program pass (file rules only)",
+    )
+    ap.add_argument(
         "--list-rules", action="store_true",
-        help="print the rule catalog and exit",
+        help="print the rule catalog (file + project rules) and exit",
     )
     return ap
 
@@ -58,26 +79,37 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = _build_parser()
     args = ap.parse_args(argv)  # argparse exits 2 on usage errors
 
+    catalog = all_rules()
     if args.list_rules:
-        width = max(len(r) for r in RULES)
-        for r in RULES.values():
-            print(f"{r.id:<{width}}  {r.severity:<5}  {r.doc}")
+        width = max(len(r) for r in catalog)
+        for rid in sorted(catalog):
+            r = catalog[rid]
+            kind = "file" if rid in RULES else "project"
+            print(f"{r.id:<{width}}  {r.severity:<5}  {kind:<7}  {r.doc}")
         return 0
 
     rules = None
     if args.rules:
         rules = [r.strip() for r in args.rules.split(",") if r.strip()]
-        unknown = [r for r in rules if r not in RULES]
+        unknown = [r for r in rules if r not in catalog]
         if unknown:
             print(
                 f"unknown rule(s): {', '.join(unknown)} "
                 f"(see --list-rules)", file=sys.stderr,
             )
             return 2
+    if args.jobs is not None and args.jobs < 1:
+        print("--jobs must be >= 1", file=sys.stderr)
+        return 2
 
     root = default_repo_root()
     paths = args.paths or [str(root / p) for p in DEFAULT_PATHS]
-    findings = scan_paths(paths, rules=rules)
+    findings = scan_paths(
+        paths,
+        rules=rules,
+        jobs=args.jobs if args.jobs is not None else _default_jobs(),
+        project=not args.no_project,
+    )
 
     entries: List[dict] = []
     if not args.no_baseline:
@@ -104,6 +136,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             "errors": n_err,
             "warnings": n_warn,
         }, indent=2))
+    elif args.format == "sarif":
+        from tools.arealint import sarif
+
+        print(sarif.dumps(findings, root=root, rule_ids=rules))
     else:
         for f in findings:
             print(f"{f.path}:{f.line}: [{f.rule}] {f.severity}: {f.message}")

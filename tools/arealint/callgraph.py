@@ -1,0 +1,353 @@
+"""Cross-module, name-qualified call graph over a :class:`Project`.
+
+For every indexed function the builder resolves its call expressions to
+canonical qualified names using the module's import table plus a small,
+deliberately conservative local type pass:
+
+- ``f(...)``                  -> module function / import target
+- ``self.m(...)``/``cls.m``   -> method of the enclosing class (bases
+  followed when resolvable in the index)
+- ``mod.f(...)``, ``pkg.mod.Class.m(...)`` -> dotted walk through imports
+- ``Class(...)``              -> ``Class.__init__`` when indexed
+- ``x.m(...)`` where ``x`` was assigned ``Class(...)`` in the same
+  function, or is a parameter annotated ``x: Class`` -> ``Class.m``
+- ``self.attr.m(...)`` where the class assigns
+  ``self.attr = Class(...)`` anywhere -> ``Class.m``
+
+Anything else (external callables, dynamic dispatch, star imports)
+lands in ``unresolved`` — downstream rules treat an unresolved edge as
+"no edge", never as a finding (docs/static_analysis.md).
+
+Thread/async entry discovery also lives here because both need the same
+resolution machinery: ``threading.Thread(target=...)`` targets (and
+``executor.submit(fn, ...)``-style escapes are NOT included — only real
+thread spawns) seed the thread-context closure used by
+:mod:`tools.arealint.rules_concurrency`.
+"""
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set
+
+from tools.arealint.core import walk_excluding_nested
+from tools.arealint.project import (
+    FunctionInfo, ModuleInfo, Project, _dotted,
+)
+
+
+@dataclasses.dataclass
+class CallSite:
+    caller: str              # canonical qualname of the calling function
+    callee: str              # canonical qualname of the resolved target
+    node: ast.Call
+    path: str
+    line: int
+
+
+class CallGraph:
+    def __init__(self, project: Project):
+        self.project = project
+        self.edges: Dict[str, Set[str]] = {}           # caller -> callees
+        self.redges: Dict[str, Set[str]] = {}          # callee -> callers
+        self.sites: List[CallSite] = []
+        self.sites_by_caller: Dict[str, List[CallSite]] = {}
+        self.sites_by_callee: Dict[str, List[CallSite]] = {}
+        # calls that could not be resolved, per caller (bookkeeping only)
+        self.unresolved: Dict[str, Set[str]] = {}
+        # thread entry points: functions handed to threading.Thread(target=)
+        self.thread_entries: Set[str] = set()
+        # synthesized nodes for local-def thread targets
+        # ("caller.<local>.name" -> FunctionInfo)
+        self.local_functions: Dict[str, FunctionInfo] = {}
+
+    def function(self, qualname: str) -> Optional[FunctionInfo]:
+        """FunctionInfo for any graph node, including synthesized
+        local-def thread targets."""
+        return self.local_functions.get(qualname) or self.project.function(
+            qualname
+        )
+
+    # ----------------------------------------------------------------- #
+
+    def add_edge(self, caller: str, callee: str, node: ast.Call, path: str):
+        self.edges.setdefault(caller, set()).add(callee)
+        self.redges.setdefault(callee, set()).add(caller)
+        site = CallSite(caller, callee, node, path, node.lineno)
+        self.sites.append(site)
+        self.sites_by_caller.setdefault(caller, []).append(site)
+        self.sites_by_callee.setdefault(callee, []).append(site)
+
+    def reachable(self, roots: Iterable[str]) -> Set[str]:
+        """Transitive closure over resolved edges, roots included."""
+        seen: Set[str] = set()
+        work = [r for r in roots]
+        while work:
+            cur = work.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            work.extend(self.edges.get(cur, ()))
+        return seen
+
+    def callers_closure(self, targets: Iterable[str]) -> Set[str]:
+        """Everything that (transitively) calls one of ``targets``."""
+        seen: Set[str] = set()
+        work = [t for t in targets]
+        while work:
+            cur = work.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            work.extend(self.redges.get(cur, ()))
+        return seen
+
+
+# --------------------------------------------------------------------- #
+# builder
+# --------------------------------------------------------------------- #
+
+
+def build_call_graph(project: Project) -> CallGraph:
+    graph = CallGraph(project)
+    for mod in project.modules.values():
+        _scan_module(project, graph, mod)
+    return graph
+
+
+def _scan_module(project: Project, graph: CallGraph, mod: ModuleInfo):
+    # class attribute types: "Class.attr" -> resolved class qualname, from
+    # ``self.attr = Ctor(...)`` assignments anywhere in the class
+    attr_types: Dict[str, str] = {}
+    for ci in mod.classes.values():
+        for fi in ci.methods.values():
+            for node in ast.walk(fi.node):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and isinstance(node.targets[0].value, ast.Name)
+                    and node.targets[0].value.id == "self"
+                    and isinstance(node.value, ast.Call)
+                ):
+                    ctor = _resolve_ctor(project, mod, node.value)
+                    if ctor:
+                        attr_types[f"{ci.name}.{node.targets[0].attr}"] = ctor
+
+    for fi in _functions_of(mod):
+        _scan_function(project, graph, mod, fi, attr_types)
+        _scan_thread_targets(project, graph, mod, fi)
+
+
+def _functions_of(mod: ModuleInfo) -> Iterable[FunctionInfo]:
+    yield from mod.functions.values()
+    for ci in mod.classes.values():
+        yield from ci.methods.values()
+
+
+def _resolve_ctor(
+    project: Project, mod: ModuleInfo, call: ast.Call
+) -> Optional[str]:
+    """``Ctor(...)`` -> class qualname when the ctor resolves to an
+    indexed class."""
+    d = _dotted(call.func)
+    if not d:
+        return None
+    target = project.resolve_in_module(mod, d)
+    if target and project.class_info(target) is not None:
+        return target
+    return None
+
+
+def _local_types(
+    project: Project, mod: ModuleInfo, fi: FunctionInfo
+) -> Dict[str, str]:
+    """Conservative local var -> class qualname map: ``x = Class(...)``
+    assignments plus ``x: Class`` parameter annotations."""
+    types: Dict[str, str] = {}
+    args = fi.node.args
+    for a in list(args.args) + list(args.kwonlyargs) + list(
+        getattr(args, "posonlyargs", [])
+    ):
+        if a.annotation is not None:
+            d = _dotted(a.annotation)
+            if d:
+                target = project.resolve_in_module(mod, d)
+                if target and project.class_info(target) is not None:
+                    types[a.arg] = target
+    for node in ast.walk(fi.node):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Call)
+        ):
+            ctor = _resolve_ctor(project, mod, node.value)
+            if ctor:
+                types[node.targets[0].id] = ctor
+            else:
+                # reassigned to something unresolvable: drop the binding
+                types.pop(node.targets[0].id, None)
+    return types
+
+
+def _resolve_call(
+    project: Project,
+    mod: ModuleInfo,
+    fi: FunctionInfo,
+    call: ast.Call,
+    attr_types: Dict[str, str],
+    local_types: Dict[str, str],
+) -> Optional[str]:
+    """Canonical callee qualname, or None (degrade to no edge)."""
+    f = call.func
+    # self.m(...) / cls.m(...)
+    if (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Name)
+        and f.value.id in ("self", "cls")
+        and fi.class_name is not None
+    ):
+        ci = mod.classes.get(fi.class_name)
+        if ci is not None:
+            m = project._method(ci, f.attr)
+            if m is not None:
+                return m.qualname
+        return None
+    # self.attr.m(...) via recorded attribute types
+    if (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Attribute)
+        and isinstance(f.value.value, ast.Name)
+        and f.value.value.id == "self"
+        and fi.class_name is not None
+    ):
+        cls_q = attr_types.get(f"{fi.class_name}.{f.value.attr}")
+        if cls_q:
+            ci = project.class_info(cls_q)
+            if ci is not None:
+                m = project._method(ci, f.attr)
+                if m is not None:
+                    return m.qualname
+        return None
+    # x.m(...) via local type bindings
+    if (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Name)
+        and f.value.id in local_types
+    ):
+        ci = project.class_info(local_types[f.value.id])
+        if ci is not None:
+            m = project._method(ci, f.attr)
+            if m is not None:
+                return m.qualname
+        return None
+    d = _dotted(f)
+    if not d:
+        return None
+    target = project.resolve_in_module(mod, d)
+    if target is None:
+        return None
+    ci = project.class_info(target)
+    if ci is not None:
+        # instantiation -> __init__ when defined (else the class itself
+        # is recorded so reachability still crosses the ctor)
+        m = project._method(ci, "__init__")
+        return m.qualname if m is not None else target
+    if project.function(target) is not None:
+        return target
+    return None
+
+
+def _scan_function(
+    project: Project,
+    graph: CallGraph,
+    mod: ModuleInfo,
+    fi: FunctionInfo,
+    attr_types: Dict[str, str],
+):
+    local_types = _local_types(project, mod, fi)
+    for node in walk_excluding_nested(fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _resolve_call(
+            project, mod, fi, node, attr_types, local_types
+        )
+        if callee is not None:
+            graph.add_edge(fi.qualname, callee, node, mod.path)
+        else:
+            d = _dotted(node.func)
+            if d:
+                graph.unresolved.setdefault(fi.qualname, set()).add(d)
+
+
+# --------------------------------------------------------------------- #
+# thread targets
+# --------------------------------------------------------------------- #
+
+
+def _is_thread_ctor(mod: ModuleInfo, call: ast.Call) -> bool:
+    """``threading.Thread(...)`` / ``Thread(...)`` (from-import)."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread":
+        return isinstance(f.value, ast.Name) and f.value.id == "threading"
+    if isinstance(f, ast.Name) and f.id == "Thread":
+        return mod.imports.get("Thread", "").endswith("threading.Thread")
+    return False
+
+
+def _scan_thread_targets(
+    project: Project, graph: CallGraph, mod: ModuleInfo, fi: FunctionInfo
+):
+    """Record ``threading.Thread(target=X)`` targets as thread entries
+    (including local ``def`` targets, resolved by name against the
+    enclosing function's OWN nested defs)."""
+    nested = {
+        n.name: n for n in ast.walk(fi.node)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n is not fi.node
+    }
+    for node in ast.walk(fi.node):
+        if not (isinstance(node, ast.Call) and _is_thread_ctor(mod, node)):
+            continue
+        target = next(
+            (kw.value for kw in node.keywords if kw.arg == "target"), None
+        )
+        if target is None:
+            continue
+        # self._method
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and fi.class_name is not None
+        ):
+            ci = mod.classes.get(fi.class_name)
+            m = project._method(ci, target.attr) if ci else None
+            if m is not None:
+                graph.thread_entries.add(m.qualname)
+            continue
+        d = _dotted(target)
+        if d is None:
+            continue
+        if d in nested:
+            # local def target: synthesize a node id and wire its calls
+            q = f"{fi.qualname}.<local>.{d}"
+            graph.thread_entries.add(q)
+            local_fi = FunctionInfo(
+                qualname=q, module=mod.name, name=d,
+                class_name=fi.class_name, node=nested[d], path=mod.path,
+            )
+            graph.local_functions[q] = local_fi
+            _scan_function(project, graph, mod, local_fi, {})
+            continue
+        resolved = project.resolve_in_module(mod, d)
+        if resolved is not None and project.function(resolved) is not None:
+            graph.thread_entries.add(resolved)
+
+
+def thread_context(graph: CallGraph) -> Set[str]:
+    """Qualnames executing on a spawned thread: the reachability closure
+    from every ``Thread(target=...)`` entry. Functions that START their
+    own event loop (``asyncio.run``) re-enter async context and are NOT
+    excluded here — the concurrency rules handle that distinction."""
+    return graph.reachable(graph.thread_entries)

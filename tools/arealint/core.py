@@ -23,11 +23,12 @@ Design (docs/static_analysis.md):
 """
 
 import ast
+import concurrent.futures
 import dataclasses
 import pathlib
 import re
 from typing import (
-    Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple,
+    Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple,
 )
 
 SUPPRESS_RE = re.compile(r"#\s*arealint:\s*ok\(\s*(?P<reason>[^)]*?)\s*\)")
@@ -43,6 +44,48 @@ LEGACY_RULES = frozenset(
 
 SEVERITY_ERROR = "error"
 SEVERITY_WARN = "warn"
+
+# ------------------------------------------------------------------ #
+# Path profiles: test code runs under a relaxed ruleset — tests set env
+# knobs freely, build deliberate bug fixtures, clean tmp dirs, and never
+# run on a hot path, so these rules would only generate annotation noise
+# there. Everything NOT listed (async hygiene, the concurrency family,
+# donation dataflow) stays enforced in tests: a race in a test harness
+# wedges CI just as hard as one in the stack.
+# ------------------------------------------------------------------ #
+
+TEST_RELAXED_RULES = frozenset({
+    "env-knob",
+    "host-sync-in-hot-path",
+    "host-sync-cross-module",
+    "live-checkpoint-rmtree",
+    "retrace-hazard",
+    "unregistered-counter",
+    "unregistered-fault-point",
+    "suppression-missing-reason",
+})
+# The linter's own sources quote suppression tokens in rule docs and
+# docstrings; policing them there is self-noise.
+SELF_EXEMPT_RULES = frozenset({"suppression-missing-reason"})
+
+
+def is_test_path(path: str) -> bool:
+    p = "/" + str(path).replace("\\", "/")
+    return "/tests/" in p or "/test/" in p
+
+
+def _is_linter_path(path: str) -> bool:
+    return "/tools/arealint/" in "/" + str(path).replace("\\", "/")
+
+
+def excluded_rules_for_path(path: str) -> frozenset:
+    """Rule ids NOT applied to ``path`` (the tests profile and the
+    linter's self-exemption). Empty for regular stack code."""
+    if is_test_path(path):
+        return TEST_RELAXED_RULES
+    if _is_linter_path(path):
+        return SELF_EXEMPT_RULES
+    return frozenset()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -252,10 +295,76 @@ def rule(rule_id: str, severity: str, doc: str):
 
     def deco(fn: CheckFn) -> CheckFn:
         assert rule_id not in RULES, f"duplicate rule id {rule_id}"
+        assert rule_id not in PROJECT_RULES, f"duplicate rule id {rule_id}"
         RULES[rule_id] = Rule(rule_id, severity, doc, fn)
         return fn
 
     return deco
+
+
+# --------------------------------------------------------------------- #
+# Project (whole-program) rule registry
+# --------------------------------------------------------------------- #
+
+# A project rule sees the whole indexed file set at once (cross-module
+# call graph, thread/async contexts, donation dataflow) and yields
+# ``(path, lineno, message)`` triples. The driver applies the same
+# inline-suppression, baseline, and path-profile machinery as file rules.
+ProjectCheckFn = Callable[["ProjectContext"], Iterable[Tuple[str, int, str]]]
+
+
+@dataclasses.dataclass(frozen=True)
+class ProjectRule:
+    id: str
+    severity: str
+    doc: str
+    check: ProjectCheckFn
+
+
+PROJECT_RULES: Dict[str, ProjectRule] = {}
+
+
+def project_rule(rule_id: str, severity: str, doc: str):
+    assert severity in (SEVERITY_ERROR, SEVERITY_WARN), severity
+
+    def deco(fn: ProjectCheckFn) -> ProjectCheckFn:
+        assert rule_id not in RULES, f"duplicate rule id {rule_id}"
+        assert rule_id not in PROJECT_RULES, f"duplicate rule id {rule_id}"
+        PROJECT_RULES[rule_id] = ProjectRule(rule_id, severity, doc, fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> Dict[str, object]:
+    """File + project rules in one catalog (CLI ``--list-rules``,
+    ``--rules`` validation, SARIF rule metadata)."""
+    out: Dict[str, object] = dict(RULES)
+    out.update(PROJECT_RULES)
+    return out
+
+
+class ProjectContext:
+    """Whole-program state handed to every project rule: the index, the
+    call graph, the catalogs, and lazily-built per-file contexts (for
+    hot markers and suppression checks)."""
+
+    def __init__(self, project, graph, config: Config):
+        self.project = project
+        self.graph = graph
+        self.config = config
+        self._file_ctx: Dict[str, FileContext] = {}
+
+    def file_ctx(self, path: str) -> Optional[FileContext]:
+        posix = path.replace("\\", "/")
+        ctx = self._file_ctx.get(posix)
+        if ctx is None:
+            mod = self.project.by_path.get(posix)
+            if mod is None:
+                return None
+            ctx = FileContext(mod.src, mod.path, mod.tree, self.config)
+            self._file_ctx[posix] = ctx
+        return ctx
 
 
 # --------------------------------------------------------------------- #
@@ -286,10 +395,13 @@ def is_suppressed(ctx: FileContext, rule_id: str, lineno: int) -> bool:
 def _resolve_rules(rules: Optional[Sequence[str]]) -> List[Rule]:
     if rules is None:
         return list(RULES.values())
-    unknown = [r for r in rules if r not in RULES]
+    unknown = [
+        r for r in rules if r not in RULES and r not in PROJECT_RULES
+    ]
     if unknown:
         raise KeyError(f"unknown rule(s): {', '.join(unknown)}")
-    return [RULES[r] for r in rules]
+    # project-rule ids are valid selections but run in the project pass
+    return [RULES[r] for r in rules if r in RULES]
 
 
 def scan_source(
@@ -297,9 +409,13 @@ def scan_source(
     path: str = "<string>",
     rules: Optional[Sequence[str]] = None,
     config: Optional[Config] = None,
+    apply_profile: bool = True,
 ) -> List[Finding]:
     config = config if config is not None else default_config()
     selected = _resolve_rules(rules)
+    if apply_profile:
+        excluded = excluded_rules_for_path(path)
+        selected = [r for r in selected if r.id not in excluded]
     try:
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:
@@ -319,20 +435,157 @@ def scan_source(
     return out
 
 
+def _collect_files(paths: Iterable) -> List[pathlib.Path]:
+    files: List[pathlib.Path] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
+    return files
+
+
+def _scan_file_worker(args) -> Tuple[str, str, List[Finding]]:
+    """Process-pool worker: re-triggers rule registration (spawn-safe),
+    then scans one file. Returns ``(path, src, findings)`` — the source
+    rides back so the parent's project pass doesn't re-read the tree."""
+    path, rules, config = args
+    import tools.arealint  # noqa: F401  (registers every rule module)
+
+    src = pathlib.Path(path).read_text()
+    return path, src, scan_source(src, path, rules=rules, config=config)
+
+
 def scan_paths(
     paths: Iterable,
     rules: Optional[Sequence[str]] = None,
     config: Optional[Config] = None,
+    jobs: int = 1,
+    project: bool = True,
 ) -> List[Finding]:
+    """Scan ``paths``: per-file rules (optionally on a process pool) plus
+    the whole-program rules over the same file set. Output order is
+    deterministic regardless of ``jobs``: sorted by (path, line, rule).
+    """
+    config = config if config is not None else default_config()
+    files = _collect_files(paths)
     findings: List[Finding] = []
-    for p in paths:
-        p = pathlib.Path(p)
-        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+    read_sources: Dict[str, str] = {}
+    if jobs > 1 and len(files) > 1:
+        work = [(str(f), rules, config) for f in files]
+        with concurrent.futures.ProcessPoolExecutor(
+            max_workers=min(jobs, len(files))
+        ) as pool:
+            # map preserves submission order -> deterministic output
+            for path, src, result in pool.map(
+                _scan_file_worker, work,
+                chunksize=max(1, len(work) // (jobs * 4)),
+            ):
+                read_sources[path] = src
+                findings.extend(result)
+    else:
         for f in files:
+            src = f.read_text()
+            read_sources[str(f)] = src
             findings.extend(
-                scan_source(f.read_text(), str(f), rules=rules, config=config)
+                scan_source(src, str(f), rules=rules, config=config)
             )
+    if project:
+        findings.extend(
+            scan_project_files(
+                files, rules=rules, config=config,
+                sources=read_sources or None,
+            )
+        )
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
+
+
+def _resolve_project_rules(
+    rules: Optional[Sequence[str]],
+) -> List[ProjectRule]:
+    if rules is None:
+        return list(PROJECT_RULES.values())
+    return [PROJECT_RULES[r] for r in rules if r in PROJECT_RULES]
+
+
+def scan_project_files(
+    files: Sequence,
+    rules: Optional[Sequence[str]] = None,
+    config: Optional[Config] = None,
+    root: Optional[pathlib.Path] = None,
+    sources: Optional[Dict[str, str]] = None,
+) -> List[Finding]:
+    """Run the whole-program rules over a file set. ``root`` anchors
+    dotted module names (defaults to the config's repo root);
+    ``sources`` forwards already-read file text to skip re-reading."""
+    from tools.arealint.callgraph import build_call_graph
+    from tools.arealint.project import Project
+
+    config = config if config is not None else default_config()
+    selected = _resolve_project_rules(rules)
+    if not selected or not files:
+        return []
+    if root is None:
+        root = config.repo_root or default_repo_root()
+        # a scan outside the repo (fixtures, ad-hoc trees) must anchor
+        # dotted module names at the scanned tree, not the repo — else
+        # every cross-module import fails to resolve and the project
+        # rules silently degrade to intra-file
+        resolved_root = pathlib.Path(root).resolve()
+        def _under_root(f):
+            try:
+                pathlib.Path(f).resolve().relative_to(resolved_root)
+                return True
+            except ValueError:
+                return False
+        if not all(_under_root(f) for f in files):
+            root = None  # Project.from_paths falls back to common parent
+    proj = Project.from_paths(files, root=root, sources=sources)
+    pctx = ProjectContext(proj, build_call_graph(proj), config)
+    return run_project_rules(pctx, selected)
+
+
+def scan_sources(
+    sources: Dict[str, str],
+    rules: Optional[Sequence[str]] = None,
+    config: Optional[Config] = None,
+) -> List[Finding]:
+    """Fixture-friendly whole-program scan: ``{relpath: src}`` becomes a
+    synthetic project rooted at ``/proj`` and BOTH rule layers run.
+    Used by the rule tests; file paths in findings are root-relative."""
+    from tools.arealint.callgraph import build_call_graph
+    from tools.arealint.project import Project
+
+    config = config if config is not None else default_config()
+    findings: List[Finding] = []
+    for rel in sorted(sources):
+        findings.extend(
+            scan_source(sources[rel], rel, rules=rules, config=config)
+        )
+    proj = Project.from_sources(sources)
+    pctx = ProjectContext(proj, build_call_graph(proj), config)
+    root_prefix = str(proj.root).replace("\\", "/").rstrip("/") + "/"
+    for f in run_project_rules(pctx, _resolve_project_rules(rules)):
+        p = f.path[len(root_prefix):] if f.path.startswith(root_prefix) else f.path
+        findings.append(dataclasses.replace(f, path=p))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def run_project_rules(
+    pctx: ProjectContext, selected: Sequence[ProjectRule]
+) -> List[Finding]:
+    out: List[Finding] = []
+    for r in selected:
+        for path, lineno, message in r.check(pctx):
+            posix = path.replace("\\", "/")
+            if r.id in excluded_rules_for_path(posix):
+                continue
+            ctx = pctx.file_ctx(posix)
+            if ctx is not None and is_suppressed(ctx, r.id, lineno):
+                continue
+            out.append(Finding(posix, lineno, r.id, message, r.severity))
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
 
 
 def has_errors(findings: Iterable[Finding]) -> bool:

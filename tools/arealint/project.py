@@ -1,0 +1,403 @@
+"""Project indexer: parse a file set once, derive dotted module names,
+and build per-module symbol tables (functions, classes, imports,
+re-exports) that :mod:`tools.arealint.callgraph` resolves calls against.
+
+Everything stays stdlib-only and purely static (docs/static_analysis.md):
+imports are resolved by walking the INDEX, never by importing anything.
+Resolution is deliberately conservative — a name the index cannot follow
+(external library, dynamic attribute, star import) resolves to ``None``
+and downstream rules treat it as "no edge", never as a finding.
+
+What resolves (see docs/static_analysis.md "Call-graph semantics"):
+
+- ``import a.b.c`` / ``import a.b.c as x`` — binds ``a`` (or ``x``).
+- ``from a.b import c [as d]`` — module attribute OR submodule, decided
+  against the index.
+- ``from . import x`` / ``from ..mod import f`` — package-relative,
+  resolved against the importing module's package.
+- re-exports: ``__init__.py`` doing ``from .mod import f`` makes
+  ``pkg.f`` an alias of ``pkg.mod.f`` (chains followed with a cycle
+  guard).
+- classes: methods index as ``module.Class.method``; single-name base
+  classes resolvable in the index link method-resolution fallbacks.
+"""
+
+import ast
+import dataclasses
+import pathlib
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# Alias-chain / attribute-walk depth guard (import cycles, pathological
+# re-export chains).
+_MAX_HOPS = 32
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One indexed function/method."""
+
+    qualname: str            # "pkg.mod.func" or "pkg.mod.Class.method"
+    module: str              # "pkg.mod"
+    name: str                # bare name
+    class_name: Optional[str]
+    node: ast.AST            # FunctionDef | AsyncFunctionDef
+    path: str
+
+    @property
+    def is_method(self) -> bool:
+        return self.class_name is not None
+
+    @property
+    def is_async(self) -> bool:
+        return isinstance(self.node, ast.AsyncFunctionDef)
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    qualname: str            # "pkg.mod.Class"
+    module: str
+    name: str
+    node: ast.ClassDef
+    # single-name / dotted base expressions, unresolved (resolved lazily)
+    bases: List[str] = dataclasses.field(default_factory=list)
+    methods: Dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
+
+
+class ModuleInfo:
+    """One parsed module: tree + symbol table."""
+
+    def __init__(self, name: str, path: str, tree: ast.Module, src: str):
+        self.name = name
+        self.path = path
+        self.tree = tree
+        self.src = src
+        # local binding -> fully-qualified dotted target. Targets may name
+        # a module, a class, a function, or an attribute of any of those;
+        # the project's resolver decides which.
+        self.imports: Dict[str, str] = {}
+        self.functions: Dict[str, FunctionInfo] = {}   # bare name -> info
+        self.classes: Dict[str, ClassInfo] = {}        # bare name -> info
+        # module-level simple assignments: name -> value expression
+        self.assigns: Dict[str, ast.expr] = {}
+
+    @property
+    def package(self) -> str:
+        return self.name.rsplit(".", 1)[0] if "." in self.name else ""
+
+
+def module_name_for(path: pathlib.Path, root: pathlib.Path) -> Optional[str]:
+    """Dotted module name of ``path`` relative to ``root``
+    (``a/b/c.py`` -> ``a.b.c``; ``a/b/__init__.py`` -> ``a.b``).
+    None when the path is not under the root."""
+    try:
+        rel = path.resolve().relative_to(root.resolve())
+    except ValueError:
+        return None
+    parts = list(rel.parts)
+    if not parts or not parts[-1].endswith(".py"):
+        return None
+    parts[-1] = parts[-1][: -len(".py")]
+    if parts[-1] == "__init__":
+        parts.pop()
+    if not parts:
+        return None
+    return ".".join(parts)
+
+
+def _index_module(mod: ModuleInfo) -> None:
+    """Fill the symbol table from the module's top-level statements."""
+    for node in mod.tree.body:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    mod.imports[alias.asname] = alias.name
+                else:
+                    # ``import a.b.c`` binds the top package ``a``
+                    mod.imports[alias.name.split(".")[0]] = (
+                        alias.name.split(".")[0]
+                    )
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                # package-relative: level 1 = this package, 2 = parent, ...
+                pkg_parts = mod.name.split(".")
+                # a package __init__'s own name IS its package
+                cut = len(pkg_parts) - (
+                    node.level - 1 if _is_package_module(mod) else node.level
+                )
+                if cut <= 0:
+                    # walks past the top of the tree: invalid Python at
+                    # runtime — degrade to unresolvable, never guess
+                    continue
+                base = ".".join(pkg_parts[:cut] + ([base] if base else []))
+            for alias in node.names:
+                if alias.name == "*":
+                    continue  # star imports never resolve (degrade)
+                local = alias.asname or alias.name
+                mod.imports[local] = (
+                    f"{base}.{alias.name}" if base else alias.name
+                )
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod.functions[node.name] = FunctionInfo(
+                qualname=f"{mod.name}.{node.name}",
+                module=mod.name, name=node.name, class_name=None,
+                node=node, path=mod.path,
+            )
+        elif isinstance(node, ast.ClassDef):
+            ci = ClassInfo(
+                qualname=f"{mod.name}.{node.name}",
+                module=mod.name, name=node.name, node=node,
+                bases=[d for d in map(_dotted, node.bases) if d],
+            )
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    ci.methods[item.name] = FunctionInfo(
+                        qualname=f"{mod.name}.{node.name}.{item.name}",
+                        module=mod.name, name=item.name,
+                        class_name=node.name, node=item, path=mod.path,
+                    )
+            mod.classes[node.name] = ci
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                mod.assigns[t.id] = node.value
+
+
+def _is_package_module(mod: ModuleInfo) -> bool:
+    return mod.path.replace("\\", "/").endswith("/__init__.py")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+class Project:
+    """The indexed file set. Build with :meth:`from_paths` (real tree) or
+    :meth:`from_sources` (fixture dict, used by the rule tests)."""
+
+    def __init__(self, root: pathlib.Path):
+        self.root = pathlib.Path(root)
+        self.modules: Dict[str, ModuleInfo] = {}       # dotted name -> info
+        self.by_path: Dict[str, ModuleInfo] = {}       # posix path -> info
+        self.parse_errors: List[Tuple[str, int, str]] = []
+
+    # ----------------------------------------------------------------- #
+    # construction
+    # ----------------------------------------------------------------- #
+
+    @classmethod
+    def from_paths(
+        cls,
+        paths: Iterable,
+        root: Optional[pathlib.Path] = None,
+        sources: Optional[Dict[str, str]] = None,
+    ) -> "Project":
+        """Index every ``*.py`` under ``paths``. ``root`` anchors dotted
+        module names (defaults to the repo root heuristic: the common
+        parent of the given paths). ``sources`` maps path -> already-read
+        text so a caller that just scanned the files doesn't pay a second
+        round of file I/O."""
+        files: List[pathlib.Path] = []
+        for p in paths:
+            p = pathlib.Path(p)
+            files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
+        if root is None:
+            if files:
+                # common parent: handles both repo-root invocation and
+                # tests that point at a single fixture directory
+                root = pathlib.Path(
+                    _common_parent([f.resolve() for f in files])
+                )
+                # a package dir is not a valid anchor — dotted names
+                # would lose the package prefix and every ``from pkg
+                # import x`` would fail to resolve; walk up to the
+                # first non-package ancestor
+                while (
+                    (root / "__init__.py").is_file()
+                    and root.parent != root
+                ):
+                    root = root.parent
+            else:
+                root = pathlib.Path(".")
+        proj = cls(root)
+        for f in files:
+            src = (sources or {}).get(str(f))
+            if src is None:
+                try:
+                    src = f.read_text()
+                except OSError:
+                    continue
+            proj.add_source(str(f), src)
+        return proj
+
+    @classmethod
+    def from_sources(
+        cls, sources: Dict[str, str], root: str = "/proj"
+    ) -> "Project":
+        """Fixture constructor: ``{"pkg/mod.py": "src", ...}`` keyed by
+        root-relative posix paths."""
+        proj = cls(pathlib.Path(root))
+        for rel, src in sorted(sources.items()):
+            proj.add_source(str(pathlib.Path(root) / rel), src)
+        return proj
+
+    def add_source(self, path: str, src: str) -> Optional[ModuleInfo]:
+        posix = path.replace("\\", "/")
+        name = module_name_for(pathlib.Path(path), self.root)
+        if name is None:
+            # not under the root: index it as a standalone top-level module
+            name = pathlib.Path(posix).stem
+            if name == "__init__":
+                name = pathlib.Path(posix).parent.name or "__init__"
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            self.parse_errors.append((posix, e.lineno or 0, e.msg or ""))
+            return None
+        mod = ModuleInfo(name, posix, tree, src)
+        _index_module(mod)
+        self.modules[name] = mod
+        self.by_path[posix] = mod
+        return mod
+
+    # ----------------------------------------------------------------- #
+    # resolution
+    # ----------------------------------------------------------------- #
+
+    def resolve(self, dotted: str) -> Optional[str]:
+        """Canonical qualified name for an absolute dotted path: follows
+        re-export aliases until it lands on an indexed function, class, or
+        module. None when the chain leaves the index (external name) —
+        callers degrade to no-edge."""
+        seen: Set[str] = set()
+        cur = dotted
+        for _ in range(_MAX_HOPS):
+            if cur in seen:
+                return None  # alias cycle
+            seen.add(cur)
+            nxt = self._step(cur)
+            if nxt is None:
+                return None
+            if nxt == cur:
+                return cur
+            cur = nxt
+        return None
+
+    def _step(self, dotted: str) -> Optional[str]:
+        """One resolution hop: returns a fixed point when ``dotted`` is
+        canonical, a new dotted path to continue from, or None."""
+        if dotted in self.modules:
+            return dotted
+        if "." not in dotted:
+            return None
+        # find the longest module prefix, then walk attributes
+        parts = dotted.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod_name = ".".join(parts[:cut])
+            mod = self.modules.get(mod_name)
+            if mod is None:
+                continue
+            attr, rest = parts[cut], parts[cut + 1:]
+            if attr in mod.classes:
+                ci = mod.classes[attr]
+                if not rest:
+                    return ci.qualname
+                if len(rest) == 1 and rest[0] in ci.methods:
+                    return ci.methods[rest[0]].qualname
+                return None
+            if attr in mod.functions:
+                return mod.functions[attr].qualname if not rest else None
+            if attr in mod.imports:
+                # re-export: continue from the aliased target
+                return ".".join([mod.imports[attr]] + rest)
+            # maybe a submodule not explicitly imported
+            sub = f"{mod_name}.{attr}"
+            if sub in self.modules:
+                return ".".join([sub] + rest)
+            return None
+        return None
+
+    def resolve_in_module(
+        self, mod: ModuleInfo, dotted: str
+    ) -> Optional[str]:
+        """Resolve a (possibly dotted) name as seen from inside ``mod``:
+        local defs shadow imports, imports map to absolute targets."""
+        head, _, rest = dotted.partition(".")
+        target: Optional[str] = None
+        if head in mod.functions:
+            target = mod.functions[head].qualname
+        elif head in mod.classes:
+            target = mod.classes[head].qualname
+        elif head in mod.imports:
+            target = mod.imports[head]
+        else:
+            return None
+        full = f"{target}.{rest}" if rest else target
+        return self.resolve(full)
+
+    def function(self, qualname: str) -> Optional[FunctionInfo]:
+        """FunctionInfo for a canonical qualified name (module.func or
+        module.Class.method); follows base classes for missing methods."""
+        parts = qualname.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = self.modules.get(".".join(parts[:cut]))
+            if mod is None:
+                continue
+            rest = parts[cut:]
+            if len(rest) == 1:
+                return mod.functions.get(rest[0])
+            if len(rest) == 2 and rest[0] in mod.classes:
+                return self._method(mod.classes[rest[0]], rest[1])
+            return None
+        return None
+
+    def class_info(self, qualname: str) -> Optional[ClassInfo]:
+        parts = qualname.rsplit(".", 1)
+        if len(parts) != 2:
+            return None
+        mod = self.modules.get(parts[0])
+        return mod.classes.get(parts[1]) if mod else None
+
+    def _method(
+        self, ci: ClassInfo, name: str, _depth: int = 0
+    ) -> Optional[FunctionInfo]:
+        if name in ci.methods:
+            return ci.methods[name]
+        if _depth >= _MAX_HOPS:
+            return None
+        mod = self.modules.get(ci.module)
+        for base in ci.bases:
+            target = (
+                self.resolve_in_module(mod, base) if mod else None
+            )
+            if target is None:
+                continue
+            base_ci = self.class_info(target)
+            if base_ci is not None:
+                found = self._method(base_ci, name, _depth + 1)
+                if found is not None:
+                    return found
+        return None
+
+    def all_functions(self) -> Iterable[FunctionInfo]:
+        for mod in self.modules.values():
+            yield from mod.functions.values()
+            for ci in mod.classes.values():
+                yield from ci.methods.values()
+
+
+def _common_parent(paths: Sequence[pathlib.Path]) -> pathlib.Path:
+    # component-wise, not string-prefix: /x/foobar must NOT count as
+    # under /x/foo (a wrong root silently disables cross-module analysis)
+    parent = paths[0].parent
+    for p in paths[1:]:
+        while parent not in p.parents:
+            if parent.parent == parent:
+                return parent
+            parent = parent.parent
+    return parent

@@ -1,0 +1,738 @@
+"""Concurrency/race rules over the whole-program call graph.
+
+The async fleet braids three execution contexts through one process:
+**thread context** (``threading.Thread`` targets — telemetry exporters,
+prefetchers, watchdogs, heartbeats), **loop context** (``async def``
+bodies and their sync callees), and the main thread. The bug classes
+here are the ones that wedge a fleet or corrupt state silently
+(docs/fault_tolerance.md):
+
+- ``thread-unsafe-shared-state`` — instance/module state written from
+  thread context and read from async (loop) code with no lock on either
+  side: a torn read feeds the rollout loop stale or half-updated state.
+- ``asyncio-from-thread`` — asyncio primitives (``asyncio.Queue``,
+  ``create_task``, ``loop.call_soon``) touched from thread context:
+  asyncio's internals are not thread-safe; the only legal bridges are
+  ``run_coroutine_threadsafe`` / ``call_soon_threadsafe``.
+- ``lock-order`` — two ``threading`` locks acquired in opposite orders
+  on different paths (lexically or through calls): the classic ABBA
+  deadlock, invisible until the fleet is under load.
+- ``await-in-lock`` (file rule) — ``await`` while holding a
+  ``threading.Lock``: every other loop task contending for the lock
+  blocks the WHOLE event loop until the awaited I/O completes (and a
+  second contender awaiting inside deadlocks it outright).
+
+Context discovery is conservative (docs/static_analysis.md): thread
+context is the call-graph closure of ``Thread(target=...)`` entries
+traversed only through SYNC functions — an ``async def`` reached from a
+thread is being driven by a loop bridge (``asyncio.run`` /
+``run_coroutine_threadsafe``) and re-enters loop context, so neither it
+nor its callees are treated as thread code. Unresolvable targets and
+edges degrade to no-finding.
+"""
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.arealint.core import (
+    FileContext, ProjectContext, SEVERITY_ERROR, project_rule, rule,
+    walk_excluding_nested,
+)
+from tools.arealint.project import ModuleInfo
+
+# value-constructor classification for ``self.attr = Ctor(...)`` /
+# module-level ``name = Ctor(...)``
+_THREADING_LOCKS = ("Lock", "RLock", "Condition", "Semaphore",
+                    "BoundedSemaphore")
+_THREADSAFE_CTORS = {
+    # attrs of these kinds are internally synchronized: reading/writing
+    # THE ATTR's object from two contexts is their whole point
+    "threading": _THREADING_LOCKS + ("Event", "Barrier", "Thread", "local"),
+    "queue": ("Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"),
+    "collections": ("deque",),
+}
+_ASYNCIO_QUEUES = ("Queue", "LifoQueue", "PriorityQueue")
+_ASYNCIO_QUEUE_METHODS = ("put", "put_nowait", "get", "get_nowait",
+                          "task_done", "join")
+
+
+def _ctor_kind(mod: ModuleInfo, value: ast.expr) -> Optional[str]:
+    """'lock' | 'threadsafe' | 'asyncio_queue' | 'asyncio_sync' | None
+    for an assigned value expression."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    base, name = None, None
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        base, name = f.value.id, f.attr
+    elif isinstance(f, ast.Name):
+        target = mod.imports.get(f.id, "") if mod else ""
+        if "." in target:
+            base, name = target.rsplit(".", 1)
+    if base == "threading" and name in _THREADING_LOCKS:
+        return "lock"
+    if base in _THREADSAFE_CTORS and name in _THREADSAFE_CTORS.get(base, ()):
+        return "threadsafe"
+    if base == "asyncio" and name in _ASYNCIO_QUEUES:
+        return "asyncio_queue"
+    if base == "asyncio":
+        return "asyncio_sync"
+    return None
+
+
+class ModuleModel:
+    """Per-module concurrency facts: lock identities and attribute kinds."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        # lock id ("mod.Class.attr" / "mod.name") -> defining line
+        self.locks: Dict[str, int] = {}
+        # "Class.attr" -> kind (see _ctor_kind)
+        self.attr_kinds: Dict[str, str] = {}
+        for name, value in mod.assigns.items():
+            if _ctor_kind(mod, value) == "lock":
+                self.locks[f"{mod.name}.{name}"] = value.lineno
+        for ci in mod.classes.values():
+            for fi in ci.methods.values():
+                for node in ast.walk(fi.node):
+                    if not (
+                        isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Attribute)
+                        and isinstance(node.targets[0].value, ast.Name)
+                        and node.targets[0].value.id == "self"
+                    ):
+                        continue
+                    kind = _ctor_kind(mod, node.value)
+                    attr = node.targets[0].attr
+                    if kind is None:
+                        continue
+                    self.attr_kinds.setdefault(f"{ci.name}.{attr}", kind)
+                    if kind == "lock":
+                        self.locks.setdefault(
+                            f"{mod.name}.{ci.name}.{attr}", node.lineno
+                        )
+
+    def lock_id_for(self, expr: ast.expr, class_name: Optional[str]
+                    ) -> Optional[str]:
+        """The lock identity a ``with <expr>:`` acquires, or None."""
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and class_name is not None
+        ):
+            lid = f"{self.mod.name}.{class_name}.{expr.attr}"
+            return lid if lid in self.locks else None
+        if isinstance(expr, ast.Name):
+            lid = f"{self.mod.name}.{expr.id}"
+            return lid if lid in self.locks else None
+        return None
+
+    def may_be_lock(self, expr: ast.expr, class_name: Optional[str]) -> bool:
+        """Degrade-don't-guess companion to :meth:`lock_id_for` for the
+        shared-state rules: a ``with`` over a bare name or self/cls
+        attribute whose kind this module cannot classify (e.g. a lock
+        inherited from a base class in ANOTHER module, or one imported
+        from elsewhere) MAY be a lock, so accesses under it count as
+        held. Known non-lock kinds stay non-locks."""
+        if self.lock_id_for(expr, class_name) is not None:
+            return True
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in ("self", "cls")
+        ):
+            kind = (
+                self.attr_kinds.get(f"{class_name}.{expr.attr}")
+                if class_name else None
+            )
+            return kind is None or kind == "lock"
+        if isinstance(expr, ast.Name):
+            known = self.mod.assigns.get(expr.id)
+            return known is None or _ctor_kind(self.mod, known) == "lock"
+        return False
+
+
+def _models(pctx: ProjectContext) -> Dict[str, ModuleModel]:
+    cache = getattr(pctx, "_concurrency_models", None)
+    if cache is None:
+        cache = {
+            name: ModuleModel(mod)
+            for name, mod in pctx.project.modules.items()
+        }
+        pctx._concurrency_models = cache
+    return cache
+
+
+def sync_thread_context(pctx: ProjectContext) -> Set[str]:
+    """Qualnames whose bodies run on a spawned thread: closure of
+    ``Thread(target=...)`` entries, traversed through sync functions
+    only (async callees re-enter loop context via a bridge)."""
+    cached = getattr(pctx, "_sync_thread_ctx", None)
+    if cached is not None:
+        return cached
+    graph = pctx.graph
+    seen: Set[str] = set()
+    work = list(graph.thread_entries)
+    while work:
+        cur = work.pop()
+        if cur in seen:
+            continue
+        fi = graph.function(cur)
+        if fi is not None and fi.is_async:
+            continue  # loop context from here on
+        seen.add(cur)
+        work.extend(graph.edges.get(cur, ()))
+    pctx._sync_thread_ctx = seen
+    return seen
+
+
+def _uses_explicit_acquire(
+    fnode, model: ModuleModel, class_name: Optional[str]
+) -> bool:
+    """True when the function's own body calls ``.acquire()`` on a
+    possible lock. Flow tracking for acquire/release pairs is out of
+    scope — the whole body conservatively counts as lock-held instead
+    (degrade to no-finding, never flag correctly-locked code)."""
+    return any(
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "acquire"
+        and model.may_be_lock(node.func.value, class_name)
+        for node in walk_excluding_nested(fnode)
+    )
+
+
+def _self_accesses(
+    fnode, model: ModuleModel, class_name: str
+) -> Iterable[Tuple[str, str, int, bool]]:
+    """(attr, 'load'|'store', line, lock_held) for every ``self.X``
+    access in the function's own body (nested defs excluded; a nested
+    def is its own context). ``held`` uses :meth:`ModuleModel.may_be_lock`
+    — an unclassifiable context manager counts as held, so a lock
+    inherited from another module degrades to no-finding."""
+
+    def walk(node, held: bool):
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return
+        if isinstance(node, ast.With):
+            inner = held or any(
+                model.may_be_lock(item.context_expr, class_name)
+                for item in node.items
+            )
+            for item in node.items:
+                yield from walk(item, held)
+            for stmt in node.body:
+                yield from walk(stmt, inner)
+            return
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            kind = "store" if isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ) else "load"
+            yield (node.attr, kind, node.lineno, held)
+        for child in ast.iter_child_nodes(node):
+            yield from walk(child, held)
+
+    base = _uses_explicit_acquire(fnode, model, class_name)
+    for stmt in fnode.body:
+        yield from walk(stmt, base)
+
+
+def _name_accesses(
+    fnode, model: ModuleModel, class_name: Optional[str], names: Set[str]
+) -> Iterable[Tuple[str, str, int, bool]]:
+    """(name, 'load'|'store', line, lock_held) for every bare-Name access
+    of ``names`` in the function's own body — the module-global analogue
+    of :func:`_self_accesses`, tracking ``with <lock>:`` scopes with the
+    same :meth:`ModuleModel.may_be_lock` conservatism."""
+
+    def walk(node, held: bool):
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return
+        if isinstance(node, ast.With):
+            inner = held or any(
+                model.may_be_lock(item.context_expr, class_name)
+                for item in node.items
+            )
+            for item in node.items:
+                yield from walk(item, held)
+            for stmt in node.body:
+                yield from walk(stmt, inner)
+            return
+        if isinstance(node, ast.Name) and node.id in names:
+            kind = "store" if isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ) else "load"
+            yield (node.id, kind, node.lineno, held)
+        for child in ast.iter_child_nodes(node):
+            yield from walk(child, held)
+
+    base = _uses_explicit_acquire(fnode, model, class_name)
+    for stmt in fnode.body:
+        yield from walk(stmt, base)
+
+
+# --------------------------------------------------------------------- #
+# thread-unsafe-shared-state
+# --------------------------------------------------------------------- #
+
+
+@project_rule(
+    "thread-unsafe-shared-state", SEVERITY_ERROR,
+    "instance attribute written from a threading.Thread target and read "
+    "from async (event-loop) code with no lock on either side — torn/stale "
+    "reads feed the rollout loop silently",
+)
+def check_thread_shared_state(pctx: ProjectContext):
+    thread_ctx = sync_thread_context(pctx)
+    models = _models(pctx)
+    for mod_name, mod in pctx.project.modules.items():
+        model = models[mod_name]
+        for ci in mod.classes.values():
+            # accesses per attr from each context
+            thread_writes: Dict[str, Tuple[int, bool]] = {}
+            async_reads: Dict[str, Tuple[int, bool, str]] = {}
+            for fi in ci.methods.values():
+                in_thread = fi.qualname in thread_ctx
+                if not in_thread and not fi.is_async:
+                    continue
+                for attr, kind, line, held in _self_accesses(
+                    fi.node, model, ci.name
+                ):
+                    akind = model.attr_kinds.get(f"{ci.name}.{attr}")
+                    if akind in ("lock", "threadsafe", "asyncio_queue",
+                                 "asyncio_sync"):
+                        continue  # internally-synchronized objects
+                    if in_thread and kind == "store":
+                        # keep the UNheld write if any (that's the bug)
+                        prev = thread_writes.get(attr)
+                        if prev is None or (prev[1] and not held):
+                            thread_writes[attr] = (line, held)
+                    if fi.is_async and not in_thread and kind == "load":
+                        # loads only: the rule's contract is
+                        # written-from-thread / READ-from-async; a
+                        # store/store race would mis-cite a write line
+                        # as a read and misdirect the fix
+                        prev = async_reads.get(attr)
+                        if prev is None or (prev[1] and not held):
+                            async_reads[attr] = (line, held, fi.name)
+            for attr, (wline, wheld) in sorted(thread_writes.items()):
+                ar = async_reads.get(attr)
+                if ar is None:
+                    continue
+                rline, rheld, rname = ar
+                if wheld and rheld:
+                    continue  # both sides under a class lock
+                side = (
+                    "neither side holds a lock" if not (wheld or rheld)
+                    else ("the async reader takes no lock" if wheld
+                          else "the thread writer takes no lock")
+                )
+                yield (
+                    mod.path, wline,
+                    f"'self.{attr}' is written here on a Thread-target "
+                    f"path and read from async {rname}() (line {rline}) "
+                    f"— {side}; guard both sides with one threading.Lock, "
+                    "use a queue, or annotate a benign/monotonic flag "
+                    "with '# arealint: ok(<reason>)'",
+                )
+        # module-global variant: ``global X`` writes from thread context,
+        # loads from async functions in the same module — lock-aware on
+        # both sides, like the instance-attribute variant
+        g_writes: Dict[str, Tuple[int, str, bool]] = {}
+        g_async_reads: Dict[str, Tuple[int, str, bool]] = {}
+        for fi in _all_module_functions(mod):
+            declared = {
+                n for node in ast.walk(fi.node)
+                if isinstance(node, ast.Global) for n in node.names
+            }
+            if fi.qualname in thread_ctx and declared:
+                for name, kind, line, held in _name_accesses(
+                    fi.node, model, fi.class_name, declared
+                ):
+                    if kind != "store":
+                        continue
+                    prev = g_writes.get(name)
+                    if prev is None or (prev[2] and not held):
+                        g_writes[name] = (line, fi.name, held)
+            if fi.is_async and fi.qualname not in thread_ctx:
+                # Python scoping: a name ASSIGNED in the function without
+                # a ``global`` declaration is local and shadows the
+                # module global — reads of it are not global reads
+                shadowed = {
+                    node.id
+                    for node in ast.walk(fi.node)
+                    if isinstance(node, ast.Name)
+                    and isinstance(node.ctx, (ast.Store, ast.Del))
+                } | {a.arg for a in ast.walk(fi.node)
+                     if isinstance(a, ast.arg)}
+                module_names = (set(mod.assigns) - shadowed) | declared
+                for name, kind, line, held in _name_accesses(
+                    fi.node, model, fi.class_name, module_names
+                ):
+                    if kind != "load":
+                        continue
+                    prev = g_async_reads.get(name)
+                    if prev is None or (prev[2] and not held):
+                        g_async_reads[name] = (line, fi.name, held)
+        for name, (wline, wfn, wheld) in sorted(g_writes.items()):
+            ar = g_async_reads.get(name)
+            if ar is None:
+                continue
+            rline, rfn, rheld = ar
+            if wheld and rheld:
+                continue  # both sides under a module lock
+            if _ctor_kind(mod, mod.assigns.get(name, ast.Constant(0))) in (
+                "lock", "threadsafe"
+            ):
+                continue
+            side = (
+                "neither side holds a lock" if not (wheld or rheld)
+                else ("the async reader takes no lock" if wheld
+                      else "the thread writer takes no lock")
+            )
+            yield (
+                mod.path, wline,
+                f"module global '{name}' is written here in thread-target "
+                f"{wfn}() and read from async {rfn}() (line {rline}) "
+                f"— {side}; guard both sides or hand the value over "
+                "a queue",
+            )
+
+
+def _all_module_functions(mod: ModuleInfo):
+    yield from mod.functions.values()
+    for ci in mod.classes.values():
+        yield from ci.methods.values()
+
+
+# --------------------------------------------------------------------- #
+# asyncio-from-thread
+# --------------------------------------------------------------------- #
+
+_ASYNCIO_THREAD_BANNED = ("create_task", "ensure_future",
+                          "get_running_loop", "get_event_loop")
+
+
+@project_rule(
+    "asyncio-from-thread", SEVERITY_ERROR,
+    "asyncio primitive (asyncio.Queue ops, create_task/ensure_future, "
+    "loop.call_soon) touched from threading.Thread context — asyncio is "
+    "not thread-safe; bridge with run_coroutine_threadsafe / "
+    "call_soon_threadsafe",
+)
+def check_asyncio_from_thread(pctx: ProjectContext):
+    thread_ctx = sync_thread_context(pctx)
+    models = _models(pctx)
+    for q in sorted(thread_ctx):
+        fi = pctx.graph.function(q)
+        if fi is None:
+            continue
+        mod = pctx.project.modules.get(fi.module)
+        if mod is None:
+            continue
+        model = models[fi.module]
+        # a function that starts its own loop re-enters loop context for
+        # everything it does afterwards; skip its body entirely — but
+        # only ITS OWN body: an asyncio.run inside a nested def is a
+        # separate execution context and must not exempt the outer
+        # thread target
+        if any(
+            isinstance(n, ast.Call) and _is_asyncio_attr(n.func, "run")
+            for n in walk_excluding_nested(fi.node)
+        ):
+            continue
+        for node in walk_excluding_nested(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and _is_asyncio_attr(
+                f, None
+            ) and f.attr in _ASYNCIO_THREAD_BANNED:
+                yield (
+                    mod.path, node.lineno,
+                    f"asyncio.{f.attr}() called from thread context "
+                    f"({fi.name}() runs on a Thread target) — schedule "
+                    "onto the loop with asyncio.run_coroutine_threadsafe "
+                    "or loop.call_soon_threadsafe instead",
+                )
+                continue
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "call_soon"
+                and _is_loopish(f.value)
+            ):
+                yield (
+                    mod.path, node.lineno,
+                    f".call_soon() from thread context ({fi.name}() runs "
+                    "on a Thread target) is not thread-safe — use "
+                    ".call_soon_threadsafe",
+                )
+                continue
+            # asyncio.Queue-typed attribute ops
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _ASYNCIO_QUEUE_METHODS
+                and isinstance(f.value, ast.Attribute)
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id == "self"
+                and fi.class_name is not None
+                and model.attr_kinds.get(
+                    f"{fi.class_name}.{f.value.attr}"
+                ) == "asyncio_queue"
+            ):
+                yield (
+                    mod.path, node.lineno,
+                    f"asyncio.Queue method .{f.attr}() called on "
+                    f"'self.{f.value.attr}' from thread context "
+                    f"({fi.name}() runs on a Thread target) — asyncio "
+                    "queues are loop-affine; bridge with "
+                    "run_coroutine_threadsafe (or use queue.Queue)",
+                )
+
+
+def _is_loopish(expr: ast.AST) -> bool:
+    """True when ``expr`` is recognizably an event loop: a name/attribute
+    spelled ``*loop`` (self.loop, self._loop, loop) or a direct
+    ``asyncio.get_event_loop()/get_running_loop()`` call. Anything else
+    (a user object that happens to have a ``call_soon`` method) degrades
+    to no-finding."""
+    if (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Attribute)
+        and expr.func.attr in ("get_event_loop", "get_running_loop")
+    ):
+        return True
+    name = None
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    return name is not None and name.lower().endswith("loop")
+
+
+def _is_asyncio_attr(f: ast.AST, attr: Optional[str]) -> bool:
+    return (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "asyncio"
+        and (attr is None or f.attr == attr)
+    )
+
+
+# --------------------------------------------------------------------- #
+# lock-order
+# --------------------------------------------------------------------- #
+
+
+@project_rule(
+    "lock-order", SEVERITY_ERROR,
+    "two threading locks acquired in opposite orders on different paths "
+    "(lexically or across calls) — ABBA deadlock under contention",
+)
+def check_lock_order(pctx: ProjectContext):
+    models = _models(pctx)
+    graph = pctx.graph
+
+    # per function: direct acquisitions, nested pairs, calls-under-lock
+    acquires: Dict[str, Set[str]] = {}
+    pair_sites: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+    calls_held: List[Tuple[str, List[str], str, str, int]] = []
+
+    for mod_name, mod in pctx.project.modules.items():
+        model = models[mod_name]
+        if not model.locks:
+            continue
+        for fi in _all_module_functions(mod):
+            direct: Set[str] = set()
+
+            def walk(node, held: List[str]):
+                if isinstance(
+                    node,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    return
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    new = list(held)
+                    for item in node.items:
+                        lid = model.lock_id_for(
+                            item.context_expr, fi.class_name
+                        )
+                        if lid:
+                            direct.add(lid)
+                            for h in new:
+                                if h != lid:
+                                    pair_sites.setdefault(
+                                        (h, lid), []
+                                    ).append(
+                                        (mod.path, item.context_expr.lineno,
+                                         fi.name)
+                                    )
+                            new.append(lid)
+                    for stmt in node.body:
+                        walk(stmt, new)
+                    return
+                if isinstance(node, ast.Call) and held:
+                    site = next(
+                        (
+                            s for s in graph.sites_by_caller.get(
+                                fi.qualname, ()
+                            )
+                            if s.node is node
+                        ),
+                        None,
+                    )
+                    if site is not None:
+                        calls_held.append(
+                            (fi.qualname, list(held), site.callee,
+                             mod.path, node.lineno)
+                        )
+                for child in ast.iter_child_nodes(node):
+                    walk(child, held)
+
+            for stmt in fi.node.body:
+                walk(stmt, [])
+            if direct:
+                acquires[fi.qualname] = direct
+
+    if not pair_sites and not calls_held:
+        return
+
+    # closure of locks acquired by each function's callees
+    def locks_closure(q: str) -> Set[str]:
+        out: Set[str] = set()
+        for r in graph.reachable([q]):
+            out |= acquires.get(r, set())
+        return out
+
+    for caller, held, callee, path, line in calls_held:
+        for lid in sorted(locks_closure(callee)):
+            for h in held:
+                if h != lid:
+                    pair_sites.setdefault((h, lid), []).append(
+                        (path, line,
+                         f"{caller.rsplit('.', 1)[-1]} -> "
+                         f"{callee.rsplit('.', 1)[-1]}")
+                    )
+
+    # order-graph edges + cycle detection
+    order: Dict[str, Set[str]] = {}
+    for (a, b) in pair_sites:
+        order.setdefault(a, set()).add(b)
+
+    def reaches(src: str, dst: str) -> bool:
+        seen, work = set(), [src]
+        while work:
+            cur = work.pop()
+            if cur == dst:
+                return True
+            if cur in seen:
+                continue
+            seen.add(cur)
+            work.extend(order.get(cur, ()))
+        return False
+
+    emitted: Set[Tuple[str, int, str, str]] = set()
+    for (a, b), sites in sorted(pair_sites.items()):
+        if not reaches(b, a):
+            continue
+        other = pair_sites.get((b, a), [])
+        where = (
+            f" (reverse order at {other[0][0]}:{other[0][1]})"
+            if other else ""
+        )
+        for path, line, via in sites:
+            key = (path, line, a, b)
+            if key in emitted:
+                continue
+            emitted.add(key)
+            yield (
+                path, line,
+                f"lock '{_short(a)}' is held while acquiring "
+                f"'{_short(b)}' here ({via}), but another path acquires "
+                f"them in the reverse order{where} — ABBA deadlock; pick "
+                "one global order",
+            )
+
+
+def _short(lock_id: str) -> str:
+    parts = lock_id.split(".")
+    return ".".join(parts[-2:]) if len(parts) > 1 else lock_id
+
+
+# --------------------------------------------------------------------- #
+# await-in-lock (file rule: purely lexical)
+# --------------------------------------------------------------------- #
+
+
+@rule(
+    "await-in-lock", SEVERITY_ERROR,
+    "await while holding a threading.Lock — the lock is held across the "
+    "suspension, blocking every loop task that contends for it (and "
+    "deadlocking if one of them awaits inside it too)",
+)
+def check_await_in_lock(ctx: FileContext):
+    mod = ModuleInfo("<file>", ctx.path, ctx.tree, ctx.src)
+    # reuse the project indexing for imports/classes on this one file
+    from tools.arealint.project import _index_module
+
+    _index_module(mod)
+    model = ModuleModel(mod)
+    if not model.locks:
+        return
+    parents = ctx.parents()
+
+    def enclosing_class(node) -> Optional[str]:
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = parents.get(cur)
+        return None
+
+    found: List[Tuple[int, str]] = []
+
+    def walk(node, held: Optional[str], cls: Optional[str]):
+        if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+            return  # sync context: a plain callee may run anywhere
+        if isinstance(node, ast.AsyncFunctionDef):
+            for stmt in node.body:
+                walk(stmt, None, cls)
+            return
+        if isinstance(node, ast.With):
+            lid = held
+            for item in node.items:
+                got = model.lock_id_for(item.context_expr, cls)
+                if got:
+                    lid = got
+            for stmt in node.body:
+                walk(stmt, lid, cls)
+            return
+        if isinstance(node, ast.Await) and held is not None:
+            found.append((
+                node.lineno,
+                f"await while holding threading lock '{_short(held)}' — "
+                "the lock stays held across the suspension and stalls "
+                "every contending loop task; release before awaiting, or "
+                "use asyncio.Lock for loop-side mutual exclusion",
+            ))
+        for child in ast.iter_child_nodes(node):
+            walk(child, held, cls)
+
+    for fnode in ast.walk(ctx.tree):
+        if isinstance(fnode, ast.AsyncFunctionDef):
+            cls = enclosing_class(fnode)
+            for stmt in fnode.body:
+                walk(stmt, None, cls)
+    # dedupe nested-async double visits (ast.walk reaches inner async
+    # defs both directly and via the outer walk)
+    yield from sorted(set(found))

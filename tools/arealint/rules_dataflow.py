@@ -1,0 +1,461 @@
+"""Whole-program JAX dataflow rules: the cross-module extensions of the
+intra-file host-sync and donation rules, plus a jit-boundary weak-type
+drift check.
+
+- ``host-sync-cross-module`` — the v1 host-sync rule could only follow
+  same-file calls; a ``.item()`` two modules away from the
+  ``# arealint: hot`` root in ``train/engine.py`` was invisible
+  (docs/static_analysis.md:55 in v1). This rule walks the project call
+  graph from every hot root (jitted, ``# arealint: hot``) and flags sync
+  matches in functions the INTRA-file rule cannot reach — each defect is
+  reported by exactly one of the two rules.
+- ``donation-cross-call`` — donation-after-use across call boundaries,
+  both directions: (a) a helper donates its own parameter to a jitted
+  call, so the CALLER's variable is invalidated by the call and any read
+  after it observes an aliased buffer; (b) a value is handed to a helper
+  that STORES it (``self.x = p`` / ``container.append(p)``) and later
+  donated by the caller — the stored alias outlives the donation.
+- ``jit-weak-type-drift`` — call sites of one jitted function disagree
+  on whether an operand is a Python scalar literal: literals trace
+  weak-typed, so the callable silently compiles a second trace and the
+  two sites can produce different result dtypes.
+
+All resolution degrades: an edge the index cannot follow produces no
+finding (docs/static_analysis.md "Call-graph semantics").
+"""
+
+import ast
+import collections
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.arealint.core import (
+    ProjectContext, SEVERITY_ERROR, SEVERITY_WARN,
+    project_rule, walk_excluding_nested,
+)
+from tools.arealint.project import FunctionInfo, _dotted
+from tools.arealint.rules_jax import (
+    _donated_positions, _has_jit_decorator, _is_jit_call, _sync_match,
+    file_hot_roots, intra_hot_reachable,
+)
+
+
+def _project_hot_roots(pctx: ProjectContext) -> List[str]:
+    """Qualnames of indexed functions that are hot roots — delegates the
+    detection to :func:`rules_jax.file_hot_roots` so the intra-file and
+    cross-module rules can never disagree about what a root is."""
+    roots: List[str] = []
+    for mod in pctx.project.modules.values():
+        ctx = pctx.file_ctx(mod.path)
+        if ctx is None:
+            continue
+        hot_ids = {id(n) for n in file_hot_roots(ctx)}
+        for fi in _indexed_functions(mod):
+            if id(fi.node) in hot_ids:
+                roots.append(fi.qualname)
+    return sorted(roots)
+
+
+def _indexed_functions(mod) -> Iterator[FunctionInfo]:
+    yield from mod.functions.values()
+    for ci in mod.classes.values():
+        yield from ci.methods.values()
+
+
+# --------------------------------------------------------------------- #
+# host-sync-cross-module
+# --------------------------------------------------------------------- #
+
+
+@project_rule(
+    "host-sync-cross-module", SEVERITY_ERROR,
+    "host<->device sync in a function reachable from a jitted or "
+    "'# arealint: hot' root through the CROSS-MODULE call graph — "
+    "invisible to the intra-file rule, same pipeline stall",
+)
+def check_host_sync_cross_module(pctx: ProjectContext):
+    graph = pctx.graph
+    roots = _project_hot_roots(pctx)
+    if not roots:
+        return
+    # FIFO BFS over SORTED edges with predecessor tracking: the chain in
+    # the message is a shortest one with lexicographic tie-breaks, so the
+    # attribution (and the SARIF byte-identity contract) is deterministic
+    # even when a sync is reachable from several roots/callers
+    pred: Dict[str, Tuple[Optional[str], str]] = {}
+    work: collections.deque = collections.deque()
+    for r in roots:
+        if r not in pred:
+            pred[r] = (None, r)
+            work.append(r)
+    while work:
+        cur = work.popleft()
+        root = pred[cur][1]
+        for nxt in sorted(graph.edges.get(cur, ())):
+            if nxt not in pred:
+                pred[nxt] = (cur, root)
+                work.append(nxt)
+
+    intra_cache: Dict[str, Set[int]] = {}
+
+    def intra_ids(path: str) -> Set[int]:
+        got = intra_cache.get(path)
+        if got is None:
+            ctx = pctx.file_ctx(path)
+            got = (
+                {id(n) for n in intra_hot_reachable(ctx)}
+                if ctx is not None else set()
+            )
+            intra_cache[path] = got
+        return got
+
+    for q in sorted(pred):
+        fi = graph.function(q)
+        if fi is None:
+            continue
+        if id(fi.node) in intra_ids(fi.path):
+            continue  # the intra-file rule already owns this function
+        caller, root = pred[q]
+        via = f" via {_short(caller)}()" if caller and caller != root else ""
+        for node in walk_excluding_nested(fi.node):
+            m = _sync_match(node)
+            if m:
+                yield (
+                    fi.path, node.lineno,
+                    f"{m} in {fi.name}() forces a host<->device sync on a "
+                    f"hot path — reachable from hot root {_short(root)}()"
+                    f"{via} through the project call graph; move it off "
+                    "the step path or annotate a deliberate sync with "
+                    "'# arealint: ok(<reason>)'",
+                )
+
+
+def _short(qualname: Optional[str]) -> str:
+    if not qualname:
+        return "?"
+    parts = qualname.split(".")
+    return ".".join(parts[-2:]) if len(parts) > 1 else qualname
+
+
+# --------------------------------------------------------------------- #
+# donation-cross-call
+# --------------------------------------------------------------------- #
+
+
+def _param_names(fnode) -> List[str]:
+    args = fnode.args
+    return [
+        a.arg
+        for a in list(getattr(args, "posonlyargs", [])) + list(args.args)
+    ]
+
+
+def _donating_calls(fnode) -> Iterator[Tuple[ast.Call, Tuple[int, ...]]]:
+    """(call node, donated positions) for every donating jitted call in
+    the function's own body: direct ``jax.jit(f, donate_argnums=..)(x)``
+    and calls through a locally-bound donated callable."""
+    donors: Dict[str, Tuple[int, ...]] = {}
+    for node in walk_excluding_nested(fnode):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and _is_jit_call(node.value)
+        ):
+            pos = _donated_positions(node.value)
+            if pos:
+                donors[node.targets[0].id] = pos
+    for node in walk_excluding_nested(fnode):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Name) and node.func.id in donors:
+            yield node, donors[node.func.id]
+        elif _is_jit_call(node.func):
+            pos = _donated_positions(node.func)
+            if pos:
+                yield node, pos
+
+
+def _donated_param_positions(fi: FunctionInfo) -> Dict[int, str]:
+    """{caller-arg position: param name} for parameters of ``fi`` that
+    its body donates to a jitted call while still bound to the CALLER's
+    buffer — a param rebound before the donating call (``x = x * 2``)
+    donates the new buffer, not the caller's, and is excluded. Positions
+    are as the caller sees them (``self``/``cls`` stripped for methods).
+    """
+    params = _param_names(fi.node)
+    offset = 1 if fi.class_name is not None and params[:1] in (
+        ["self"], ["cls"]
+    ) else 0
+    stores: Dict[str, List[Tuple[int, int]]] = {}
+    for node in walk_excluding_nested(fi.node):
+        if (
+            isinstance(node, ast.Name)
+            and isinstance(node.ctx, (ast.Store, ast.Del))
+            and node.id in params
+        ):
+            stores.setdefault(node.id, []).append(
+                (node.lineno, node.col_offset)
+            )
+    out: Dict[int, str] = {}
+    for call, positions in _donating_calls(fi.node):
+        call_pos = (call.lineno, call.col_offset)
+        for p in positions:
+            if p >= len(call.args):
+                continue
+            a = call.args[p]
+            if isinstance(a, ast.Name) and a.id in params:
+                if any(s < call_pos for s in stores.get(a.id, ())):
+                    continue  # rebound before the donation
+                idx = params.index(a.id) - offset
+                if idx >= 0:
+                    out[idx] = a.id
+    return out
+
+
+def _loads_after(
+    fnode, dotted: str, after: Tuple[int, int]
+) -> Optional[int]:
+    """First Load line of ``dotted`` after position ``after`` in the
+    function's own body; None if it is stored first (rebound) or never
+    read."""
+    events: List[Tuple[Tuple[int, int], str]] = []
+    for node in walk_excluding_nested(fnode):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if _dotted(node) == dotted:
+                kind = "load" if isinstance(node.ctx, ast.Load) else "store"
+                events.append(((node.lineno, node.col_offset), kind))
+    events.sort()
+    for pos, kind in events:
+        if pos <= after:
+            continue
+        return pos[0] if kind == "load" else None
+    return None
+
+
+def _stored_param_positions(fi: FunctionInfo) -> Dict[int, int]:
+    """{caller-arg position: store line} for parameters the function
+    body STORES (assigns to an attribute/subscript, or appends/adds to a
+    container) — the alias outlives the call."""
+    params = _param_names(fi.node)
+    offset = 1 if fi.class_name is not None and params[:1] in (
+        ["self"], ["cls"]
+    ) else 0
+    stored: Dict[int, int] = {}
+
+    def record(name: str, line: int):
+        if name in params:
+            idx = params.index(name) - offset
+            if idx >= 0:
+                stored.setdefault(idx, line)
+
+    for node in walk_excluding_nested(fi.node):
+        if isinstance(node, ast.Assign):
+            escapes = any(
+                isinstance(t, (ast.Attribute, ast.Subscript))
+                for t in node.targets
+            )
+            if escapes and isinstance(node.value, ast.Name):
+                record(node.value.id, node.lineno)
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("append", "add", "appendleft", "setdefault")
+            ):
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        record(a.id, node.lineno)
+    return stored
+
+
+def _rebound_at_call(
+    pctx: ProjectContext, caller: FunctionInfo, call: ast.Call
+) -> Set[str]:
+    """Names rebound by the assignment the call sits in
+    (``x, y = helper(x, y)``): they hold the NEW buffer afterwards."""
+    ctx = pctx.file_ctx(caller.path)
+    if ctx is None:
+        return set()
+    parent = ctx.parents().get(call)
+    out: Set[str] = set()
+    if isinstance(parent, ast.Assign):
+        for t in parent.targets:
+            elts = t.elts if isinstance(t, ast.Tuple) else [t]
+            for e in elts:
+                d = _dotted(e)
+                if d:
+                    out.add(d)
+    return out
+
+
+def _arg_at(call: ast.Call, pos: int, param: str) -> Optional[ast.expr]:
+    if pos < len(call.args):
+        return call.args[pos]
+    for kw in call.keywords:
+        if kw.arg == param:
+            return kw.value
+    return None
+
+
+@project_rule(
+    "donation-cross-call", SEVERITY_ERROR,
+    "donation-after-use across a call boundary: a helper donates its "
+    "parameter (caller's variable read after the call observes an aliased "
+    "buffer), or a donated value was stored by a helper beforehand (the "
+    "stored alias survives donation) — fails only on hardware",
+)
+def check_donation_cross_call(pctx: ProjectContext):
+    graph = pctx.graph
+    # (a) helper donates its own parameter; caller reads the arg after
+    for q in sorted(graph.sites_by_callee):
+        fi = graph.function(q)
+        if fi is None:
+            continue
+        donated = _donated_param_positions(fi)
+        if not donated:
+            continue
+        for site in graph.sites_by_callee[q]:
+            caller = graph.function(site.caller)
+            if caller is None:
+                continue
+            rebound = _rebound_at_call(pctx, caller, site.node)
+            for pos, pname in sorted(donated.items()):
+                arg = _arg_at(site.node, pos, pname)
+                if arg is None:
+                    continue
+                d = _dotted(arg)
+                if d is None or d in ("self", "cls") or d in rebound:
+                    continue
+                end = (
+                    getattr(site.node, "end_lineno", site.node.lineno),
+                    getattr(site.node, "end_col_offset",
+                            site.node.col_offset),
+                )
+                read = _loads_after(caller.node, d, end)
+                if read is not None:
+                    yield (
+                        caller.path, read,
+                        f"{d!r} is read here, but {fi.name}() (called on "
+                        f"line {site.line}) donates that parameter "
+                        f"({pname!r}) to a jitted call — the buffer may "
+                        "already be aliased in place; rebind from the "
+                        "helper's result or copy before the call",
+                    )
+    # (b) caller passes a value to a storing helper, then donates it
+    for caller_q in sorted(graph.sites_by_caller):
+        caller = graph.function(caller_q)
+        if caller is None:
+            continue
+        donations: List[Tuple[str, int]] = []
+        for call, positions in _donating_calls(caller.node):
+            for p in positions:
+                if p < len(call.args):
+                    d = _dotted(call.args[p])
+                    if d:
+                        donations.append((d, call.lineno))
+        if not donations:
+            continue
+        stored_cache: Dict[str, Dict[int, int]] = {}
+        for site in graph.sites_by_caller[caller_q]:
+            callee = graph.function(site.callee)
+            if callee is None:
+                continue
+            stored = stored_cache.get(site.callee)
+            if stored is None:
+                stored = _stored_param_positions(callee)
+                stored_cache[site.callee] = stored
+            if not stored:
+                continue
+            params = _param_names(callee.node)
+            offset = 1 if callee.class_name is not None and params[:1] in (
+                ["self"], ["cls"]
+            ) else 0
+            for pos, store_line in sorted(stored.items()):
+                pname = (
+                    params[pos + offset]
+                    if pos + offset < len(params) else ""
+                )
+                arg = _arg_at(site.node, pos, pname)
+                if arg is None:
+                    continue
+                d = _dotted(arg)
+                if d is None:
+                    continue
+                for donated, don_line in donations:
+                    if donated == d and site.line <= don_line:
+                        yield (
+                            caller.path, don_line,
+                            f"{d!r} is donated here, but "
+                            f"{callee.name}() (called on line "
+                            f"{site.line}) stored it (line {store_line} "
+                            f"of {callee.path}) — the stored alias "
+                            "survives donation and reads garbage on "
+                            "hardware; copy before storing or don't "
+                            "donate this operand",
+                        )
+
+
+# --------------------------------------------------------------------- #
+# jit-weak-type-drift
+# --------------------------------------------------------------------- #
+
+
+def _lit_kind(expr: ast.expr) -> str:
+    if isinstance(expr, ast.Constant) and not isinstance(expr.value, bool):
+        if isinstance(expr.value, int):
+            return "int literal"
+        if isinstance(expr.value, float):
+            return "float literal"
+    if isinstance(expr, ast.UnaryOp) and isinstance(
+        expr.op, (ast.USub, ast.UAdd)
+    ):
+        inner = _lit_kind(expr.operand)
+        if inner != "other":
+            return inner
+    return "other"
+
+
+@project_rule(
+    "jit-weak-type-drift", SEVERITY_WARN,
+    "call sites of one jitted function disagree on whether an operand is "
+    "a Python scalar literal — weak-typed literals compile a second trace "
+    "and can drift the result dtype between sites",
+)
+def check_weak_type_drift(pctx: ProjectContext):
+    graph = pctx.graph
+    for q in sorted(graph.sites_by_callee):
+        fi = graph.function(q)
+        if fi is None or not _has_jit_decorator(fi.node):
+            continue
+        sites = graph.sites_by_callee[q]
+        if len(sites) < 2:
+            continue
+        max_args = max(len(s.node.args) for s in sites)
+        for pos in range(max_args):
+            kinds: Dict[str, List] = {}
+            for s in sites:
+                if pos < len(s.node.args):
+                    kinds.setdefault(
+                        _lit_kind(s.node.args[pos]), []
+                    ).append(s)
+            if len(kinds) < 2:
+                continue
+            other = kinds.get("other", [])
+            for kind, lit_sites in sorted(kinds.items()):
+                if kind == "other":
+                    continue
+                vs = (
+                    f"a non-literal at {other[0].path}:{other[0].line}"
+                    if other else
+                    "a different literal kind at another site"
+                )
+                article = "an" if kind.startswith("int") else "a"
+                for s in lit_sites:
+                    yield (
+                        s.path, s.line,
+                        f"jitted {fi.name}() receives {article} {kind} at "
+                        f"position {pos} here but {vs} — the weak-typed "
+                        "scalar traces separately and the result dtype "
+                        "can drift between call sites; pass "
+                        "jnp.asarray(x, dtype) consistently",
+                    )

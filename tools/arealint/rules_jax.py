@@ -129,30 +129,40 @@ def _sync_match(node: ast.AST) -> Optional[str]:
     return None
 
 
-@rule(
-    "host-sync-in-hot-path", SEVERITY_ERROR,
-    "host<->device sync (device_get / .item() / float(call) / "
-    "np.asarray(call) / block_until_ready) reachable from a jitted or "
-    "'# arealint: hot' root — serializes the dispatch-ahead pipeline",
-)
-def check_host_sync(ctx: FileContext):
+def _funcs_by_name(ctx: FileContext):
     funcs = _all_functions(ctx)
     by_name: Dict[str, List[ast.AST]] = {}
     for f in funcs:
         by_name.setdefault(f.name, []).append(f)
+    return funcs, by_name
 
+
+def file_hot_roots(ctx: FileContext, _index=None) -> Set[ast.AST]:
+    """Function nodes that are hot roots in this file: jit-decorated,
+    ``# arealint: hot``-annotated, or handed to ``jax.jit(fn)`` by name.
+    ``_index`` is an optional precomputed ``(funcs, by_name)`` pair so
+    callers that already walked the file don't walk it again."""
+    funcs, by_name = _index if _index is not None else _funcs_by_name(ctx)
     hot: Set[ast.AST] = set()
     for f in funcs:
         if _has_jit_decorator(f) or ctx.hot_marked(f):
             hot.add(f)
-    # functions handed to jax.jit(fn, ...) by name are traced bodies
     for node in ast.walk(ctx.tree):
         if _is_jit_call(node) and node.args and isinstance(
             node.args[0], ast.Name
         ):
             hot.update(by_name.get(node.args[0].id, []))
+    return hot
 
-    # intra-file call graph: f(...) and self.f(...) resolve by bare name
+
+def intra_hot_reachable(ctx: FileContext) -> Set[ast.AST]:
+    """Function nodes reachable from this file's hot roots through the
+    INTRA-FILE name-based call graph (``f(...)`` / ``self.f(...)`` resolve
+    to same-file ``def f``). The cross-module project rule subtracts this
+    set so each defect is reported by exactly one rule."""
+    funcs, by_name = _funcs_by_name(ctx)
+    hot = file_hot_roots(ctx, _index=(funcs, by_name))
+
     calls: Dict[ast.AST, Set[str]] = {}
     for f in funcs:
         names: Set[str] = set()
@@ -176,7 +186,17 @@ def check_host_sync(ctx: FileContext):
                 if g not in reach:
                     reach.add(g)
                     work.append(g)
+    return reach
 
+
+@rule(
+    "host-sync-in-hot-path", SEVERITY_ERROR,
+    "host<->device sync (device_get / .item() / float(call) / "
+    "np.asarray(call) / block_until_ready) reachable from a jitted or "
+    "'# arealint: hot' root — serializes the dispatch-ahead pipeline",
+)
+def check_host_sync(ctx: FileContext):
+    reach = intra_hot_reachable(ctx)
     for f in sorted(reach, key=lambda n: n.lineno):
         for node in walk_excluding_nested(f):
             m = _sync_match(node)

@@ -1,0 +1,88 @@
+"""SARIF 2.1.0 output: the interchange format CI annotators (GitHub code
+scanning, VS Code SARIF viewers) ingest. One run, one result per
+finding; rule metadata comes from the live registry so the catalog in
+the report always matches the code.
+
+Determinism contract (golden-file tested): findings are emitted in the
+order given (the driver sorts by path/line/rule), rules sorted by id,
+paths repo-relative posix — so the same tree produces byte-identical
+SARIF everywhere.
+"""
+
+import json
+from typing import Iterable, List, Optional
+
+from tools.arealint.baseline import norm_path
+from tools.arealint.core import Finding, SEVERITY_ERROR, all_rules
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemas/sarif-schema-2.1.0.json"
+)
+TOOL_NAME = "arealint"
+
+
+def _level(severity: str) -> str:
+    return "error" if severity == SEVERITY_ERROR else "warning"
+
+
+def to_sarif(
+    findings: Iterable[Finding],
+    root=None,
+    rule_ids: Optional[List[str]] = None,
+) -> dict:
+    """The SARIF log object for ``findings``. ``rule_ids`` limits the
+    reported rule catalog (default: every registered rule)."""
+    catalog = all_rules()
+    ids = sorted(rule_ids) if rule_ids is not None else sorted(catalog)
+    rules = [
+        {
+            "id": rid,
+            "shortDescription": {"text": catalog[rid].doc},
+            "defaultConfiguration": {
+                "level": _level(catalog[rid].severity)
+            },
+        }
+        for rid in ids
+        if rid in catalog
+    ]
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": _level(f.severity),
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": norm_path(f.path, root),
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {"startLine": max(f.line, 1)},
+                    }
+                }
+            ],
+        }
+        for f in findings
+    ]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": TOOL_NAME,
+                        "informationUri": "docs/static_analysis.md",
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+
+
+def dumps(findings: Iterable[Finding], root=None, **kw) -> str:
+    return json.dumps(to_sarif(findings, root=root, **kw), indent=2)

@@ -1,24 +1,27 @@
 #!/usr/bin/env python3
-"""Static async-hygiene pass — now a thin shim over :mod:`tools.arealint`.
+"""DEPRECATED — thin forwarding stub over :mod:`tools.arealint`.
 
-The four rules this script introduced (bare ``asyncio.gather``, discarded
-``create_task``, ``shutil.rmtree`` outside the checkpoint commit helper,
-``time.sleep`` inside ``async def``) live in the arealint framework as
-first-class rules (``tools/arealint/rules_async.py``); this entry point is
-kept so existing invocations and ``tests/test_async_hygiene.py`` keep
-working unchanged::
+This entry point is retired and will be deleted one release after
+arealint v2; it survives only so scripts that still invoke it keep
+working while they migrate. It runs exactly the four migrated async
+rules (bare ``asyncio.gather``, discarded ``create_task``,
+``shutil.rmtree`` outside the checkpoint commit helper, ``time.sleep``
+inside ``async def``) — a strict subset of::
 
-    python tools/check_async_hygiene.py [paths...]     # exits 1 on findings
+    python -m tools.arealint [paths...]
 
-For the full rule set (JAX host-sync/retrace/donation hazards, env-knob
-and registry hygiene) run ``python -m tools.arealint`` instead — see
-docs/static_analysis.md. Suppress a deliberate violation with
-``# async-hygiene: ok`` (legacy) or ``# arealint: ok(<reason>)`` on the
-call's first line.
+which adds the JAX host-sync/retrace/donation rules, the whole-program
+call-graph rules (cross-module host-sync, thread/asyncio races,
+donation dataflow), and env-knob/registry hygiene. Migrate invocations
+there, and migrate any remaining legacy ``# async-hygiene: ok`` tokens
+to ``# arealint: ok(<reason>)`` — the legacy token only covers the four
+migrated rules and is honored for one more release
+(docs/static_analysis.md "Suppressing a finding").
 """
 
 import pathlib
 import sys
+import warnings
 
 _REPO = str(pathlib.Path(__file__).resolve().parent.parent)
 if _REPO not in sys.path:
@@ -45,6 +48,18 @@ def scan_paths(paths):
 
 
 def main(argv) -> int:
+    warnings.warn(
+        "tools/check_async_hygiene.py is deprecated and will be removed "
+        "one release after arealint v2 — run `python -m tools.arealint` "
+        "instead (superset of these rules; see docs/static_analysis.md)",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    print(
+        "warning: check_async_hygiene.py is deprecated; "
+        "run `python -m tools.arealint` instead",
+        file=sys.stderr,
+    )
     paths = argv[1:] or DEFAULT_PATHS
     findings = scan_paths(paths)
     for f in findings:
