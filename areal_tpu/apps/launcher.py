@@ -130,6 +130,8 @@ def gen_server_main(cfg, server_idx: int):
         page_size=cfg.gen.page_size,
         n_pages=cfg.gen.n_pages,
         mesh=mesh,
+        spec_decode=cfg.gen.spec_decode,
+        spec_k=cfg.gen.spec_k,
     )
 
     async def main():

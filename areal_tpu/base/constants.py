@@ -34,6 +34,9 @@ WATCHDOG_ABORT_ENV = "AREAL_WATCHDOG_ABORT"   # dump AND exit so the scheduler r
 # Fleet telemetry plane (docs/observability.md): per-worker counter/
 # histogram snapshot export interval.
 TELEMETRY_EXPORT_ENV = "AREAL_TELEMETRY_EXPORT"
+# Speculative decoding (docs/performance.md "Speculative decoding").
+SPEC_DECODE_ENV = "AREAL_SPEC_DECODE"   # draft-and-verify decode chunks
+SPEC_K_ENV = "AREAL_SPEC_K"             # draft tokens per slot per spec step
 
 
 # --------------------------------------------------------------------- #
@@ -176,6 +179,21 @@ def decode_pipeline_enabled() -> bool:
     """``AREAL_DECODE_PIPELINE`` (default off): harvest decode chunks one
     late so the per-chunk host sync overlaps the next chunk's compute."""
     return env_flag("AREAL_DECODE_PIPELINE", False)
+
+
+def spec_decode_enabled() -> bool:
+    """``AREAL_SPEC_DECODE`` (default off): generation engines decode with
+    speculative draft-and-verify chunks (self-drafting n-gram baseline;
+    exactly distribution-preserving, so PPO-safe). Default off until
+    chip-measured — see the ``gen_spec`` bench section."""
+    return env_flag(SPEC_DECODE_ENV, False)
+
+
+def spec_k() -> int:
+    """``AREAL_SPEC_K`` (default 4): draft tokens proposed per slot per
+    speculative decode step; the verify pass scores K+1 positions in one
+    forward. Floored at 1 (K=0 would be vanilla decode with extra steps)."""
+    return max(1, env_int(SPEC_K_ENV, 4))
 
 
 def native_disabled() -> bool:
@@ -340,6 +358,8 @@ def get_env_vars(**extra) -> dict:
         "AREAL_DEBUG_CHECKS",
         "AREAL_FLASH_BWD_PIPELINE",
         "AREAL_DECODE_PIPELINE",
+        SPEC_DECODE_ENV,
+        SPEC_K_ENV,
         "AREAL_DISABLE_NATIVE",
         "AREAL_ENABLE_FUNCTION_CALL",
         "AREAL_FUNCTIONCALL_SERVICE_DOMAIN",

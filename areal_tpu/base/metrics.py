@@ -86,11 +86,14 @@ class Histogram:
         self.min = float("inf")
         self.max = float("-inf")
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, n: int = 1) -> None:
+        """Record ``value`` ``n`` times (``n > 1`` lets batched producers —
+        e.g. the spec-decode chunk folding a whole ``[steps, slots]``
+        accept-length grid — record without a per-observation loop)."""
         v = float(value)
-        self.counts[bisect.bisect_left(self.boundaries, v)] += 1
-        self.sum += v
-        self.count += 1
+        self.counts[bisect.bisect_left(self.boundaries, v)] += n
+        self.sum += v * n
+        self.count += n
         if v < self.min:
             self.min = v
         if v > self.max:
@@ -214,16 +217,17 @@ class CounterRegistry:
             if float(value) > self._vals.get(name, float("-inf")):
                 self._vals[name] = float(value)
 
-    def observe(self, name: str, value: float) -> None:
-        """Record one observation into the histogram ``name`` (created on
-        first use with the catalog's boundaries for that key)."""
+    def observe(self, name: str, value: float, n: int = 1) -> None:
+        """Record ``n`` observations of ``value`` into the histogram
+        ``name`` (created on first use with the catalog's boundaries for
+        that key)."""
         with self._lock:
             h = self._hists.get(name)
             if h is None:
                 h = Histogram(HISTOGRAM_BOUNDARIES.get(name))
                 self._hists[name] = h
                 self._kinds.setdefault(name, KIND_HISTOGRAM)
-            h.observe(value)
+            h.observe(value, n)
 
     def get(self, name: str, default: float = 0.0) -> float:
         with self._lock:
@@ -382,6 +386,22 @@ MANAGER_SCHEDULED = "manager/schedule_requests"
 MANAGER_ALLOCATED = "manager/allocated"    # rollouts admitted by the gate
 TRAIN_STEPS = "train/steps"                # optimizer steps taken
 
+# Speculative decoding (docs/performance.md "Speculative decoding"):
+# drafted vs accepted draft tokens (sums; their ratio is the accept rate)
+# plus an accept-length distribution per (slot, spec step) — the drafter
+# quality signal the bench and the ops CLI read.
+GEN_SPEC_DRAFT_TOKENS = "gen/spec_draft_tokens"
+GEN_SPEC_ACCEPTED_TOKENS = "gen/spec_accepted_tokens"
+GEN_SPEC_ACCEPT_LEN = "gen/spec_accept_len"
+
+# Small-integer edges for the accept-length histogram: accept lengths are
+# 0..K (K = AREAL_SPEC_K, typically <= 8) and the duration edges would
+# smear 0/1/2 — the values that decide whether spec decode pays — into
+# one bucket.
+SPEC_ACCEPT_LEN_BOUNDARIES: List[float] = [
+    0.5, 1.5, 2.5, 3.5, 4.5, 5.5, 6.5, 7.5, 8.5, 12.5, 16.5,
+]
+
 
 # Per-key metric kinds; unknown keys default to KIND_SUM. The arealint
 # ``unregistered-counter`` rule keys off the UPPERCASE constants above;
@@ -393,12 +413,14 @@ METRIC_KINDS: Dict[str, str] = {
     E2E_LATENCY_S: KIND_HISTOGRAM,
     TTFC_S: KIND_HISTOGRAM,
     REWARD_LAG_S: KIND_HISTOGRAM,
+    GEN_SPEC_ACCEPT_LEN: KIND_HISTOGRAM,
 }
 
 # Non-default bucket edges per histogram key (default: the log-spaced
 # duration edges).
 HISTOGRAM_BOUNDARIES: Dict[str, List[float]] = {
     STALENESS_VERSIONS: VERSION_LAG_BOUNDARIES,
+    GEN_SPEC_ACCEPT_LEN: SPEC_ACCEPT_LEN_BOUNDARIES,
 }
 
 

@@ -85,6 +85,10 @@ class GenFleetSpec:
     tp_size: int = 1
     page_size: int = 128
     n_pages: Optional[int] = None    # KV pool size; None = max_slots * tables
+    # speculative decoding (docs/performance.md "Speculative decoding"):
+    # None defers to the AREAL_SPEC_DECODE / AREAL_SPEC_K env knobs
+    spec_decode: Optional[bool] = None
+    spec_k: Optional[int] = None
 
 
 @dataclasses.dataclass

@@ -1,0 +1,94 @@
+"""Draft-token proposers for speculative decoding.
+
+The drafter runs INSIDE the generation engine's jitted decode chunk
+(``gen/engine.py::_spec_chunk_fn``): ``propose`` must be a pure, traceable
+function of device state — no host syncs, no data-dependent shapes. The
+engine hands it the slot batch's resident token context and expects
+``[B, K]`` proposed tokens back; the verify forward then scores all K+1
+positions in one pass and ``sampling.spec_rejection_sample`` accepts a
+prefix. Because acceptance is exactly distribution-preserving, a drafter
+can NEVER corrupt outputs — only the accept rate (and therefore speed)
+varies with its quality.
+
+Shipped baseline: :class:`NGramDrafter`, self-drafting via on-device
+suffix lookup over the slot's resident context (prompt + generated tokens
+— the ``ctx_tokens`` buffer the engine maintains), falling back to the
+engine-provided greedy-from-last-logits hint when no match exists. Needs
+no second model, which makes it free to serve: repetitive/structured
+generations (math derivations, code, re-quoted context) are its sweet
+spot.
+
+A small TP-sharded draft MODEL slots in behind the same interface later:
+implement ``propose`` as the draft model's forward (its params/KV ride
+alongside the engine state; SNIPPETS.md's pjit/NamedSharding patterns
+cover sharding it onto the serving mesh) and set
+``deterministic = False`` + return per-position proposal logprobs through
+``q_logprobs`` once the engine threads them (the rejection sampler
+already supports the general form).
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+class Drafter:
+    """Interface: propose K draft tokens per slot from resident context.
+
+    ``deterministic = True`` declares one-hot proposals (the rejection
+    sampler then needs no proposal distribution). ``propose`` executes
+    under ``jax.jit`` inside a ``lax.scan`` body.
+    """
+
+    deterministic: bool = True
+
+    def propose(
+        self,
+        ctx_tokens: jnp.ndarray,   # [B, S] i32; [b, :lens[b]+1] is valid
+        lens: jnp.ndarray,         # [B] i32; ctx_tokens[b, lens[b]] = last token
+        fallback: jnp.ndarray,     # [B] i32 greedy-from-last-logits hint
+        k: int,
+    ) -> jnp.ndarray:              # [B, k] i32
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class NGramDrafter(Drafter):
+    """Self-drafting suffix lookup: find the most recent earlier occurrence
+    of the context's trailing bigram (then unigram) and propose the K
+    tokens that followed it; positions past the match's continuation — or
+    slots with no match at all — fill with the ``fallback`` token.
+
+    Cost: two ``[B, S]`` comparisons + one gather per spec step — noise
+    next to the verify forward. The bigram→unigram cascade is the standard
+    prompt-lookup-decoding heuristic (≈ llama.cpp / transformers
+    ``prompt_lookup_num_tokens``)."""
+
+    deterministic: bool = True
+
+    def propose(self, ctx_tokens, lens, fallback, k):
+        B, S = ctx_tokens.shape
+        rows = jnp.arange(B)
+        last = ctx_tokens[rows, jnp.clip(lens, 0, S - 1)]
+        prev = ctx_tokens[rows, jnp.clip(lens - 1, 0, S - 1)]
+        # bigram (prev, last) at (j, j+1): continuation starts at j+2 and
+        # must begin inside the valid region (j+2 <= lens); lens >= 1
+        # guards the prev read
+        j = jnp.arange(S - 1)[None, :]
+        big = (
+            (ctx_tokens[:, :-1] == prev[:, None])
+            & (ctx_tokens[:, 1:] == last[:, None])
+            & (j + 1 < lens[:, None])
+            & (lens >= 1)[:, None]
+        )
+        m2 = jnp.max(jnp.where(big, j, -1), axis=1)          # most recent
+        ju = jnp.arange(S)[None, :]
+        uni = (ctx_tokens == last[:, None]) & (ju < lens[:, None])
+        m1 = jnp.max(jnp.where(uni, ju, -1), axis=1)
+        start = jnp.where(m2 >= 0, m2 + 2, jnp.where(m1 >= 0, m1 + 1, -1))
+        offs = start[:, None] + jnp.arange(k)[None, :]       # [B, k]
+        in_ctx = (start[:, None] >= 0) & (offs <= lens[:, None])
+        cont = jnp.take_along_axis(
+            ctx_tokens, jnp.clip(offs, 0, S - 1), axis=1
+        )
+        return jnp.where(in_ctx, cont, fallback[:, None]).astype(jnp.int32)

@@ -51,8 +51,14 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from areal_tpu.base import constants
+from areal_tpu.base import metrics as metrics_mod
+from areal_tpu.gen.drafter import Drafter, NGramDrafter
 from areal_tpu.gen.pages import OutOfPagesError, PagePool, PrefixRegistry
-from areal_tpu.gen.sampling import SamplingParams, sample_tokens
+from areal_tpu.gen.sampling import (
+    SamplingParams,
+    sample_tokens,
+    spec_rejection_sample,
+)
 from areal_tpu.models import transformer as tfm
 from areal_tpu.models.config import ModelConfig
 
@@ -82,6 +88,15 @@ class GenState:
     stop_ids: jnp.ndarray       # [B, K] i32 per-slot stop tokens (-1 = unused)
     out_tokens: jnp.ndarray     # [B, G] i32
     out_logprobs: jnp.ndarray   # [B, G] f32
+    # token-id mirror of the resident context for the self-drafter:
+    # ctx_tokens[b, i] is the token whose KV sits at pool position i, and
+    # ctx_tokens[b, lens[b]] = last_tokens[b] (pending, KV not yet written).
+    # Maintained by BOTH decode paths so spec/vanilla chunks can interleave
+    # freely on one state pytree (bounded jit specializations).
+    ctx_tokens: jnp.ndarray     # [B, S] i32
+    # drafter fallback when the n-gram lookup misses: the target argmax at
+    # the previous spec step's emission boundary (greedy-from-last-logits)
+    fallback_token: jnp.ndarray  # [B] i32
     sp: SamplingParams
     rng: jax.Array
 
@@ -137,6 +152,9 @@ class GenerationEngine:
         mesh: Optional[Mesh] = None,
         admit_chunk_tokens: Optional[int] = None,
         pipeline_chunks: Optional[bool] = None,
+        spec_decode: Optional[bool] = None,
+        spec_k: Optional[int] = None,
+        drafter: Optional[Drafter] = None,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -210,6 +228,8 @@ class GenerationEngine:
                 stop_ids=jnp.full((self.B, self.max_stop_ids), -1, jnp.int32),
                 out_tokens=jnp.zeros((self.B, self.G), jnp.int32),
                 out_logprobs=jnp.zeros((self.B, self.G), jnp.float32),
+                ctx_tokens=jnp.zeros((self.B, self.S), jnp.int32),
+                fallback_token=jnp.zeros((self.B,), jnp.int32),
                 sp=SamplingParams.filled(self.B),
                 rng=jax.random.key(seed),
             )
@@ -251,9 +271,32 @@ class GenerationEngine:
             if pipeline_chunks is not None
             else constants.decode_pipeline_enabled()
         )
+        # speculative decoding (docs/performance.md): draft-and-verify
+        # chunks amortize one params+pool sweep over K+1 candidate tokens;
+        # exactly distribution-preserving, so togglable between chunks
+        # (``spec`` is read once per step() under the engine lock)
+        self.spec = (
+            spec_decode
+            if spec_decode is not None
+            else constants.spec_decode_enabled()
+        )
+        self.spec_k = max(
+            1, spec_k if spec_k is not None else constants.spec_k()
+        )
+        self.drafter: Drafter = drafter if drafter is not None else NGramDrafter()
+        if not getattr(self.drafter, "deterministic", True):
+            # the spec chunk calls spec_rejection_sample without proposal
+            # logprobs, which is only distribution-preserving for one-hot
+            # proposals — accepting a sampled drafter here would silently
+            # bias generation toward its proposals (PPO corruption)
+            raise NotImplementedError(
+                "non-deterministic drafters need their proposal logprobs "
+                "threaded into spec_rejection_sample (q_logprobs); the "
+                "engine only wires one-hot (deterministic) drafters today"
+            )
         self._prev_flags = None           # chunk k's undonated flag outputs
         self._prev_running: tuple = ()    # (slot, epoch) pairs at k's dispatch
-        self._steps_ahead = 0             # decode steps in the in-flight chunk
+        self._steps_ahead = 0   # token-advance bound of the in-flight chunk
         # admission generation per slot: stale flags from a chunk dispatched
         # before the slot turned over must never harvest its NEW occupant
         self._slot_epoch = np.zeros((self.B,), np.int64)
@@ -268,12 +311,15 @@ class GenerationEngine:
         self._jit_extend: Dict[int, Any] = {}
         self._jit_commit: Dict[int, Any] = {}
         self._jit_chunk: Dict[int, Any] = {}
+        self._jit_spec: Dict[Any, Any] = {}
         # observability
         self.stats = {
             "prefill_tokens": 0,        # prompt tokens actually computed
             "prefix_hit_tokens": 0,     # prompt tokens served from shared pages
             "prefix_hits": 0,
             "admitted": 0,
+            "spec_draft_tokens": 0,     # draft tokens proposed (spec decode)
+            "spec_accepted_tokens": 0,  # draft tokens accepted & emitted
         }
 
     # ------------------------------------------------------------------ #
@@ -303,8 +349,11 @@ class GenerationEngine:
 
     def n_compiles(self) -> int:
         """Total jitted specializations (stability tested: bounded by the
-        admit buckets + decode chunk sizes, NOT by prompt lengths)."""
-        return len(self._jit_extend) + len(self._jit_commit) + len(self._jit_chunk)
+        admit buckets + decode/spec chunk sizes, NOT by prompt lengths)."""
+        return (
+            len(self._jit_extend) + len(self._jit_commit)
+            + len(self._jit_chunk) + len(self._jit_spec)
+        )
 
     def n_jit_entries(self) -> int:
         """Jax-level cache entries across the engine's jitted programs
@@ -314,7 +363,8 @@ class GenerationEngine:
 
         return jitcache.total_cache_size(
             j
-            for d in (self._jit_extend, self._jit_commit, self._jit_chunk)
+            for d in (self._jit_extend, self._jit_commit, self._jit_chunk,
+                      self._jit_spec)
             for j in d.values()
         )
 
@@ -432,7 +482,7 @@ class GenerationEngine:
             return self._jit_commit[n_rows]
 
         def commit(state: GenState, slots, last_toks, lens, temp, top_p,
-                   top_k, min_gen, max_gen, stop_ids):
+                   top_k, min_gen, max_gen, stop_ids, ctx_rows):
             return dataclasses.replace(
                 state,
                 lens=state.lens.at[slots].set(lens, mode="drop"),
@@ -444,6 +494,12 @@ class GenerationEngine:
                 stop_ids=state.stop_ids.at[slots].set(stop_ids, mode="drop"),
                 out_tokens=state.out_tokens.at[slots].set(0, mode="drop"),
                 out_logprobs=state.out_logprobs.at[slots].set(0.0, mode="drop"),
+                # full prompt ids for the self-drafter (covers borrowed
+                # prefix pages too — the radix cache shares KV, not ids)
+                ctx_tokens=state.ctx_tokens.at[slots].set(ctx_rows, mode="drop"),
+                fallback_token=state.fallback_token.at[slots].set(
+                    last_toks, mode="drop"
+                ),
                 sp=SamplingParams(
                     temperature=state.sp.temperature.at[slots].set(temp, mode="drop"),
                     top_p=state.sp.top_p.at[slots].set(top_p, mode="drop"),
@@ -453,7 +509,7 @@ class GenerationEngine:
 
         jitted = jax.jit(
             commit, donate_argnums=(0,),
-            **self._jit_sharding(9, with_params=False),
+            **self._jit_sharding(10, with_params=False),
         )
         self._jit_commit[n_rows] = jitted
         return jitted
@@ -609,11 +665,13 @@ class GenerationEngine:
             min_gen = np.zeros((n,), np.int32)
             max_gen = np.zeros((n,), np.int32)
             stop_ids = np.full((n, K), -1, np.int32)
+            ctx_rows = np.zeros((n, self.S), np.int32)
             for j, (r, slot, _) in enumerate(group):
                 ids = r.input_ids
                 slots[j] = slot
                 last_toks[j] = ids[-1]
                 lens[j] = len(ids) - 1
+                ctx_rows[j, : min(len(ids), self.S)] = ids[: self.S]
                 self._lens_host[slot] = len(ids) - 1
                 self._warp_host[slot] = (
                     r.top_p < 1.0 or r.top_k < self.cfg.vocab_size
@@ -632,7 +690,7 @@ class GenerationEngine:
                 self.state, jnp.asarray(slots), jnp.asarray(last_toks),
                 jnp.asarray(lens), jnp.asarray(temp), jnp.asarray(top_p),
                 jnp.asarray(top_k), jnp.asarray(min_gen), jnp.asarray(max_gen),
-                jnp.asarray(stop_ids),
+                jnp.asarray(stop_ids), jnp.asarray(ctx_rows),
             )
 
     # ------------------------------------------------------------------ #
@@ -673,6 +731,11 @@ class GenerationEngine:
                 tokens[:, None] == state.stop_ids, axis=1
             ) & (n_gen >= state.min_gen)
             active = state.active & ~hit_stop & (n_gen < state.max_gen)
+            # keep the drafter's token mirror current (ctx[new_lens] = the
+            # token just sampled) so spec chunks can take over mid-stream
+            ctx_tokens = state.ctx_tokens.at[
+                rows, jnp.where(state.active, new_lens, self.S)
+            ].set(tokens, mode="drop")
             return dataclasses.replace(
                 state,
                 cache=cache,
@@ -682,6 +745,7 @@ class GenerationEngine:
                 n_gen=n_gen,
                 out_tokens=out_tokens,
                 out_logprobs=out_logprobs,
+                ctx_tokens=ctx_tokens,
                 rng=rng,
             )
 
@@ -708,6 +772,164 @@ class GenerationEngine:
         jitted = jax.jit(chunk, donate_argnums=(1,), **sharding_kw)
         self._jit_chunk[key] = jitted
         return jitted
+
+    # ------------------------------------------------------------------ #
+    # Speculative decode (docs/performance.md "Speculative decoding"):
+    # each scan step drafts K tokens per slot (self-drafting n-gram
+    # lookup), scores K+1 positions in ONE verify forward (one params +
+    # pool sweep where vanilla pays one per token), and accepts a prefix
+    # by rejection sampling — exactly distribution-preserving, entirely
+    # on device. Composes with everything the vanilla chunk guarantees:
+    # same GenState pytree (mixed spec/vanilla traffic adds no
+    # specializations beyond the chunk program itself), same flag-tuple
+    # harvest protocol (pipelining, pause, weight swap untouched).
+    # ------------------------------------------------------------------ #
+
+    def _spec_chunk_fn(self, n_steps: int, width: int, warp: bool):
+        key = (n_steps, width, warp, self.spec_k)
+        if key in self._jit_spec:
+            return self._jit_spec[key]
+        cfg = self.cfg
+        K = self.spec_k
+        C = K + 1
+        B, G, S = self.B, self.G, self.S
+
+        def one_spec_step(state: GenState, params, table):
+            draft = self.drafter.propose(
+                state.ctx_tokens, state.lens, state.fallback_token, K
+            )                                             # [B, K]
+            chunk_toks = jnp.concatenate(
+                [state.last_tokens[:, None], draft], axis=1
+            )                                             # [B, C]
+            pos_i = jnp.arange(C)[None, :]
+            n_new = jnp.where(state.active, C, 0).astype(jnp.int32)
+            # KV residency bound, acceptance-agnostic (see
+            # ``verify_step_paged``): position i's KV can only ever be
+            # read if emission n_gen+i stays below the cap — and writing
+            # past it could run off the slot's allocated pages
+            write_mask = state.active[:, None] & (
+                state.n_gen[:, None] + pos_i < state.max_gen[:, None]
+            )
+            logits, cache = tfm.verify_step_paged(
+                params, cfg, state.cache, chunk_toks, table, state.lens,
+                n_new, write_mask,
+            )
+            if self.mesh is not None:
+                # sampling runs replicated after one logits all-gather
+                # (same constraint as the vanilla chunk)
+                logits = jax.lax.with_sharding_constraint(
+                    logits, self._repl
+                )
+            rng, sub = jax.random.split(state.rng)
+            a, cand, cand_lp, boundary_arg = spec_rejection_sample(
+                sub, logits, draft, state.sp, warp=warp
+            )
+            # masked variable-length advance: accepted drafts + one
+            # residual token, capped at the remaining budget, truncated at
+            # the first accepted stop token (stop included, like vanilla)
+            remaining = state.max_gen - state.n_gen
+            e0 = jnp.minimum(a + 1, remaining)
+            emit_no = state.n_gen[:, None] + pos_i + 1
+            is_stop = jnp.any(
+                cand[:, :, None] == state.stop_ids[:, None, :], axis=2
+            ) & (emit_no >= state.min_gen[:, None])
+            stop_hit = is_stop & (pos_i < e0[:, None])
+            any_stop = stop_hit.any(axis=1)
+            first_stop = jnp.argmax(stop_hit, axis=1)
+            e = jnp.where(any_stop, first_stop + 1, e0)
+            e = jnp.where(state.active, e, 0)             # emitted count
+            emitted = pos_i < e[:, None]
+            rows = jnp.arange(B)
+            out_idx = jnp.where(emitted, state.n_gen[:, None] + pos_i, G)
+            out_tokens = state.out_tokens.at[rows[:, None], out_idx].set(
+                cand, mode="drop"
+            )
+            out_logprobs = state.out_logprobs.at[
+                rows[:, None], out_idx
+            ].set(cand_lp, mode="drop")
+            n_gen = state.n_gen + e
+            # t0's KV plus the accepted drafts' became resident; rejected
+            # drafts' writes sit beyond new_lens, masked until overwritten
+            new_lens = state.lens + e
+            last_tokens = jnp.where(
+                e > 0,
+                jnp.take_along_axis(
+                    cand, jnp.maximum(e - 1, 0)[:, None], axis=1
+                )[:, 0],
+                state.last_tokens,
+            )
+            active = state.active & ~any_stop & (n_gen < state.max_gen)
+            ctx_idx = jnp.where(
+                emitted, state.lens[:, None] + 1 + pos_i, S
+            )
+            ctx_tokens = state.ctx_tokens.at[rows[:, None], ctx_idx].set(
+                cand, mode="drop"
+            )
+            fallback = jnp.where(
+                state.active, boundary_arg, state.fallback_token
+            )
+            drafted = jnp.where(state.active, K, 0).astype(jnp.int32)
+            accepted = jnp.minimum(a, e).astype(jnp.int32)
+            new_state = dataclasses.replace(
+                state, cache=cache, lens=new_lens, last_tokens=last_tokens,
+                active=active, n_gen=n_gen, out_tokens=out_tokens,
+                out_logprobs=out_logprobs, ctx_tokens=ctx_tokens,
+                fallback_token=fallback, rng=rng,
+            )
+            return new_state, (drafted, accepted)
+
+        def spec_chunk(params, state, table):
+            def body(s, _):
+                return one_spec_step(s, params, table)
+
+            state, (drafted, accepted) = jax.lax.scan(
+                body, state, None, length=n_steps
+            )
+            # same 4-flag harvest protocol as the vanilla chunk, plus the
+            # per-step [n_steps, B] draft/accept grids the host folds into
+            # telemetry on the sync it already pays
+            return state, (state.active, state.n_gen, state.max_gen,
+                           state.lens, drafted, accepted)
+
+        sharding_kw = self._jit_sharding(1)
+        if sharding_kw:
+            sharding_kw = dict(sharding_kw)
+            sharding_kw["out_shardings"] = (
+                sharding_kw["out_shardings"], (self._repl,) * 6
+            )
+        jitted = jax.jit(spec_chunk, donate_argnums=(1,), **sharding_kw)
+        self._jit_spec[key] = jitted
+        return jitted
+
+    def _fold_spec_stats(self, drafted, accepted):
+        """Fold one spec chunk's ``[n_steps, B]`` drafted/accepted grids
+        into engine stats + telemetry counters — host bookkeeping riding
+        the per-chunk sync the engine already pays, no extra pulls."""
+        drafted = np.asarray(drafted)
+        accepted = np.asarray(accepted)
+        d = int(drafted.sum())
+        if d == 0:
+            return
+        acc = int(accepted.sum())
+        self.stats["spec_draft_tokens"] += d
+        self.stats["spec_accepted_tokens"] += acc
+        metrics_mod.counters.add(metrics_mod.GEN_SPEC_DRAFT_TOKENS, d)
+        metrics_mod.counters.add(metrics_mod.GEN_SPEC_ACCEPTED_TOKENS, acc)
+        vals, counts = np.unique(accepted[drafted > 0], return_counts=True)
+        for v, c in zip(vals, counts):
+            metrics_mod.counters.observe(
+                metrics_mod.GEN_SPEC_ACCEPT_LEN, float(v), n=int(c)
+            )
+
+    def _decode_chunk_fn(self, decode_steps: int, running: List[int]):
+        """Pick the chunk program (spec or vanilla) plus its table-width
+        token bound for one dispatch. ``self.spec`` is read here, under the
+        engine lock — flipping it between chunks is safe and takes effect
+        on the next dispatch (both programs share one state pytree)."""
+        tok_bound = decode_steps * ((self.spec_k + 1) if self.spec else 1)
+        warp = bool(self._warp_host[running].any())
+        make = self._spec_chunk_fn if self.spec else self._chunk_fn
+        return make, tok_bound, warp
 
     def _pull_outputs(self) -> dict:
         """ONE device pull of every slot's accumulated outputs + flags."""
@@ -775,17 +997,21 @@ class GenerationEngine:
                 return []
             # width-limit the chunk to the pages this chunk can touch
             running = [b for b, s in enumerate(self._slots) if s is not None]
+            make, tok_bound, warp = self._decode_chunk_fn(
+                decode_steps, running
+            )
             W = self._table_width(
-                int(self._lens_host[running].max()) + decode_steps
+                int(self._lens_host[running].max()) + tok_bound
             )
-            chunk = self._chunk_fn(
-                decode_steps, W, bool(self._warp_host[running].any())
-            )
+            chunk = make(decode_steps, W, warp)
             self.state, flags = chunk(
                 self.params, self.state, jnp.asarray(self._table_host[:, :W])
             )
             # one host sync per chunk
-            active, n_gen, max_gen, lens = jax.device_get(flags)
+            flags = jax.device_get(flags)
+            active, n_gen, max_gen, lens = flags[:4]
+            if len(flags) > 4:
+                self._fold_spec_stats(flags[4], flags[5])
             self._lens_host[:] = lens
             finished = [
                 b for b, info in enumerate(self._slots)
@@ -806,31 +1032,37 @@ class GenerationEngine:
 
     def _step_pipelined(self, decode_steps: int) -> List[GenOutput]:
         self._admit_pending()
-        new_flags, new_running = None, ()
+        new_flags, new_running, new_ahead = None, (), 0
         if self.n_running():
             running = [b for b, s in enumerate(self._slots) if s is not None]
+            make, tok_bound, warp = self._decode_chunk_fn(
+                decode_steps, running
+            )
             # _lens_host can be one in-flight chunk stale for continuing
-            # slots: widen the bound by the steps already dispatched
+            # slots: widen the bound by the TOKENS already dispatched
+            # (a spec chunk advances up to decode_steps * (K+1) of them)
             W = self._table_width(
                 int(self._lens_host[running].max())
-                + self._steps_ahead + decode_steps
+                + self._steps_ahead + tok_bound
             )
-            chunk = self._chunk_fn(
-                decode_steps, W, bool(self._warp_host[running].any())
-            )
+            chunk = make(decode_steps, W, warp)
             self.state, new_flags = chunk(
                 self.params, self.state, jnp.asarray(self._table_host[:, :W])
             )
             new_running = tuple(
                 (b, int(self._slot_epoch[b])) for b in running
             )
+            new_ahead = tok_bound
         prev_flags, prev_running = self._prev_flags, self._prev_running
         self._prev_flags, self._prev_running = new_flags, new_running
-        self._steps_ahead = decode_steps if new_flags is not None else 0
+        self._steps_ahead = new_ahead
         if prev_flags is None:
             return []
         # chunk k's flags resolved while k+1 computes: one overlapped RTT
-        active, n_gen, max_gen, lens = jax.device_get(prev_flags)
+        prev_flags = jax.device_get(prev_flags)
+        active, n_gen, max_gen, lens = prev_flags[:4]
+        if len(prev_flags) > 4:
+            self._fold_spec_stats(prev_flags[4], prev_flags[5])
         # epoch check: a slot that turned over since chunk k's dispatch now
         # holds a DIFFERENT request — k's stale flags must not touch it
         same = [

@@ -10,7 +10,11 @@ surface the rollout side depends on —
   interrupted (clients re-submit, ≈ the SGLang ``InterruptAllReq`` patch) →
   reload params from an HF checkpoint dir → resume. Returns ``num_paused``.
 - ``POST /pause_generation`` / ``POST /continue_generation``.
-- ``GET /health``, ``GET /metrics_json`` (running/served counters, version).
+- ``POST /spec_decode``: toggle speculative decoding between chunks (the
+  manager's lever when a workload's accept rate collapses below breakeven —
+  spec decode is distribution-preserving, so flipping it mid-serve is safe).
+- ``GET /health``, ``GET /metrics_json`` (running/served counters, version,
+  spec-decode accept rate).
 
 The engine's jitted chunks execute in a thread-pool executor so the asyncio
 loop stays responsive; one background task drives admission/decode
@@ -69,6 +73,7 @@ class GenerationHTTPServer:
         )
         self.app.router.add_post("/pause_generation", self._pause)
         self.app.router.add_post("/continue_generation", self._continue)
+        self.app.router.add_post("/spec_decode", self._spec_decode)
         self.app.router.add_get("/health", self._health)
         self.app.router.add_get("/metrics_json", self._metrics)
         self.app.on_startup.append(self._on_startup)
@@ -282,6 +287,22 @@ class GenerationHTTPServer:
         self.engine.resume()
         return web.json_response({"success": True})
 
+    async def _spec_decode(self, request: web.Request) -> web.Response:
+        """Toggle speculative decoding. Takes effect at the next chunk
+        dispatch (the engine reads the flag under its lock per step);
+        in-flight chunks finish under their dispatched program."""
+        try:
+            d = await request.json()
+            enabled = bool(d["enabled"])
+        except (KeyError, TypeError, ValueError) as e:
+            return web.json_response({"error": repr(e)}, status=400)
+        self.engine.spec = enabled
+        return web.json_response({
+            "success": True,
+            "spec_decode": self.engine.spec,
+            "spec_k": self.engine.spec_k,
+        })
+
     async def _health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
 
@@ -305,6 +326,14 @@ class GenerationHTTPServer:
             "weight_load_overlapped_s": round(self._t_weight_load, 3),
             "n_weight_updates": self._n_weight_updates,
             "n_interrupted": self._n_interrupted,
+            # speculative decoding: config + realized accept rate (the
+            # breakeven signal a manager would act on via /spec_decode)
+            "spec_decode": self.engine.spec,
+            "spec_k": self.engine.spec_k,
+            "spec_accept_rate": round(
+                self.engine.stats["spec_accepted_tokens"]
+                / max(self.engine.stats["spec_draft_tokens"], 1), 4
+            ),
             **{f"engine_{k}": v for k, v in self.engine.stats.items()},
         }
 

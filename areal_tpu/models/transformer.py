@@ -699,6 +699,68 @@ def _scatter_chunk_kv(cache: PagedKVCache, ks, vs, table, positions, valid):
     return PagedKVCache(pages=flat.reshape(cache.pages.shape))
 
 
+def _extend_layers(
+    params: Params,
+    cfg: ModelConfig,
+    cache: PagedKVCache,
+    tokens: jnp.ndarray,     # [B, C]
+    table: jnp.ndarray,      # [B, M]
+    start: jnp.ndarray,      # [B]
+    n_new: jnp.ndarray,      # [B]
+    skip_pool: bool = False,
+    verify: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Shared multi-token layer scan over the page pool (chunked prefill
+    AND the spec-decode verify pass — one implementation, two attention
+    entry points). Returns ``(x [B, C, E] pre-final-norm hidden, ks, vs,
+    positions, valid)``; the caller scatters KV and (for verify) applies
+    the head."""
+    from areal_tpu.ops import paged_attention as paged_ops
+
+    B, C = tokens.shape
+    positions = start[:, None] + jnp.arange(C)[None, :]
+    valid = jnp.arange(C)[None, :] < n_new[:, None]
+    x = _embed(cfg, params, tokens, positions)
+    if cfg.apply_rotary:
+        cos, sin = rotary_cos_sin(_rotary_cfg(cfg), positions, jnp.float32)
+    else:
+        cos = sin = None
+
+    def _attend(q, k, v, li):
+        kw = dict(
+            softmax_scale=cfg.softmax_scale,
+            soft_cap=cfg.attn_logits_soft_cap,
+            sliding_window=cfg.sliding_window,
+        )
+        if verify:
+            return paged_ops.paged_verify_attention(
+                q, k, v, cache.pages, li, table, start, n_new, **kw
+            )
+        return paged_ops.paged_extend_attention(
+            q, k, v, cache.pages, li, table, start, n_new,
+            skip_pool=skip_pool, **kw,
+        )
+
+    def layer(carry, lp):
+        x, li = carry                                 # pool NOT in the scan
+        lp = _cast(cfg, lp)
+        h = _norm(cfg, lp["ln1"], x)
+        q, k, v = _qkv(cfg, lp["attn"], h)            # [B, C, H(kv), D]
+        if cfg.apply_rotary:
+            q = apply_rotary(q, cos, sin)
+            k = apply_rotary(k, cos, sin)
+        ctx = _attend(q, k, v, li)
+        x = x + _attn_out(lp["attn"], ctx.astype(x.dtype))
+        h = _norm(cfg, lp["ln2"], x)
+        x = x + _mlp(cfg, lp["mlp"], h)[0]
+        return (x, li + 1), (k, v)
+
+    (x, _), (ks, vs) = jax.lax.scan(
+        layer, (x, jnp.int32(0)), params["layers"]
+    )
+    return x, ks, vs, positions, valid
+
+
 def extend_paged(
     params: Params,
     cfg: ModelConfig,
@@ -715,41 +777,44 @@ def extend_paged(
     computed — admission feeds the last prompt token to the first decode
     step instead. ``skip_pool`` (STATIC): every row starts at position 0,
     so the pool scan is dead weight (see ``paged_extend_attention``)."""
-    from areal_tpu.ops import paged_attention as paged_ops
-
-    B, C = tokens.shape
-    positions = start[:, None] + jnp.arange(C)[None, :]
-    valid = jnp.arange(C)[None, :] < n_new[:, None]
-    x = _embed(cfg, params, tokens, positions)
-    if cfg.apply_rotary:
-        cos, sin = rotary_cos_sin(_rotary_cfg(cfg), positions, jnp.float32)
-    else:
-        cos = sin = None
-
-    def layer(carry, lp):
-        x, li = carry                                 # pool NOT in the scan
-        lp = _cast(cfg, lp)
-        h = _norm(cfg, lp["ln1"], x)
-        q, k, v = _qkv(cfg, lp["attn"], h)            # [B, C, H(kv), D]
-        if cfg.apply_rotary:
-            q = apply_rotary(q, cos, sin)
-            k = apply_rotary(k, cos, sin)
-        ctx = paged_ops.paged_extend_attention(
-            q, k, v, cache.pages, li, table, start, n_new,
-            softmax_scale=cfg.softmax_scale,
-            soft_cap=cfg.attn_logits_soft_cap,
-            sliding_window=cfg.sliding_window,
-            skip_pool=skip_pool,
-        )
-        x = x + _attn_out(lp["attn"], ctx.astype(x.dtype))
-        h = _norm(cfg, lp["ln2"], x)
-        x = x + _mlp(cfg, lp["mlp"], h)[0]
-        return (x, li + 1), (k, v)
-
-    _, (ks, vs) = jax.lax.scan(
-        layer, (x, jnp.int32(0)), params["layers"]
+    _, ks, vs, positions, valid = _extend_layers(
+        params, cfg, cache, tokens, table, start, n_new, skip_pool=skip_pool
     )
     return _scatter_chunk_kv(cache, ks, vs, table, positions, valid)
+
+
+def verify_step_paged(
+    params: Params,
+    cfg: ModelConfig,
+    cache: PagedKVCache,
+    tokens: jnp.ndarray,       # [B, C] verify chunk: [last_token, d_1..d_K]
+    table: jnp.ndarray,        # [B, M]
+    lens: jnp.ndarray,         # [B] resident tokens (chunk starts here)
+    n_new: jnp.ndarray,        # [B] C where the slot is active, 0 otherwise
+    write_mask: jnp.ndarray,   # [B, C] which chunk positions' KV may land
+) -> Tuple[jnp.ndarray, PagedKVCache]:
+    """Speculative-decode VERIFY: ``decode_step_paged`` generalized to C =
+    K+1 query tokens per slot in ONE pass — one params read and one pool
+    sweep score the whole draft, where vanilla decode pays both per token.
+    Returns fp32 logits ``[B, C, V]`` (position ``i`` is the distribution
+    for the token following ``tokens[:, i]``) and the cache with the
+    chunk's KV scattered where ``write_mask`` allows.
+
+    ``write_mask`` is the acceptance-agnostic residency bound the engine
+    computes (``active & (n_gen + i < max_gen)``): rejected drafts' KV
+    lands in pool positions beyond the post-acceptance ``lens``, which
+    attention never reads (``pos < lens``) and later steps overwrite
+    before ``lens`` reaches them — so the scatter can run BEFORE the
+    accept/reject decision, keeping the whole spec step inside one jitted
+    chunk with no host sync. The mask only exists to keep writes inside
+    the slot's allocated pages (a position past ``max_gen`` could fall off
+    the page table and alias page 0)."""
+    x, ks, vs, positions, _ = _extend_layers(
+        params, cfg, cache, tokens, table, lens, n_new, verify=True
+    )
+    cache = _scatter_chunk_kv(cache, ks, vs, table, positions, write_mask)
+    x = _norm(cfg, _cast(cfg, params["final_ln"]), x)
+    return _head(cfg, params, x), cache
 
 
 def decode_step_paged(

@@ -14,6 +14,9 @@ counterpart of the reference's "Generation throughput: X tokens/s" log,
   slots @ 1k-token prompts, continuous decode — prefill + decode tokens/s
 - ``gen32k``: same model, 4 slots at ~31.5k-token context (the published
   32k protocol, ``benchmark/verl_v0_3_0_post1_76084d3/README.md:39-41``)
+- ``gen_spec``: vanilla vs speculative decode A/B at the 64-slot config
+  on repetitive prompts — accepted-tokens/s, accept rate, vs_baseline
+  (docs/performance.md "Speculative decoding")
 - ``ppo``: a complete in-process async-PPO round (generate a GRPO group
   per prompt -> verify -> decoupled-PPO train step -> weight swap into
   the engine) — reward-samples/sec/chip, the north-star unit
@@ -277,6 +280,7 @@ def _free_engine(eng):
     eng.state = None
     eng.params = None
     eng._jit_extend = eng._jit_commit = eng._jit_chunk = None
+    eng._jit_spec = None
     gc.collect()
 
 
@@ -339,6 +343,88 @@ def _bench_gen_32k(peak_bw: float, peak: float):
         "context_len": PLEN, "slots": B,
         "decode_roofline_tokens_per_s": round(roof, 1),
         "vs_roofline": round(decode_tok_s / roof, 4),
+    }
+
+
+def _bench_gen_spec(
+    peak_bw: float,
+    peak: float,
+    cfg=None,
+    B: int = 64,
+    PLEN: int = 1024,
+    D_STEPS: int = 32,
+    N_CHUNKS: int = 4,
+    motif_len: int = 24,
+):
+    """A/B vanilla vs speculative decode (AREAL_SPEC_DECODE) at the
+    standard 64-slot/1024-prompt generation config, on REPETITIVE prompts
+    — the self-drafting n-gram drafter's sweet spot (structured math/code
+    generations re-quote their context; random prompts are its worst
+    case, bounded below by vanilla + the verify overhead). Greedy
+    sampling: spec decode is token-exact there, so both arms emit the
+    SAME tokens and ``vs_baseline`` = spec/vanilla accepted-tokens/s is a
+    pure speed ratio. Reported accept rate is drafted-accepted /
+    drafted (docs/performance.md "Speculative decoding"); the small
+    ``cfg``/shape overrides exist so tests can smoke the stanza on CPU."""
+    import jax
+
+    from areal_tpu.base import constants as const
+    from areal_tpu.gen.engine import GenerationEngine, GenRequest
+    from areal_tpu.models import transformer as tfm
+
+    cfg = cfg or _gen_model_cfg()
+    rng = np.random.default_rng(0)
+    motif = [int(x) for x in rng.integers(1, 50000, motif_len)]
+    prompts = []
+    for i in range(B):
+        p = (motif * (PLEN // motif_len + 1))[:PLEN]
+        p[0] = 1 + i                       # distinct slots, no prefix share
+        prompts.append(p)
+    params = tfm.init_params(cfg, jax.random.key(0), dtype=cfg.dtype)
+
+    def run_arm(spec: bool):
+        with _env(const.SPEC_DECODE_ENV, "1" if spec else "0"):
+            eng = GenerationEngine(
+                cfg, params, max_slots=B, max_seqlen=2 * PLEN,
+                max_new_tokens_cap=PLEN, page_size=min(128, PLEN // 4),
+                enable_prefix_cache=False,
+                admit_chunk_tokens=min(1024, PLEN),
+            )
+        k = eng.spec_k
+        for i, p in enumerate(prompts):
+            eng.submit(GenRequest(
+                rid=f"{'s' if spec else 'v'}{i}", input_ids=p,
+                max_new_tokens=PLEN, greedy=True,
+            ))
+        eng.step(decode_steps=1)           # admission + first decode
+        eng.step(decode_steps=D_STEPS)     # warm the chunk program
+        n0 = int(np.asarray(jax.device_get(eng.state.n_gen)).sum())
+        t0 = time.perf_counter()
+        for _ in range(N_CHUNKS):
+            eng.step(decode_steps=D_STEPS)
+        n1 = int(np.asarray(jax.device_get(eng.state.n_gen)).sum())  # drain
+        dt = time.perf_counter() - t0
+        drafted = eng.stats["spec_draft_tokens"]
+        accepted = eng.stats["spec_accepted_tokens"]
+        eng.pause()
+        _free_engine(eng)
+        return {
+            "tokens_per_s": (n1 - n0) / dt,
+            "accept_rate": accepted / max(drafted, 1),
+            "spec_k": k,
+        }
+
+    vanilla = run_arm(False)
+    spec = run_arm(True)
+    return {
+        "vanilla_tokens_per_s": round(vanilla["tokens_per_s"], 1),
+        "accepted_tokens_per_s": round(spec["tokens_per_s"], 1),
+        "accept_rate": round(spec["accept_rate"], 4),
+        "spec_k": spec["spec_k"],
+        "slots": B, "prompt_len": PLEN, "prompt": "repetitive",
+        "vs_baseline": round(
+            spec["tokens_per_s"] / max(vanilla["tokens_per_s"], 1e-9), 4
+        ),
     }
 
 
@@ -1046,6 +1132,7 @@ def main():
         # pipeline flags simply stay at their measured-default settings
         ("fwd_pipe", lambda: _bench_fwd_pipe(peak), True),
         ("gen_pipe", lambda: _bench_gen(peak_bw, peak, pipelined=True), True),
+        ("gen_spec", lambda: _bench_gen_spec(peak_bw, peak), True),
         ("bwd_pipe",
          lambda: _bench_bwd_pipe(cfg_small, cfg_32k, peak), True),
         ("guard", lambda: _bench_guard(peak), True),
