@@ -1,0 +1,34 @@
+# Developer entry points. The tier-1 test command of record lives in
+# ROADMAP.md; these targets wrap the static-analysis layer
+# (docs/static_analysis.md).
+
+PYTHON ?= python
+# Diff base for lint-fast: any git ref (branch, SHA, HEAD~1, ...).
+SINCE ?= HEAD
+
+.PHONY: lint lint-fast lint-rules
+
+# Full whole-program scan: areal_tpu/ tools/ tests/, project rules on,
+# baseline applied. This is what tier-1's TestFullTreeGate enforces.
+lint:
+	$(PYTHON) -m tools.arealint
+
+# Pre-commit fast path (<2 s on a small diff): scan only the Python
+# files touched vs $(SINCE), PLUS untracked files — `git diff` alone
+# never lists a brand-new module, which is exactly where a fresh
+# PartitionSpec typo would live. git runs OUT HERE — the linter itself
+# is pure stdlib and reads the file list from stdin (--changed-only).
+# Cross-module context degrades to the changed set by design: the scan
+# is exactly a full scan restricted to those files (property pinned by
+# tests/test_arealint_spmd.py).
+# The ref is verified first: a typo'd $(SINCE) must fail loudly, not
+# let the pipeline swallow git's error and report a false "clean".
+lint-fast:
+	@git rev-parse --verify --quiet "$(SINCE)^{commit}" >/dev/null || \
+		{ echo "lint-fast: unknown ref '$(SINCE)'" >&2; exit 2; }
+	{ git diff --name-only $(SINCE); \
+	  git ls-files --others --exclude-standard; } | \
+		$(PYTHON) -m tools.arealint --changed-only --since $(SINCE)
+
+lint-rules:
+	$(PYTHON) -m tools.arealint --list-rules

@@ -161,7 +161,10 @@ def ring_attention(
         import math
 
         bk = math.gcd(chunk, bk)  # largest workable sub-chunk
-    body = functools.partial(
+    # NOT named `body`: _ring_shard's internal scan body def shares that
+    # name, and the shadowing made the wrapped callable ambiguous to
+    # read (and to arealint's shard_map arity resolution)
+    ring_body = functools.partial(
         _ring_shard,
         axis_name=axis_name,
         scale=softmax_scale,
@@ -178,7 +181,7 @@ def ring_attention(
     spec_t = P(axis_name)
     spec_qkv = P(axis_name, head_ax, None)
     return shard_map(
-        body,
+        ring_body,
         mesh=mesh,
         in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_t),
         out_specs=spec_qkv,

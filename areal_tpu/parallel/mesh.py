@@ -121,14 +121,30 @@ def logical_to_pspec(
     axes: Optional[Tuple[Optional[str], ...]],
     rules: Optional[Dict[str, Optional[str]]] = None,
 ) -> P:
+    """PartitionSpec for one parameter's logical-axis tuple.
+
+    Unknown logical names raise: ``rules.get`` would silently map a typo
+    ("vocag") to None — fully replicating a tensor the config meant to
+    shard, with no error and an HBM/step-time regression as the only
+    symptom. The runtime twin of arealint's ``unknown-mesh-axis`` rule.
+    """
     if axes is None:
         return P()
     rules = rules or DEFAULT_RULES
+    unknown = [a for a in axes if a is not None and a not in rules]
+    if unknown:
+        raise ValueError(
+            f"unknown logical axis name(s) {unknown} in {axes!r}; the "
+            f"sharding rules know {sorted(rules)} — a typo here would "
+            "silently replicate the parameter instead of sharding it"
+        )
     return P(*(rules.get(a) if a is not None else None for a in axes))
 
 
 def param_shardings(mesh: Mesh, logical_tree, rules=None):
-    """Map a tree of logical-axis tuples to NamedShardings (same structure)."""
+    """Map a tree of logical-axis tuples to NamedShardings (same
+    structure). Validates every logical name via ``logical_to_pspec`` —
+    a typo'd axis raises instead of silently replicating the leaf."""
     return jax.tree.map(
         lambda axes: NamedSharding(mesh, logical_to_pspec(axes, rules)),
         logical_tree,

@@ -199,7 +199,13 @@ def allreduce_min(x: np.ndarray) -> np.ndarray:
 def main_decides(flag: bool) -> bool:
     """Broadcast a host-side control decision from process 0 so every process
     takes the same branch (per-host clocks/timers must never steer
-    collective-bearing paths — a straddled timer deadlocks the pod)."""
+    collective-bearing paths — a straddled timer deadlocks the pod).
+
+    This is the gate arealint's ``host-divergence-collective`` rule
+    recognizes: a branch on host-local state (clocks, signal flags,
+    queue depth, ``process_index()``) that guards a collective must
+    route its condition through here — the rule flags any that don't
+    (docs/static_analysis.md "SPMD rules")."""
     if not is_multihost():
         return flag
     return bool(allgather_rows(np.int64(flag))[0])

@@ -352,7 +352,9 @@ class AsyncPPOTrainerWorker:
             self._bump_watchdog()  # a slow HF export is not a hang
         # process 0's timer decides for everyone: save_recover_checkpoint
         # contains collectives, so a wall-clock boundary straddled across
-        # hosts must not split the control flow
+        # hosts must not split the control flow (machine-checked:
+        # arealint's host-divergence-collective flags this branch if the
+        # main_decides routing is ever removed)
         if multihost.main_decides(self._ckpt_ctl.check(steps=1)):
             self.save_recover_checkpoint()
             self._bump_watchdog()  # a slow committed save is not a hang
@@ -558,7 +560,8 @@ class AsyncPPOTrainerWorker:
                 # host at a slightly different instant, and a host-local
                 # branch into the (collective-bearing) preemption save while
                 # siblings are mid-train-step would deadlock the pod — the
-                # same rule as the ckpt timer below (multihost.main_decides).
+                # same rule as the ckpt timer below (multihost.main_decides;
+                # machine-checked by arealint host-divergence-collective).
                 # Cost: one extra per-step allgather on multihost (free
                 # single-host), marginal next to _collect_batch's existing
                 # per-iteration allreduces.

@@ -680,13 +680,46 @@ class TestSarif:
                 "but a non-literal at another site",
                 "warn",
             ),
+            # one finding per v3 SPMD family (docs/static_analysis.md
+            # "SPMD rules")
+            Finding(
+                "areal_tpu/train/demo.py", 12, "unknown-mesh-axis",
+                "unknown mesh axis 'modle' in PartitionSpec — the mesh "
+                "built by make_mesh has axes (data, fsdp, ctx, model)",
+                "error",
+            ),
+            Finding(
+                "areal_tpu/ops/demo.py", 21, "shard-map-spec-arity",
+                "shard_map in_specs has 2 entries but body() takes 3 "
+                "positional argument(s) — every operand needs exactly "
+                "one spec",
+                "error",
+            ),
+            Finding(
+                "areal_tpu/gen/demo.py", 33, "hot-path-reshard",
+                "with_sharding_constraint() changes the inferred "
+                "sharding of 'x' from P(('data','fsdp')) to P() in "
+                "decode() (reachable from hot root Engine.step()) — an "
+                "implicit reshard on the hot path",
+                "error",
+            ),
+            Finding(
+                "areal_tpu/system/demo.py", 48,
+                "host-divergence-collective",
+                "branch in run() depends on host-local time.monotonic() "
+                "but guards collective multihost.barrier() via "
+                "save_recover_checkpoint()",
+                "error",
+            ),
         ]
         rendered = sarif.dumps(
             findings,
             root="/checkout",
             rule_ids=[
                 "bare-gather", "host-sync-cross-module",
-                "jit-weak-type-drift",
+                "jit-weak-type-drift", "unknown-mesh-axis",
+                "shard-map-spec-arity", "hot-path-reshard",
+                "host-divergence-collective",
             ],
         ) + "\n"
         with open(self.GOLDEN, encoding="utf-8") as f:

@@ -42,6 +42,10 @@ from tools.arealint.core import (  # noqa: F401
     scan_source,
     scan_sources,
 )
+from tools.arealint.meshmodel import (  # noqa: F401
+    MeshModel,
+    parse_mesh_module,
+)
 from tools.arealint.project import Project  # noqa: F401
 from tools.arealint.callgraph import (  # noqa: F401
     CallGraph,
@@ -55,6 +59,7 @@ from tools.arealint import rules_jax  # noqa: E402,F401
 from tools.arealint import rules_hygiene  # noqa: E402,F401
 from tools.arealint import rules_concurrency  # noqa: E402,F401
 from tools.arealint import rules_dataflow  # noqa: E402,F401
+from tools.arealint import rules_spmd  # noqa: E402,F401
 
 from tools.arealint.baseline import (  # noqa: F401
     DEFAULT_BASELINE,

@@ -59,6 +59,8 @@ class CallGraph:
         # synthesized nodes for local-def thread targets
         # ("caller.<local>.name" -> FunctionInfo)
         self.local_functions: Dict[str, FunctionInfo] = {}
+        # memoized per-caller {id(Call node): callee} maps
+        self._callees_by_node: Dict[str, Dict[int, str]] = {}
 
     def function(self, qualname: str) -> Optional[FunctionInfo]:
         """FunctionInfo for any graph node, including synthesized
@@ -66,6 +68,21 @@ class CallGraph:
         return self.local_functions.get(qualname) or self.project.function(
             qualname
         )
+
+    def callees_by_node(self, caller: str) -> Dict[int, str]:
+        """``id(call AST node) -> resolved callee qualname`` for one
+        caller — the lookup rules doing their own AST walk over a
+        function body need to map the Call nodes they encounter back to
+        graph edges (memoized; rules_spmd's taint and branch passes hit
+        this for every analyzed function)."""
+        got = self._callees_by_node.get(caller)
+        if got is None:
+            got = {
+                id(s.node): s.callee
+                for s in self.sites_by_caller.get(caller, ())
+            }
+            self._callees_by_node[caller] = got
+        return got
 
     # ----------------------------------------------------------------- #
 

@@ -63,6 +63,16 @@ TEST_RELAXED_RULES = frozenset({
     "unregistered-counter",
     "unregistered-fault-point",
     "suppression-missing-reason",
+    # SPMD family: tests build synthetic meshes with their own axis
+    # names, reshard deliberately in fixtures, and run single-process
+    # where host divergence cannot straddle a real collective. The
+    # arity rule (shard-map-spec-arity) STAYS enforced — a wrong-arity
+    # shard_map wedges a test exactly like stack code.
+    "unknown-mesh-axis",
+    "mesh-axis-reuse",
+    "hot-path-reshard",
+    "donation-sharding-mismatch",
+    "host-divergence-collective",
 })
 # The linter's own sources quote suppression tokens in rule docs and
 # docstrings; policing them there is self-noise.
@@ -121,10 +131,16 @@ class Config:
     counter_names: Optional[frozenset] = None
     # Registered fault injection points (base/faults.py FAULT_POINTS).
     fault_points: Optional[frozenset] = None
+    # Mesh axis names + logical rules parsed from parallel/mesh.py
+    # (tools.arealint.meshmodel.MeshModel); None disables the mesh-axis
+    # rule family (degrade, never guess).
+    mesh: Optional[object] = None
     repo_root: Optional[pathlib.Path] = None
 
     @classmethod
     def from_repo(cls, root: Optional[pathlib.Path] = None) -> "Config":
+        from tools.arealint import meshmodel
+
         root = pathlib.Path(root) if root else default_repo_root()
         cfg = cls(repo_root=root)
         metrics_py = root / "areal_tpu" / "base" / "metrics.py"
@@ -135,6 +151,7 @@ class Config:
             cfg.counter_values = frozenset(values)
         if faults_py.is_file():
             cfg.fault_points = _fault_points(faults_py)
+        cfg.mesh = meshmodel.from_repo(root)
         return cfg
 
 
@@ -251,7 +268,9 @@ class FileContext:
 def walk_excluding_nested(fdef) -> Iterator[ast.AST]:
     """Nodes of a function's OWN body — nested function/lambda bodies are
     separate execution contexts and are excluded (they are scanned when
-    the call graph reaches them)."""
+    the call graph reaches them). Also accepts a bare statement/node
+    list (a branch body), so rules walking an If's arms share the same
+    exclusion semantics instead of re-implementing them."""
 
     def _walk(node):
         for child in ast.iter_child_nodes(node):
@@ -262,7 +281,8 @@ def walk_excluding_nested(fdef) -> Iterator[ast.AST]:
                 continue
             yield from _walk(child)
 
-    for stmt in fdef.body:
+    body = fdef if isinstance(fdef, (list, tuple)) else fdef.body
+    for stmt in body:
         yield stmt
         if not isinstance(
             stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)

@@ -1,0 +1,141 @@
+"""The mesh model: the SPMD rules' catalog, parsed from
+``areal_tpu/parallel/mesh.py`` with ``ast`` — never imported.
+
+Same provenance contract as the counter/fault catalogs
+(docs/static_analysis.md "Knob/registry hygiene"): the linter runs in a
+bare CI container with no jax, so the single source of truth for mesh
+axis names and logical→mesh rules is read statically from the module
+that defines them:
+
+- **axis names** come from the ``Mesh(devs, ("data", "fsdp", "ctx",
+  "model"))`` construction inside ``make_mesh`` (the tuple literal is
+  the authoritative axis order);
+- **logical rules** come from the module-level ``DEFAULT_RULES`` dict
+  literal (logical axis name → mesh axis name or None=replicated).
+
+Catalog drift fails loudly twice over: the parser returns ``None`` when
+the expected shapes are missing (every mesh-axis rule degrades to
+no-finding rather than guessing), and ``tests/test_arealint_spmd.py``
+pins the parsed axis tuple against the tuple ``make_mesh`` actually
+builds at runtime.
+"""
+
+import ast
+import dataclasses
+import pathlib
+from typing import Dict, Optional, Tuple
+
+MESH_MODULE = pathlib.Path("areal_tpu") / "parallel" / "mesh.py"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshModel:
+    """Parsed mesh facts the SPMD rules check against."""
+
+    # mesh axis names, in construction order ("data", "fsdp", "ctx", "model")
+    axes: Tuple[str, ...]
+    # logical axis name -> mesh axis name (None = replicated)
+    logical_rules: Optional[Dict[str, Optional[str]]] = None
+    # where the model was parsed from (diagnostics only)
+    source: str = ""
+
+    @property
+    def axis_set(self) -> frozenset:
+        return frozenset(self.axes)
+
+    def known_axis(self, name: str) -> bool:
+        return name in self.axes
+
+
+def _mesh_axes_from_tree(tree: ast.Module) -> Optional[Tuple[str, ...]]:
+    """The axis tuple of the ``Mesh(devs, (...))`` call. Preference order:
+    a call inside a ``def make_mesh``, else any Mesh call in the module —
+    ambiguity (two calls with different tuples) degrades to None."""
+
+    def mesh_calls(root) -> list:
+        out = []
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else ""
+            )
+            if name != "Mesh" or len(node.args) < 2:
+                continue
+            axes_node = node.args[1]
+            if isinstance(axes_node, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in axes_node.elts
+            ):
+                out.append(tuple(e.value for e in axes_node.elts))
+        return out
+
+    scopes = [
+        n for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef) and n.name == "make_mesh"
+    ]
+    found = []
+    for scope in scopes:
+        found.extend(mesh_calls(scope))
+    if not found:
+        # no literal tuple inside make_mesh (or no make_mesh at all):
+        # fall back to the whole module before giving up
+        found = mesh_calls(tree)
+    distinct = sorted(set(found))
+    return distinct[0] if len(distinct) == 1 else None
+
+
+def _logical_rules_from_tree(
+    tree: ast.Module,
+) -> Optional[Dict[str, Optional[str]]]:
+    """The module-level ``DEFAULT_RULES = {"logical": "mesh"|None, ...}``
+    dict literal; None when absent or not fully literal."""
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            target = node.target
+            value = node.value
+        else:
+            continue
+        if not (isinstance(target, ast.Name) and target.id == "DEFAULT_RULES"):
+            continue
+        if not isinstance(value, ast.Dict):
+            return None
+        rules: Dict[str, Optional[str]] = {}
+        for k, v in zip(value.keys, value.values):
+            if not (
+                isinstance(k, ast.Constant) and isinstance(k.value, str)
+                and isinstance(v, ast.Constant)
+                and (v.value is None or isinstance(v.value, str))
+            ):
+                return None  # computed entry: degrade, never guess
+            rules[k.value] = v.value
+        return rules
+    return None
+
+
+def parse_mesh_module(path) -> Optional[MeshModel]:
+    """MeshModel parsed from a mesh.py-shaped file, or None when the
+    expected shapes (Mesh axis tuple) are missing — callers degrade."""
+    path = pathlib.Path(path)
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except (OSError, SyntaxError):
+        return None
+    axes = _mesh_axes_from_tree(tree)
+    if not axes:
+        return None
+    return MeshModel(
+        axes=axes,
+        logical_rules=_logical_rules_from_tree(tree),
+        source=str(path).replace("\\", "/"),
+    )
+
+
+def from_repo(root) -> Optional[MeshModel]:
+    p = pathlib.Path(root) / MESH_MODULE
+    return parse_mesh_module(p) if p.is_file() else None

@@ -176,6 +176,30 @@ def _dotted(node: ast.AST) -> Optional[str]:
     return None
 
 
+def collect_aliases(tree: ast.AST) -> Dict[str, str]:
+    """name -> dotted import target, collected from EVERY scope — unlike
+    :class:`ModuleInfo`'s top-level import table, this sees imports done
+    inside functions (the repo imports ``PartitionSpec as P`` and
+    ``shard_map`` locally in several ops modules). Recognition-only: a
+    scope collision just makes a match more permissive, so callers use
+    it for *classifying* constructors (degrade on miss), never for
+    building call-graph edges."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = (
+                    f"{base}.{a.name}" if base else a.name
+                )
+    return out
+
+
 class Project:
     """The indexed file set. Build with :meth:`from_paths` (real tree) or
     :meth:`from_sources` (fixture dict, used by the rule tests)."""

@@ -1,0 +1,1315 @@
+"""SPMD/sharding-safety rules: mesh-axis checking, sharding-propagation
+lite, and host-divergence-before-collective detection.
+
+The trainer is 3D-parallel (``parallel/mesh.py``: axes ``data/fsdp/ctx/
+model``) and its worst failure modes are SPMD-shaped — a typo'd mesh
+axis in a ``PartitionSpec`` silently replicates a tensor, an implicit
+reshard inside the decode/train hot path moves gigabytes per step, and
+host-divergent control flow ahead of a collective wedges every process
+in the pod at once. Four rule families catch these at lint time:
+
+- **mesh-axis family** (file rules) — ``unknown-mesh-axis`` (axis name
+  not in the parsed mesh catalog, see :mod:`tools.arealint.meshmodel`),
+  ``mesh-axis-reuse`` (one axis used for two dims of one spec),
+  ``shard-map-spec-arity`` (``in_specs``/``out_specs`` arity vs. the
+  wrapped function's signature and the immediate invocation), and
+  ``donation-sharding-mismatch`` (a donated operand whose inferred
+  sharding matches no ``out_shardings`` entry — XLA cannot alias the
+  buffer, so the donation is a silent copy).
+- **sharding-propagation lite** (project rules) — a per-function
+  inference pass tracks ``NamedSharding``-typed locals/attributes and
+  the placements ``device_put``/``with_sharding_constraint`` establish.
+  ``hot-path-reshard`` flags a placement call that CHANGES the inferred
+  spec of a value inside a jitted / ``# arealint: hot`` root (or
+  anything reachable from one); ``jit-sharding-disagreement`` flags
+  call sites of one jitted function passing differently-sharded
+  operands at the same position (one trace per layout + a reshard at
+  the losing sites).
+- **host divergence** (project rule) — ``host-divergence-collective``:
+  host-local nondeterminism (``time.*``, runtime ``os.environ`` reads
+  outside the knob catalog, ``random``/``secrets``/``uuid``,
+  ``process_index()`` comparisons, queue state) flowing — through
+  assignments and cross-module return values — into a branch whose body
+  reaches a collective (``multihost.barrier/allreduce_*`` etc., a
+  function containing ``lax.psum``-family ops, ``with mesh:`` entry)
+  without being routed through ``multihost.main_decides``. The exact
+  class PR 3 hand-fixed for SIGTERM timing.
+
+Everything degrades (docs/static_analysis.md): a spec the inference
+cannot resolve, an axis entry that is not a literal, or an unresolvable
+call edge produces NO finding — the propagation pass never guesses.
+"""
+
+import ast
+import collections
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from tools.arealint.core import (
+    FileContext, ProjectContext, SEVERITY_ERROR, SEVERITY_WARN,
+    project_rule, rule, walk_excluding_nested,
+)
+from tools.arealint.project import FunctionInfo, _dotted, collect_aliases
+from tools.arealint.rules_dataflow import _short
+from tools.arealint.rules_hygiene import (
+    ENV_CATALOG_SUFFIXES, ENV_HELPER_FILE, _env_read,
+)
+from tools.arealint.rules_jax import (
+    _donated_positions, _has_jit_decorator, _is_jit_call,
+    file_hot_roots, intra_hot_reachable,
+)
+
+# --------------------------------------------------------------------- #
+# alias table + constructor recognition
+# --------------------------------------------------------------------- #
+
+
+def _file_aliases(ctx: FileContext) -> Dict[str, str]:
+    cached = getattr(ctx, "_spmd_aliases", None)
+    if cached is None:
+        cached = collect_aliases(ctx.tree)
+        ctx._spmd_aliases = cached
+    return cached
+
+
+def _ctor_matches(
+    aliases: Dict[str, str], func: ast.AST, name: str
+) -> bool:
+    """``X.<name>(...)`` attribute form, a bare ``<name>`` import, or an
+    alias whose import target ends in ``.<name>``."""
+    if isinstance(func, ast.Attribute):
+        return func.attr == name
+    if isinstance(func, ast.Name):
+        if func.id == name:
+            return True
+        return aliases.get(func.id, "").split(".")[-1] == name
+    return False
+
+
+def _is_pspec_ctor(aliases, call: ast.AST) -> bool:
+    return isinstance(call, ast.Call) and _ctor_matches(
+        aliases, call.func, "PartitionSpec"
+    )
+
+
+def _is_named_sharding_ctor(aliases, call: ast.AST) -> bool:
+    return isinstance(call, ast.Call) and _ctor_matches(
+        aliases, call.func, "NamedSharding"
+    )
+
+
+def _is_shard_map_call(aliases, call: ast.AST) -> bool:
+    return isinstance(call, ast.Call) and _ctor_matches(
+        aliases, call.func, "shard_map"
+    )
+
+
+def _is_placement_call(call: ast.AST) -> Optional[str]:
+    """``jax.device_put`` / ``with_sharding_constraint`` (any spelling) —
+    the two ops that *establish* a value's sharding."""
+    if not isinstance(call, ast.Call):
+        return None
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else ""
+    )
+    if name in ("device_put", "with_sharding_constraint"):
+        return name
+    return None
+
+
+# --------------------------------------------------------------------- #
+# spec parsing / canonicalization
+# --------------------------------------------------------------------- #
+
+_UNRESOLVED = object()
+
+
+def _pspec_entries(call: ast.Call) -> List[Tuple[ast.AST, object]]:
+    """Per positional arg of a ``P(...)`` call: (node, parsed) where
+    parsed is None (replicated), a str axis, a tuple of str axes, or
+    ``_UNRESOLVED`` (dynamic expression)."""
+    out: List[Tuple[ast.AST, object]] = []
+    for a in call.args:
+        if isinstance(a, ast.Constant) and a.value is None:
+            out.append((a, None))
+        elif isinstance(a, ast.Constant) and isinstance(a.value, str):
+            out.append((a, a.value))
+        elif isinstance(a, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in a.elts
+        ):
+            out.append((a, tuple(e.value for e in a.elts)))
+        else:
+            out.append((a, _UNRESOLVED))
+    return out
+
+
+def _canonical_pspec(call: ast.Call) -> Optional[tuple]:
+    """Fully-literal spec as a canonical tuple (trailing replicated dims
+    stripped — ``P('data', None)`` == ``P('data')``); None when any
+    entry is dynamic (degrade)."""
+    entries = _pspec_entries(call)
+    if any(parsed is _UNRESOLVED for _, parsed in entries):
+        return None
+    spec = [parsed for _, parsed in entries]
+    while spec and spec[-1] is None:
+        spec.pop()
+    return tuple(spec)
+
+
+def _fmt_spec(spec: tuple) -> str:
+    def one(e):
+        if e is None:
+            return "None"
+        if isinstance(e, tuple):
+            return "(" + ",".join(repr(x) for x in e) + ")"
+        return repr(e)
+
+    return "P(" + ", ".join(one(e) for e in spec) + ")"
+
+
+def _spec_axis_names(call: ast.Call) -> Iterator[Tuple[ast.AST, str]]:
+    """Every literal axis-name string in a ``P(...)`` call, including
+    inside tuple entries — dynamic entries are simply skipped."""
+    for node, parsed in _pspec_entries(call):
+        if isinstance(parsed, str):
+            yield node, parsed
+        elif isinstance(parsed, tuple):
+            for e, v in zip(node.elts, parsed):
+                yield e, v
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _sharding_spec_of(
+    aliases, expr: ast.AST, shvars: Dict[str, tuple]
+) -> Optional[tuple]:
+    """Canonical spec of a sharding-valued EXPRESSION: an inline
+    ``NamedSharding(mesh, P(...))``, an inline ``P(...)``, or a name /
+    ``self.attr`` previously bound to one (``shvars``)."""
+    if _is_named_sharding_ctor(aliases, expr):
+        spec_arg = (
+            expr.args[1] if len(expr.args) > 1 else _kwarg(expr, "spec")
+        )
+        if spec_arg is not None and _is_pspec_ctor(aliases, spec_arg):
+            return _canonical_pspec(spec_arg)
+        return None
+    if _is_pspec_ctor(aliases, expr):
+        return _canonical_pspec(expr)
+    d = _dotted(expr)
+    if d is not None:
+        return shvars.get(d)
+    return None
+
+
+# --------------------------------------------------------------------- #
+# class-attribute sharding specs ("self._repl" -> P())
+# --------------------------------------------------------------------- #
+
+
+def _class_attr_specs(aliases, tree: ast.AST) -> Dict[str, tuple]:
+    """``self.<attr>`` -> canonical spec, from ``self.attr =
+    NamedSharding(mesh, P(<literal>))`` assignments anywhere in the
+    file's classes. An attr bound twice with different specs, or also
+    bound to anything unresolvable, is dropped (ambiguous — degrade)."""
+    specs: Dict[str, tuple] = {}
+    dropped: Set[str] = set()
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for node in ast.walk(cls):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Attribute)
+                and isinstance(node.targets[0].value, ast.Name)
+                and node.targets[0].value.id == "self"
+            ):
+                continue
+            attr = node.targets[0].attr
+            spec = (
+                _sharding_spec_of(aliases, node.value, {})
+                if isinstance(node.value, ast.Call) else None
+            )
+            if spec is None:
+                # ANY unresolvable rebinding (a forwarded parameter, a
+                # helper result, a dynamic spec) makes the attr's spec
+                # unknowable — drop it, never keep a stale literal
+                dropped.add(attr)
+                continue
+            if attr in specs and specs[attr] != spec:
+                dropped.add(attr)
+            specs.setdefault(attr, spec)
+    return {
+        f"self.{a}": s for a, s in specs.items() if a not in dropped
+    }
+
+
+# --------------------------------------------------------------------- #
+# per-function spec inference (the "propagation lite" pass)
+# --------------------------------------------------------------------- #
+
+
+class FnSpecs:
+    """One ordered pass over a function's own body:
+
+    - ``shvars``: sharding OBJECTS (``sh = NamedSharding(mesh, P(..))``,
+      plus the file's ``self.<attr>`` specs handed in);
+    - array placements: ``x = device_put(v, sh)`` / ``x =
+      with_sharding_constraint(v, sh)`` bind x's inferred spec;
+    - ``events``: placement calls whose operand already had a DIFFERENT
+      inferred spec (an implicit reshard);
+    - ``call_arg_specs``: id(Call) -> per-positional-arg inferred spec
+      snapshot taken in source order (for the call-site rules).
+
+    Any expression the pass cannot resolve invalidates the binding —
+    inference degrades, never guesses.
+    """
+
+    def __init__(self, aliases, fnode, attr_specs: Dict[str, tuple]):
+        self.aliases = aliases
+        self.shvars: Dict[str, tuple] = dict(attr_specs)
+        self.arr: Dict[str, tuple] = {}
+        self.events: List[Tuple[ast.AST, str, str, tuple, tuple]] = []
+        self.call_arg_specs: Dict[int, List[Optional[tuple]]] = {}
+        self._run(fnode)
+
+    def _run(self, fnode):
+        handled: Set[int] = set()
+        for node in walk_excluding_nested(fnode):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                self._assign(node, handled)
+            elif isinstance(node, ast.Assign):
+                # a = b = value: every target rebinds to an unknown
+                for t in node.targets:
+                    self._invalidate(t)
+            elif isinstance(node, ast.AnnAssign):
+                self._invalidate(node.target)
+            elif isinstance(node, (ast.AugAssign, ast.NamedExpr)):
+                self._invalidate(node.target)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                self._invalidate(node.target)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        self._invalidate(item.optional_vars)
+            elif isinstance(node, ast.Call) and id(node) not in handled:
+                self._snapshot(node)
+                kind = _is_placement_call(node)
+                if kind:
+                    self._placement(node, kind, target=None)
+
+    def _invalidate(self, target: ast.AST):
+        """Rebinding through any form the pass doesn't model drops the
+        binding — degrade, never keep a stale spec."""
+        elts = (
+            target.elts
+            if isinstance(target, (ast.Tuple, ast.List)) else [target]
+        )
+        for e in elts:
+            d = _dotted(e)
+            if d is not None:
+                self.arr.pop(d, None)
+                self.shvars.pop(d, None)
+
+    def _snapshot(self, call: ast.Call):
+        specs = [
+            self.arr.get(d) if (d := _dotted(a)) else None
+            for a in call.args
+        ]
+        if any(s is not None for s in specs):
+            self.call_arg_specs[id(call)] = specs
+
+    def _sharding_expr(self, call: ast.Call) -> Optional[ast.expr]:
+        """The sharding operand of a placement call."""
+        if len(call.args) > 1:
+            return call.args[1]
+        for name in ("device", "sharding", "shardings"):
+            got = _kwarg(call, name)
+            if got is not None:
+                return got
+        return None
+
+    def _placement(self, call: ast.Call, kind: str, target: Optional[str]):
+        sh = self._sharding_expr(call)
+        spec = (
+            _sharding_spec_of(self.aliases, sh, self.shvars)
+            if sh is not None else None
+        )
+        opd = _dotted(call.args[0]) if call.args else None
+        if spec is None:
+            if target:
+                self.arr.pop(target, None)
+            return
+        if opd is not None and opd in self.arr and self.arr[opd] != spec:
+            self.events.append((call, kind, opd, self.arr[opd], spec))
+        if target:
+            self.arr[target] = spec
+        # no target (the result is returned/passed on directly): the
+        # OPERAND's own binding is unchanged — device_put/wsc produce a
+        # new value, they don't mutate their input
+
+    def _assign(self, node: ast.Assign, handled: Set[int]):
+        t0 = node.targets[0]
+        if isinstance(t0, (ast.Tuple, ast.List)):
+            # tuple unpacking rebinds every element to an unknown value
+            if isinstance(node.value, ast.Call):
+                handled.add(id(node.value))
+                self._snapshot(node.value)
+            for e in t0.elts:
+                d = _dotted(e)
+                if d is not None:
+                    self.arr.pop(d, None)
+                    self.shvars.pop(d, None)
+            return
+        td = _dotted(t0)
+        v = node.value
+        if isinstance(v, ast.Call):
+            handled.add(id(v))
+            self._snapshot(v)
+            spec = None
+            if _is_named_sharding_ctor(self.aliases, v) or _is_pspec_ctor(
+                self.aliases, v
+            ):
+                spec = _sharding_spec_of(self.aliases, v, self.shvars)
+                if td is not None:
+                    if spec is not None:
+                        self.shvars[td] = spec
+                    else:
+                        self.shvars.pop(td, None)
+                    self.arr.pop(td, None)
+                return
+            kind = _is_placement_call(v)
+            if kind:
+                self._placement(v, kind, target=td)
+                return
+        # opaque value: drop whatever we believed about the target
+        if td is not None:
+            self.arr.pop(td, None)
+            self.shvars.pop(td, None)
+
+
+# --------------------------------------------------------------------- #
+# unknown-mesh-axis + mesh-axis-reuse (file rules)
+# --------------------------------------------------------------------- #
+
+
+@rule(
+    "unknown-mesh-axis", SEVERITY_ERROR,
+    "axis name in a PartitionSpec/NamedSharding/shard_map spec that is "
+    "not an axis of the mesh built by parallel/mesh.py:make_mesh — the "
+    "spec silently replicates (or errors at trace time on hardware)",
+)
+def check_unknown_mesh_axis(ctx: FileContext):
+    mesh = ctx.config.mesh
+    if mesh is None:
+        return
+    aliases = _file_aliases(ctx)
+    known = ", ".join(mesh.axes)
+    for node in ast.walk(ctx.tree):
+        if not _is_pspec_ctor(aliases, node):
+            continue
+        for entry, axis in _spec_axis_names(node):
+            if not mesh.known_axis(axis):
+                yield (
+                    entry.lineno,
+                    f"unknown mesh axis {axis!r} in PartitionSpec — the "
+                    f"mesh built by make_mesh has axes ({known}); a "
+                    "typo'd axis silently replicates the tensor instead "
+                    "of sharding it",
+                )
+
+
+@rule(
+    "mesh-axis-reuse", SEVERITY_ERROR,
+    "one mesh axis named twice in a single PartitionSpec — an axis can "
+    "shard only one dim; jax rejects the spec at trace time, on "
+    "hardware, hours in",
+)
+def check_mesh_axis_reuse(ctx: FileContext):
+    aliases = _file_aliases(ctx)
+    for node in ast.walk(ctx.tree):
+        if not _is_pspec_ctor(aliases, node):
+            continue
+        seen: Dict[str, int] = {}
+        for entry, axis in _spec_axis_names(node):
+            if axis in seen:
+                yield (
+                    entry.lineno,
+                    f"mesh axis {axis!r} is used twice in one "
+                    "PartitionSpec (first at line "
+                    f"{seen[axis]}) — an axis can shard only one dim "
+                    "of a value",
+                )
+            else:
+                seen[axis] = entry.lineno
+
+
+# --------------------------------------------------------------------- #
+# shard-map-spec-arity (file rule)
+# --------------------------------------------------------------------- #
+
+
+def _positional_arity(fdef) -> Optional[Tuple[int, int]]:
+    """(min, max) positional args a def accepts; None when *args makes
+    the upper bound open."""
+    args = fdef.args
+    if args.vararg is not None:
+        return None
+    pos = list(getattr(args, "posonlyargs", [])) + list(args.args)
+    return (len(pos) - len(args.defaults), len(pos))
+
+
+def _resolve_shard_map_body(
+    aliases, call: ast.Call, defs_by_name: Dict[str, List],
+    shadowed: Set[str],
+) -> Optional[Tuple[str, Tuple[int, int]]]:
+    """(name, (min, max) arity) of the wrapped callable when it resolves
+    to exactly one same-file def — directly or through a keyword-only
+    ``functools.partial``; anything else degrades. ``shadowed`` holds
+    names bound as plain variables in the enclosing scope — those may
+    refer to ANYTHING (e.g. a partial assigned to a name that collides
+    with an unrelated def), so they never resolve."""
+    if not call.args:
+        return None
+    body = call.args[0]
+    extra = 0
+    partial_kwargs: List[str] = []
+    if isinstance(body, ast.Call) and _ctor_matches(
+        aliases, body.func, "partial"
+    ):
+        if not body.args:
+            return None
+        extra = len(body.args) - 1  # positionals pre-bound by partial
+        partial_kwargs = [kw.arg for kw in body.keywords if kw.arg]
+        body = body.args[0]
+    d = _dotted(body)
+    if d is None or "." in d or d in shadowed:
+        return None
+    cands = defs_by_name.get(d, [])
+    if len(cands) != 1:
+        return None
+    fdef = cands[0]
+    if partial_kwargs:
+        # a partial keyword that names a POSITIONAL-or-keyword param
+        # removes it from the callable's positional surface in a way
+        # simple subtraction can't model — degrade. Keyword-ONLY params
+        # (after ``*``, the _ring_shard idiom) don't affect arity.
+        pos_names = {
+            a.arg
+            for a in list(getattr(fdef.args, "posonlyargs", []))
+            + list(fdef.args.args)
+        }
+        if pos_names & set(partial_kwargs):
+            return None
+    arity = _positional_arity(fdef)
+    if arity is None:
+        return None
+    lo, hi = arity
+    return d, (max(lo - extra, 0), hi - extra)
+
+
+def _tuple_return_arity(fdef) -> Optional[int]:
+    """Length of the def's returned tuple when EVERY return is a literal
+    tuple of one consistent length; None otherwise (degrade)."""
+    lengths: Set[int] = set()
+    for node in walk_excluding_nested(fdef):
+        if isinstance(node, ast.Return):
+            if not isinstance(node.value, ast.Tuple):
+                return None
+            lengths.add(len(node.value.elts))
+    return lengths.pop() if len(lengths) == 1 else None
+
+
+@rule(
+    "shard-map-spec-arity", SEVERITY_ERROR,
+    "shard_map in_specs/out_specs arity disagrees with the wrapped "
+    "function's signature or the immediate invocation — jax errors at "
+    "trace time, typically only on hardware where the mesh is real",
+)
+def check_shard_map_arity(ctx: FileContext):
+    aliases = _file_aliases(ctx)
+    defs_by_name: Dict[str, List] = {}
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(n.name, []).append(n)
+    parents = ctx.parents()
+    for node in ast.walk(ctx.tree):
+        if not _is_shard_map_call(aliases, node):
+            continue
+        in_specs = _kwarg(node, "in_specs")
+        n_in = (
+            len(in_specs.elts)
+            if isinstance(in_specs, (ast.Tuple, ast.List)) else None
+        )
+        enc = ctx.enclosing_function(node)
+        shadowed: Set[str] = set()
+        if enc is not None:
+            # anything locally (re)bound — plain assignments AND
+            # parameters: `def outer(kernel, ...)` must not resolve
+            # `kernel` to an unrelated module-level def
+            shadowed = {
+                n.id for n in ast.walk(enc)
+                if isinstance(n, ast.Name)
+                and isinstance(n.ctx, (ast.Store, ast.Del))
+            } | {
+                a.arg for a in ast.walk(enc) if isinstance(a, ast.arg)
+            }
+        body = _resolve_shard_map_body(aliases, node, defs_by_name, shadowed)
+        sig_mismatch = False
+        if n_in is not None and body is not None:
+            name, (lo, hi) = body
+            if not (lo <= n_in <= hi):
+                sig_mismatch = True
+                want = str(hi) if lo == hi else f"{lo}..{hi}"
+                yield (
+                    in_specs.lineno,
+                    f"shard_map in_specs has {n_in} entries but "
+                    f"{name}() takes {want} positional argument(s) — "
+                    "every operand needs exactly one spec",
+                )
+        # immediate invocation: shard_map(...)(a, b, c) — skipped when
+        # the signature check above already reported this defect
+        parent = parents.get(node)
+        if (
+            n_in is not None
+            and not sig_mismatch
+            and isinstance(parent, ast.Call)
+            and parent.func is node
+            and not any(isinstance(a, ast.Starred) for a in parent.args)
+            and not parent.keywords
+            and len(parent.args) != n_in
+        ):
+            yield (
+                parent.lineno,
+                f"shard_map in_specs has {n_in} entries but the call "
+                f"passes {len(parent.args)} operand(s)",
+            )
+        out_specs = _kwarg(node, "out_specs")
+        if (
+            isinstance(out_specs, (ast.Tuple, ast.List))
+            and body is not None
+        ):
+            name = body[0]
+            n_ret = (
+                _tuple_return_arity(defs_by_name[name][0])
+                if len(defs_by_name.get(name, [])) == 1 else None
+            )
+            if n_ret is not None and n_ret != len(out_specs.elts):
+                yield (
+                    out_specs.lineno,
+                    f"shard_map out_specs has {len(out_specs.elts)} "
+                    f"entries but {name}() returns a {n_ret}-tuple",
+                )
+
+
+# --------------------------------------------------------------------- #
+# donation-sharding-mismatch (file rule)
+# --------------------------------------------------------------------- #
+
+
+def _jit_donation_info(
+    call: ast.Call,
+) -> Optional[Tuple[Tuple[int, ...], Optional[ast.expr]]]:
+    """(donated positions, out_shardings expr) of a jit(...) build."""
+    if not _is_jit_call(call):
+        return None
+    pos = _donated_positions(call)
+    if not pos:
+        return None
+    return pos, _kwarg(call, "out_shardings")
+
+
+@rule(
+    "donation-sharding-mismatch", SEVERITY_WARN,
+    "an operand donated to a jitted call has an inferred sharding that "
+    "matches no out_shardings entry — XLA cannot alias the buffer, so "
+    "the donation silently degrades to a copy (HBM spike on hardware)",
+)
+def check_donation_sharding(ctx: FileContext):
+    # cheap pre-pass: almost no file donates — don't pay a spec
+    # inference pass (or the class-attr scan) for files/functions that
+    # can't produce a finding
+    if "donate_argnums" not in ctx.src:
+        return
+    aliases = _file_aliases(ctx)
+    attr_specs = None
+    for fdef in ast.walk(ctx.tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # donated jitted callables bound in this scope
+        donors: Dict[str, Tuple[Tuple[int, ...], Optional[ast.expr]]] = {}
+        has_inline = False
+        for node in walk_excluding_nested(fdef):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                info = _jit_donation_info(node.value)
+                if info:
+                    donors[node.targets[0].id] = info
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Call)
+                and _jit_donation_info(node.func) is not None
+            ):
+                has_inline = True
+        if not donors and not has_inline:
+            continue
+        if attr_specs is None:
+            attr_specs = _class_attr_specs(aliases, ctx.tree)
+        fs = FnSpecs(aliases, fdef, attr_specs)
+        for node in walk_excluding_nested(fdef):
+            if not isinstance(node, ast.Call):
+                continue
+            info = None
+            if isinstance(node.func, ast.Name) and node.func.id in donors:
+                info = donors[node.func.id]
+            elif isinstance(node.func, ast.Call):
+                info = _jit_donation_info(node.func)
+            if info is None:
+                continue
+            positions, out_sh = info
+            if not isinstance(out_sh, (ast.Tuple, ast.List)):
+                continue  # single/absent out_shardings: degrade
+            out_specs = []
+            for e in out_sh.elts:
+                s = _sharding_spec_of(aliases, e, fs.shvars)
+                out_specs.append(s)
+            if any(s is None for s in out_specs):
+                continue  # an unresolvable output spec: degrade
+            arg_specs = fs.call_arg_specs.get(id(node), [])
+            for p in positions:
+                if p >= len(arg_specs) or arg_specs[p] is None:
+                    continue
+                s_in = arg_specs[p]
+                if s_in not in out_specs:
+                    d = _dotted(node.args[p]) or f"argument {p}"
+                    outs = ", ".join(_fmt_spec(s) for s in out_specs)
+                    yield (
+                        node.lineno,
+                        f"{d!r} (inferred {_fmt_spec(s_in)}) is donated "
+                        f"but no out_shardings entry [{outs}] matches "
+                        "its sharding — XLA cannot alias the donated "
+                        "buffer and the donation becomes a silent copy; "
+                        "align the output binding's sharding or drop "
+                        "the donation",
+                    )
+
+
+# --------------------------------------------------------------------- #
+# hot-path-reshard (project rule)
+# --------------------------------------------------------------------- #
+
+
+def _project_hot_roots(pctx: ProjectContext) -> List[str]:
+    # delegate to rules_dataflow's detector so the SPMD and host-sync
+    # rules can never disagree about what a hot root is
+    from tools.arealint.rules_dataflow import _project_hot_roots as f
+
+    return f(pctx)
+
+
+def _module_aliases(pctx: ProjectContext, path: str) -> Dict[str, str]:
+    cache = getattr(pctx, "_spmd_mod_aliases", None)
+    if cache is None:
+        cache = {}
+        pctx._spmd_mod_aliases = cache
+    got = cache.get(path)
+    if got is None:
+        ctx = pctx.file_ctx(path)
+        got = collect_aliases(ctx.tree) if ctx is not None else {}
+        cache[path] = got
+    return got
+
+
+def _module_attr_specs(pctx: ProjectContext, path: str) -> Dict[str, tuple]:
+    cache = getattr(pctx, "_spmd_attr_specs", None)
+    if cache is None:
+        cache = {}
+        pctx._spmd_attr_specs = cache
+    got = cache.get(path)
+    if got is None:
+        ctx = pctx.file_ctx(path)
+        got = (
+            _class_attr_specs(_module_aliases(pctx, path), ctx.tree)
+            if ctx is not None else {}
+        )
+        cache[path] = got
+    return got
+
+
+def _fn_specs(pctx: ProjectContext, fi: FunctionInfo) -> FnSpecs:
+    cache = getattr(pctx, "_spmd_fn_specs", None)
+    if cache is None:
+        cache = {}
+        pctx._spmd_fn_specs = cache
+    got = cache.get(id(fi.node))
+    if got is None:
+        got = FnSpecs(
+            _module_aliases(pctx, fi.path),
+            fi.node,
+            _module_attr_specs(pctx, fi.path),
+        )
+        cache[id(fi.node)] = got
+    return got
+
+
+@project_rule(
+    "hot-path-reshard", SEVERITY_ERROR,
+    "with_sharding_constraint/device_put changes the inferred sharding "
+    "of a value inside a jitted or '# arealint: hot' root (or anything "
+    "reachable from one) — an implicit reshard moves the value across "
+    "devices every step of the decode/train loop",
+)
+def check_hot_path_reshard(pctx: ProjectContext):
+    graph = pctx.graph
+    roots = _project_hot_roots(pctx)
+    # BFS with root attribution (sorted edges -> deterministic chains)
+    pred: Dict[str, str] = {}
+    work: collections.deque = collections.deque()
+    for r in roots:
+        if r not in pred:
+            pred[r] = r
+            work.append(r)
+    while work:
+        cur = work.popleft()
+        for nxt in sorted(graph.edges.get(cur, ())):
+            if nxt not in pred:
+                pred[nxt] = pred[cur]
+                work.append(nxt)
+
+    seen_nodes: Set[int] = set()
+    todo: List[Tuple[str, FunctionInfo, str]] = []
+    for q in sorted(pred):
+        fi = graph.function(q)
+        if fi is not None:
+            seen_nodes.add(id(fi.node))
+            todo.append((fi.path, fi, f"hot root {_short(pred[q])}()"))
+    # nested defs (jitted local step functions) are hot but not indexed;
+    # pick them up from the intra-file closure
+    for mod_name in sorted(pctx.project.modules):
+        mod = pctx.project.modules[mod_name]
+        ctx = pctx.file_ctx(mod.path)
+        if ctx is None:
+            continue
+        for fnode in sorted(
+            intra_hot_reachable(ctx), key=lambda n: n.lineno
+        ):
+            if id(fnode) in seen_nodes:
+                continue
+            seen_nodes.add(id(fnode))
+            fi = FunctionInfo(
+                qualname=f"{mod.name}.<local>.{fnode.name}",
+                module=mod.name, name=fnode.name, class_name=None,
+                node=fnode, path=mod.path,
+            )
+            todo.append(
+                (mod.path, fi, "a jitted/'# arealint: hot' root here")
+            )
+
+    for path, fi, root_desc in todo:
+        fs = _fn_specs(pctx, fi)
+        for call, kind, var, old, new in fs.events:
+            yield (
+                path, call.lineno,
+                f"{kind}() changes the inferred sharding of {var!r} "
+                f"from {_fmt_spec(old)} to {_fmt_spec(new)} in "
+                f"{fi.name}() (reachable from {root_desc}) — an "
+                "implicit reshard on the hot path; produce the value "
+                "in its target sharding, or annotate a deliberate "
+                "reshard with '# arealint: ok(<reason>)'",
+            )
+
+
+# --------------------------------------------------------------------- #
+# jit-sharding-disagreement (project rule)
+# --------------------------------------------------------------------- #
+
+
+@project_rule(
+    "jit-sharding-disagreement", SEVERITY_WARN,
+    "call sites of one jitted function pass differently-sharded "
+    "operands at the same position — each layout compiles its own "
+    "trace and the losing sites pay a reshard on entry",
+)
+def check_jit_sharding_disagreement(pctx: ProjectContext):
+    graph = pctx.graph
+    for q in sorted(graph.sites_by_callee):
+        fi = graph.function(q)
+        if fi is None or not _has_jit_decorator(fi.node):
+            continue
+        sites = graph.sites_by_callee[q]
+        if len(sites) < 2:
+            continue
+        per_pos: Dict[int, List[Tuple[object, tuple]]] = {}
+        for site in sites:
+            caller = graph.function(site.caller)
+            if caller is None:
+                continue
+            specs = _fn_specs(pctx, caller).call_arg_specs.get(
+                id(site.node)
+            )
+            if not specs:
+                continue
+            for p, s in enumerate(specs):
+                if s is not None:
+                    per_pos.setdefault(p, []).append((site, s))
+        for p in sorted(per_pos):
+            known = sorted(
+                per_pos[p], key=lambda e: (e[0].path, e[0].line)
+            )
+            distinct = {s for _, s in known}
+            if len(distinct) < 2:
+                continue
+            # one defect ("pick one sharding"), one finding: report at
+            # the first site and name the first disagreeing sibling
+            site, s = known[0]
+            other, other_s = next(
+                (e for e in known if e[1] != s)
+            )
+            yield (
+                site.path, site.line,
+                f"jitted {fi.name}() receives an operand inferred as "
+                f"{_fmt_spec(s)} at position {p} here, but "
+                f"{other.path}:{other.line} passes one inferred as "
+                f"{_fmt_spec(other_s)} — each layout traces separately "
+                "and the losing sites reshard on entry; pick one "
+                "sharding for this operand",
+            )
+
+
+# --------------------------------------------------------------------- #
+# host-divergence-collective (project rule)
+# --------------------------------------------------------------------- #
+
+_TIME_ATTRS = frozenset({
+    "time", "time_ns", "monotonic", "monotonic_ns", "perf_counter",
+    "perf_counter_ns", "process_time", "process_time_ns",
+})
+_RANDOM_BASES = frozenset({"random", "secrets", "uuid"})
+# no-arg method calls that read host-local queue/signal/flag state: a
+# queue fills, a signal lands, a thread sets an Event at a different
+# instant on every host
+_HOST_STATE_METHODS = frozenset({
+    "empty", "qsize", "full", "is_set", "should_stop",
+})
+_MULTIHOST_COLLECTIVES = frozenset({
+    "barrier", "allreduce_sum", "allreduce_max", "allreduce_min",
+    "allgather_rows", "assert_same_across_hosts",
+    "gather_params_to_host", "main_decides",
+})
+_MULTIHOST_UTILS = frozenset({
+    "process_allgather", "sync_global_devices", "broadcast_one_to_all",
+})
+_LAX_COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "psum_scatter", "pshuffle",
+})
+
+
+def _env_exempt(path: str, fn_name: str) -> bool:
+    """Env reads in the knob catalog (and the worker_base ``_env_*``
+    parsers — that file only, matching the env-knob rule's scoping) are
+    uniform across hosts BY CONSTRUCTION: the launcher forwards the
+    same values to every process (that is the env-knob rule's whole
+    contract), so they are not divergence sources."""
+    p = path.replace("\\", "/")
+    return any(p.endswith(s) for s in ENV_CATALOG_SUFFIXES) or (
+        p.endswith(ENV_HELPER_FILE) and fn_name.startswith("_env_")
+    )
+
+
+def _is_gate(call: ast.AST) -> bool:
+    """A ``main_decides(...)`` call (any spelling): process 0 broadcasts
+    the decision, so everything inside its arguments is host-uniform by
+    the time the branch tests it. Name-based on the SUPPRESSION side —
+    the conservative direction."""
+    if not isinstance(call, ast.Call):
+        return False
+    d = _dotted(call.func)
+    return d is not None and d.split(".")[-1] == "main_decides"
+
+
+def _walk_ungated(expr: ast.AST) -> Iterator[ast.AST]:
+    stack = [expr]
+    while stack:
+        n = stack.pop()
+        if _is_gate(n):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _divergent_call(aliases, node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        attr = f.attr
+        if isinstance(f.value, ast.Name):
+            base = aliases.get(f.value.id, f.value.id)
+            if base == "time" and attr in _TIME_ATTRS:
+                return f"time.{attr}()"
+            if base in _RANDOM_BASES and attr != "Random":
+                return f"{base}.{attr}()"
+        if attr == "process_index":
+            return "process_index() (differs on every host)"
+        if attr in _HOST_STATE_METHODS and not node.args:
+            return f".{attr}() (host-local queue/signal state)"
+    elif isinstance(f, ast.Name):
+        t = aliases.get(f.id, "")
+        head, _, last = t.rpartition(".")
+        if head == "time" and last in _TIME_ATTRS:
+            return f"time.{last}()"
+        if head in _RANDOM_BASES and last != "Random":
+            return f"{t}()"
+        if f.id == "process_index" or last == "process_index":
+            return "process_index() (differs on every host)"
+    return None
+
+
+def _expr_divergence(
+    aliases,
+    expr: ast.AST,
+    tainted: Dict[str, str],
+    callee_of: Dict[int, str],
+    ret_div: Dict[str, str],
+    env_ok: bool,
+) -> Optional[str]:
+    """Why ``expr`` is host-divergent, or None. ``main_decides`` call
+    subtrees are skipped (gated = uniform)."""
+    for node in _walk_ungated(expr):
+        if isinstance(node, ast.Call):
+            d = _divergent_call(aliases, node)
+            if d:
+                return d
+            q = callee_of.get(id(node))
+            if q is not None and q in ret_div:
+                return (
+                    f"{_short(q)}() (returns {ret_div[q]})"
+                )
+        if not env_ok:
+            e = _env_read(node)
+            if e:
+                return f"{e} (host-local env read)"
+        if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+            getattr(node, "ctx", None), ast.Load
+        ):
+            d = _dotted(node)
+            if d is not None and d in tainted:
+                return tainted[d]
+    return None
+
+
+def _scan_divergence(
+    aliases,
+    fnode,
+    callee_of: Dict[int, str],
+    ret_div: Dict[str, str],
+    env_ok: bool,
+    on_branch=None,
+) -> Optional[str]:
+    """One scoped walk of a function's own body tracking host-divergence:
+
+    - value taint: ``x = time.monotonic()`` taints ``x``; rebinding from
+      a uniform expression untaints;
+    - CONTROL-dependence taint: an assignment (or return) inside a
+      branch whose test is divergent is divergent even when the
+      assigned expression is a constant (``if time...: fire = True``);
+    - ``on_branch(node, desc, arms)`` fires for every If/While/IfExp
+      whose test is divergent (the reshard... the collective check runs
+      there).
+
+    Returns the divergence description of the function's RESULT (first
+    divergent return), or None.
+    """
+    tainted: Dict[str, str] = {}
+    ret_desc: List[Optional[str]] = [None]
+
+    def taint_targets(targets, d: Optional[str], lineno: int):
+        for t in targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                td = _dotted(e)
+                if td is None:
+                    continue
+                if d:
+                    tainted[td] = (
+                        f"'{td}' (assigned under/from {d} on line "
+                        f"{lineno})"
+                    )
+                else:
+                    tainted.pop(td, None)
+
+    def div(expr) -> Optional[str]:
+        return _expr_divergence(
+            aliases, expr, tainted, callee_of, ret_div, env_ok
+        )
+
+    def scan_expr_branches(stmt, div_ctx):
+        if on_branch is None:
+            return
+        for node in ast.walk(stmt):
+            if isinstance(node, (
+                ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda
+            )):
+                continue
+            if isinstance(node, ast.IfExp):
+                d = div(node.test) or div_ctx
+                if d:
+                    on_branch(node, d, [node.body, node.orelse])
+
+    def walk(body, div_ctx: Optional[str]):
+        for node in body:
+            if isinstance(node, (
+                ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda
+            )):
+                continue
+            if isinstance(node, ast.Assign):
+                d = div(node.value) or div_ctx
+                taint_targets(node.targets, d, node.lineno)
+                scan_expr_branches(node, div_ctx)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                d = div(node.value) or div_ctx
+                taint_targets([node.target], d, node.lineno)
+            elif isinstance(node, ast.AugAssign):
+                d = div(node.value) or div_ctx
+                if d:  # += only adds taint, never clears it
+                    taint_targets([node.target], d, node.lineno)
+            elif isinstance(node, (ast.If, ast.While)):
+                d = div(node.test)
+                arms = list(node.body) + list(node.orelse)
+                if d and on_branch is not None:
+                    on_branch(node, d, arms)
+                inner = d or div_ctx
+                walk(node.body, inner)
+                walk(node.orelse, inner)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                taint_targets([node.target], div(node.iter) or div_ctx,
+                              node.lineno)
+                walk(node.body, div_ctx)
+                walk(node.orelse, div_ctx)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                walk(node.body, div_ctx)
+            elif isinstance(node, ast.Try):
+                walk(node.body, div_ctx)
+                for h in node.handlers:
+                    walk(h.body, div_ctx)
+                walk(node.orelse, div_ctx)
+                walk(node.finalbody, div_ctx)
+            elif isinstance(node, ast.Return):
+                d = (
+                    div(node.value) if node.value is not None else None
+                ) or div_ctx
+                if d and ret_desc[0] is None:
+                    ret_desc[0] = d
+                scan_expr_branches(node, div_ctx)
+            else:
+                scan_expr_branches(node, div_ctx)
+
+    walk(fnode.body, None)
+    return ret_desc[0]
+
+
+def _direct_collective(aliases, node: ast.AST) -> Optional[str]:
+    """A call/with that IS a collective (every process must reach it)."""
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        for item in node.items:
+            e = item.context_expr
+            name = None
+            if isinstance(e, ast.Call):
+                f = e.func
+                name = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None
+                )
+                if name == "Mesh":
+                    return "Mesh(...) context entry"
+                name = None
+            d = _dotted(e)
+            if d is not None:
+                last = d.split(".")[-1]
+                if last == "mesh" or last.endswith("_mesh"):
+                    return f"'with {d}:' (mesh context entry)"
+        return None
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, (ast.Name,
+                                                            ast.Attribute)):
+        base_d = _dotted(f.value) or ""
+        base_last = base_d.split(".")[-1]
+        base_t = aliases.get(base_last, base_last)
+        if f.attr in _MULTIHOST_COLLECTIVES and (
+            base_last == "multihost" or base_t.endswith("multihost")
+        ):
+            return f"multihost.{f.attr}()"
+        if f.attr in _MULTIHOST_UTILS and base_last == "multihost_utils":
+            return f"multihost_utils.{f.attr}()"
+        if f.attr in _LAX_COLLECTIVES and (
+            base_last == "lax" or base_d.endswith("lax")
+        ):
+            return f"lax.{f.attr}()"
+    elif isinstance(f, ast.Name):
+        t = aliases.get(f.id, "")
+        head, _, last = t.rpartition(".")
+        if last in _MULTIHOST_COLLECTIVES and head.endswith("multihost"):
+            return f"multihost.{last}()"
+        if last in _MULTIHOST_UTILS and head.endswith("multihost_utils"):
+            return f"multihost_utils.{last}()"
+    return None
+
+
+def _all_indexed_functions(pctx: ProjectContext) -> Iterator[FunctionInfo]:
+    for mod_name in sorted(pctx.project.modules):
+        mod = pctx.project.modules[mod_name]
+        for fi in mod.functions.values():
+            yield fi
+        for ci in mod.classes.values():
+            yield from ci.methods.values()
+
+
+def _divergence_state(pctx: ProjectContext):
+    """(ret_div, direct_descs, reaches) memoized on the context.
+
+    - ``ret_div``: qualname -> source description, for functions whose
+      RETURN value is host-divergent (fixpoint over the call graph, so
+      ``is_main()`` -> ``process_index() == 0`` propagates);
+    - ``direct_descs``: qualname -> (collective description, line) for
+      functions whose body contains a collective;
+    - ``reaches``: every qualname that transitively calls one of them.
+    """
+    cached = getattr(pctx, "_spmd_divergence", None)
+    if cached is not None:
+        return cached
+    graph = pctx.graph
+
+    direct_descs: Dict[str, Tuple[str, int]] = {}
+    for fi in _all_indexed_functions(pctx):
+        aliases = _module_aliases(pctx, fi.path)
+        for node in walk_excluding_nested(fi.node):
+            d = _direct_collective(aliases, node)
+            if d:
+                direct_descs[fi.qualname] = (d, node.lineno)
+                break
+    reaches = graph.callers_closure(direct_descs)
+
+    ret_div: Dict[str, str] = {}
+    fns = list(_all_indexed_functions(pctx))
+    for _ in range(8):
+        changed = False
+        for fi in fns:
+            if fi.qualname in ret_div:
+                continue
+            found = _scan_divergence(
+                _module_aliases(pctx, fi.path),
+                fi.node,
+                graph.callees_by_node(fi.qualname),
+                ret_div,
+                _env_exempt(fi.path, fi.name),
+            )
+            if found:
+                ret_div[fi.qualname] = found
+                changed = True
+        if not changed:
+            break
+
+    cached = (ret_div, direct_descs, reaches)
+    pctx._spmd_divergence = cached
+    return cached
+
+
+def _collective_in_body(
+    pctx: ProjectContext,
+    aliases,
+    stmts,
+    callee_of: Dict[int, str],
+    direct_descs: Dict[str, Tuple[str, int]],
+    reaches: Set[str],
+) -> Optional[str]:
+    """Description of the first collective the branch body reaches —
+    directly, or through resolved call edges (via-chain named)."""
+    graph = pctx.graph
+    for node in walk_excluding_nested(list(stmts)):
+        d = _direct_collective(aliases, node)
+        if d:
+            return d
+        if isinstance(node, ast.Call):
+            q = callee_of.get(id(node))
+            if q is not None and q in reaches:
+                # shortest chain q -> some direct-collective function
+                chain = _chain_to_collective(graph, q, direct_descs)
+                if chain:
+                    via = " -> ".join(_short(c) + "()" for c in chain)
+                    desc = direct_descs[chain[-1]][0]
+                    return f"{desc} via {via}"
+    return None
+
+
+def _chain_to_collective(
+    graph, start: str, direct_descs: Dict[str, Tuple[str, int]]
+) -> Optional[List[str]]:
+    if start in direct_descs:
+        return [start]
+    pred: Dict[str, Optional[str]] = {start: None}
+    work: collections.deque = collections.deque([start])
+    while work:
+        cur = work.popleft()
+        for nxt in sorted(graph.edges.get(cur, ())):
+            if nxt in pred:
+                continue
+            pred[nxt] = cur
+            if nxt in direct_descs:
+                chain = [nxt]
+                back = cur
+                while back is not None:
+                    chain.append(back)
+                    back = pred[back]
+                chain.reverse()
+                return chain[:4] + ([chain[-1]] if len(chain) > 4 else [])
+            work.append(nxt)
+    return None
+
+
+@project_rule(
+    "host-divergence-collective", SEVERITY_ERROR,
+    "a branch on host-local state (time.*, runtime os.environ, random, "
+    "process_index(), queue state) guards a collective without going "
+    "through multihost.main_decides — processes can take different "
+    "branches and the straggling collective deadlocks the pod",
+)
+def check_host_divergence(pctx: ProjectContext):
+    graph = pctx.graph
+    ret_div, direct_descs, reaches = _divergence_state(pctx)
+    for fi in _all_indexed_functions(pctx):
+        aliases = _module_aliases(pctx, fi.path)
+        callee_of = graph.callees_by_node(fi.qualname)
+        findings: List[Tuple[int, str]] = []
+
+        def on_branch(node, d, arms, _fi=fi, _aliases=aliases,
+                      _callee_of=callee_of):
+            c = _collective_in_body(
+                pctx, _aliases, arms, _callee_of, direct_descs, reaches
+            )
+            if c:
+                findings.append((
+                    node.lineno,
+                    f"branch in {_fi.name}() depends on host-local {d} "
+                    f"but guards collective {c} — processes can take "
+                    "different branches and the pod deadlocks at the "
+                    "straggling collective; route the decision through "
+                    "multihost.main_decides() (process 0 decides for "
+                    "everyone) or annotate '# arealint: ok(<reason>)'",
+                ))
+
+        _scan_divergence(
+            aliases, fi.node, callee_of, ret_div,
+            _env_exempt(fi.path, fi.name), on_branch=on_branch,
+        )
+        for line, msg in findings:
+            yield (fi.path, line, msg)
