@@ -129,6 +129,7 @@ def gen_server_main(cfg, server_idx: int):
         seed=cfg.seed + server_idx,
         page_size=cfg.gen.page_size,
         n_pages=cfg.gen.n_pages,
+        kv_dtype=cfg.gen.kv_dtype,
         mesh=mesh,
         spec_decode=cfg.gen.spec_decode,
         spec_k=cfg.gen.spec_k,
@@ -169,6 +170,12 @@ def gen_server_main(cfg, server_idx: int):
             gauges_fn=lambda: {
                 "gen_running": float(engine.n_running()),
                 "gen_pending": float(engine.n_pending()),
+                # HBM-headroom gauges (docs/observability.md): the fleet
+                # aggregator sums these per server; kv_dtype itself is a
+                # string and lives on /metrics_json instead
+                "kv_pool_bytes": float(engine.kv_pool_bytes()),
+                "kv_pool_occupancy": engine.kv_pool_occupancy(),
+                "n_pages_free": float(engine.pool.n_free),
             },
         ).maybe_start()
         while watch.alive():

@@ -37,6 +37,8 @@ TELEMETRY_EXPORT_ENV = "AREAL_TELEMETRY_EXPORT"
 # Speculative decoding (docs/performance.md "Speculative decoding").
 SPEC_DECODE_ENV = "AREAL_SPEC_DECODE"   # draft-and-verify decode chunks
 SPEC_K_ENV = "AREAL_SPEC_K"             # draft tokens per slot per spec step
+# KV-pool quantization (docs/performance.md "KV quantization").
+KV_DTYPE_ENV = "AREAL_KV_DTYPE"         # paged KV pool storage dtype
 
 
 # --------------------------------------------------------------------- #
@@ -194,6 +196,30 @@ def spec_k() -> int:
     speculative decode step; the verify pass scores K+1 positions in one
     forward. Floored at 1 (K=0 would be vanilla decode with extra steps)."""
     return max(1, env_int(SPEC_K_ENV, 4))
+
+
+def kv_dtype() -> Optional[str]:
+    """``AREAL_KV_DTYPE`` (default unset = serving dtype, i.e. raw bf16
+    pages): paged-KV pool storage dtype for generation engines. ``"int8"``
+    stores quantized pages with per-(page-slot, kv-head) scales — half the
+    decode HBM KV traffic, 2x resident pages at fixed pool HBM
+    (docs/performance.md "KV quantization"). Default stays the serving
+    dtype until chip-verified (``gen_kvq`` bench section). Unknown values
+    fall back to unset (logged), not crash — same contract as the other
+    tolerant knobs. An explicit ``cfg.kv_dtype`` / engine argument
+    overrides this knob."""
+    raw = env_str(KV_DTYPE_ENV)
+    if raw is None or not raw.strip():
+        return None
+    v = raw.strip().lower()
+    if v == "int8":
+        return "int8"
+    if v in ("bf16", "bfloat16"):
+        return "bf16"
+    _logger.warning(
+        "ignoring unknown %s=%r (using the serving dtype)", KV_DTYPE_ENV, raw
+    )
+    return None
 
 
 def native_disabled() -> bool:
@@ -360,6 +386,7 @@ def get_env_vars(**extra) -> dict:
         "AREAL_DECODE_PIPELINE",
         SPEC_DECODE_ENV,
         SPEC_K_ENV,
+        KV_DTYPE_ENV,
         "AREAL_DISABLE_NATIVE",
         "AREAL_ENABLE_FUNCTION_CALL",
         "AREAL_FUNCTIONCALL_SERVICE_DOMAIN",

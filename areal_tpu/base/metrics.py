@@ -394,6 +394,21 @@ GEN_SPEC_DRAFT_TOKENS = "gen/spec_draft_tokens"
 GEN_SPEC_ACCEPTED_TOKENS = "gen/spec_accepted_tokens"
 GEN_SPEC_ACCEPT_LEN = "gen/spec_accept_len"
 
+# KV-pool quantization (docs/performance.md "KV quantization"): pages
+# allocated into an int8 pool (their KV lands quantized at the post-scan
+# scatter) plus a pool-occupancy histogram — the HBM-headroom signal the
+# fleet aggregator and the gen server's /metrics_json gauges expose.
+GEN_KVQ_PAGES_QUANTIZED = "gen/kvq_pages_quantized"
+GEN_KV_POOL_OCCUPANCY = "gen/kv_pool_occupancy"
+
+# Fraction edges for the pool-occupancy histogram: occupancy lives in
+# [0, 1] and the log-spaced duration edges would put the whole range into
+# two buckets; 0.9+ gets finer edges because that is where admission
+# starts deferring (the signal an autoscaler acts on).
+POOL_OCCUPANCY_BOUNDARIES: List[float] = [
+    0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 0.95, 0.99,
+]
+
 # Small-integer edges for the accept-length histogram: accept lengths are
 # 0..K (K = AREAL_SPEC_K, typically <= 8) and the duration edges would
 # smear 0/1/2 — the values that decide whether spec decode pays — into
@@ -414,6 +429,7 @@ METRIC_KINDS: Dict[str, str] = {
     TTFC_S: KIND_HISTOGRAM,
     REWARD_LAG_S: KIND_HISTOGRAM,
     GEN_SPEC_ACCEPT_LEN: KIND_HISTOGRAM,
+    GEN_KV_POOL_OCCUPANCY: KIND_HISTOGRAM,
 }
 
 # Non-default bucket edges per histogram key (default: the log-spaced
@@ -421,6 +437,7 @@ METRIC_KINDS: Dict[str, str] = {
 HISTOGRAM_BOUNDARIES: Dict[str, List[float]] = {
     STALENESS_VERSIONS: VERSION_LAG_BOUNDARIES,
     GEN_SPEC_ACCEPT_LEN: SPEC_ACCEPT_LEN_BOUNDARIES,
+    GEN_KV_POOL_OCCUPANCY: POOL_OCCUPANCY_BOUNDARIES,
 }
 
 

@@ -89,6 +89,10 @@ class GenFleetSpec:
     # None defers to the AREAL_SPEC_DECODE / AREAL_SPEC_K env knobs
     spec_decode: Optional[bool] = None
     spec_k: Optional[int] = None
+    # KV-pool storage dtype (docs/performance.md "KV quantization"):
+    # None defers to cfg.kv_dtype / the AREAL_KV_DTYPE env knob; "int8"
+    # stores quantized pages + per-(page-slot, kv-head) scales
+    kv_dtype: Optional[str] = None
 
 
 @dataclasses.dataclass

@@ -11,7 +11,12 @@ and SGLang's radix/paged KV memory. Redesigned for XLA:
   tokens actually resident — not ``max_slots x max_seqlen`` slabs — and
   prompts SHARE pages for their longest common page-aligned prefix (a radix
   tree over pages; one prefill serves a
-  whole GRPO group; the reason gserver routing is sticky per qid).
+  whole GRPO group; the reason gserver routing is sticky per qid). The pool
+  can store INT8 (``kv_dtype``/``cfg.kv_dtype``/``AREAL_KV_DTYPE``): pages
+  quantize at the post-scan scatter, scales ride a parallel pytree, and
+  dequant fuses into every paged-attention path — half the decode KV bytes,
+  itemsize-ratio x pages at the same pool HBM (docs/performance.md "KV
+  quantization").
 - Admission = CHUNKED PREFILL: prompts stream through a fixed
   ``[n_rows, page]`` extend program, so compile count is bounded by the
   admit-row buckets alone — never by prompt length.
@@ -128,6 +133,26 @@ def _finish_reason(n_gen, max_gen) -> str:
     return "length" if n_gen >= max_gen else "stop"
 
 
+def _resolve_kv_dtype(kv_dtype: Optional[str], serving_dtype: str) -> str:
+    """Normalize a KV-pool dtype request: None/"bf16"/"bfloat16"/the
+    serving dtype itself -> the serving dtype string (raw unquantized
+    pages — "bf16" reads as "not quantized", which under a float32 CPU
+    test config means float32 pages); "int8" -> quantized pool. Anything
+    else is a config error, raised here at engine construction, not deep
+    inside a trace."""
+    if kv_dtype is None:
+        return serving_dtype
+    v = kv_dtype.strip().lower()
+    if v == "int8":
+        return "int8"
+    if v in ("bf16", "bfloat16", serving_dtype):
+        return serving_dtype
+    raise ValueError(
+        f"unsupported kv_dtype {kv_dtype!r}: expected 'int8', 'bf16', or "
+        f"the serving dtype ({serving_dtype!r})"
+    )
+
+
 @dataclasses.dataclass
 class _SlotInfo:
     rid: str
@@ -148,6 +173,7 @@ class GenerationEngine:
         seed: int = 0,
         page_size: int = 128,
         n_pages: Optional[int] = None,
+        kv_dtype: Optional[str] = None,
         enable_prefix_cache: bool = True,
         mesh: Optional[Mesh] = None,
         admit_chunk_tokens: Optional[int] = None,
@@ -159,6 +185,13 @@ class GenerationEngine:
         self.cfg = cfg
         self.mesh = mesh
         self._decode_use_pallas: Optional[bool] = None
+        # KV-pool storage dtype (docs/performance.md "KV quantization"):
+        # explicit argument > cfg.kv_dtype > AREAL_KV_DTYPE > serving dtype
+        kd = kv_dtype if kv_dtype is not None else (
+            cfg.kv_dtype if cfg.kv_dtype is not None else constants.kv_dtype()
+        )
+        self.kv_dtype = _resolve_kv_dtype(kd, cfg.dtype)
+        self.kv_quantized = self.kv_dtype == "int8"
         if mesh is not None:
             if "model" not in mesh.axis_names:
                 raise ValueError(
@@ -180,9 +213,15 @@ class GenerationEngine:
                         f"divisible by the model-axis size {tp}"
                     )
             self._repl = NamedSharding(mesh, P())
-            # pool [L, P, 2, Hkv, page, D]: shard the kv-head dim
+            # pool [L, P, 2, Hkv, page, D]: shard the kv-head dim; the
+            # int8 pool's scales [L, P, 2, Hkv, page] extend the same
+            # Hkv-axis TP split (scales are per kv head, so each model
+            # shard holds exactly its local heads' scales)
             self._pages_sh = NamedSharding(
                 mesh, P(None, None, None, "model", None, None)
+            )
+            self._scales_sh = NamedSharding(
+                mesh, P(None, None, None, "model", None)
             )
             from areal_tpu.parallel.mesh import param_shardings
 
@@ -211,14 +250,24 @@ class GenerationEngine:
         self.global_stop_ids = list(stop_token_ids)
         self.max_stop_ids = 8
         self.enable_prefix_cache = enable_prefix_cache
-        # dense-equivalent pool by default; size it smaller to cap HBM
-        self.n_pages = n_pages if n_pages is not None else self.B * self.M
+        # dense-equivalent pool by default, sized at the SERVING-dtype HBM
+        # budget: a quantized pool's smaller elements buy more pages for
+        # the same bytes (int8 under bf16 serving = 2x n_pages — the whole
+        # point: more resident slots/longer prefixes at fixed HBM), never
+        # a smaller footprint by surprise. Pass n_pages to cap bytes.
+        bytes_ratio = jnp.dtype(cfg.dtype).itemsize if self.kv_quantized else 1
+        self.n_pages = (
+            n_pages if n_pages is not None else self.B * self.M * bytes_ratio
+        )
         self.pool = PagePool(self.n_pages, page_size)
         self.prefix = PrefixRegistry(self.pool)
 
         def make_state() -> GenState:
             return GenState(
-                cache=tfm.PagedKVCache.empty(cfg, self.n_pages, page_size),
+                cache=tfm.PagedKVCache.empty(
+                    cfg, self.n_pages, page_size,
+                    kv_dtype="int8" if self.kv_quantized else None,
+                ),
                 lens=jnp.zeros((self.B,), jnp.int32),
                 last_tokens=jnp.zeros((self.B,), jnp.int32),
                 active=jnp.zeros((self.B,), bool),
@@ -245,7 +294,11 @@ class GenerationEngine:
                 lambda _: self._repl, jax.eval_shape(make_state)
             )
             sh = dataclasses.replace(
-                sh, cache=tfm.PagedKVCache(pages=self._pages_sh)
+                sh,
+                cache=tfm.PagedKVCache(
+                    pages=self._pages_sh,
+                    scales=self._scales_sh if self.kv_quantized else None,
+                ),
             )
             self._state_sh = sh
             # arealint: ok(one-time engine-state materialization at construction)
@@ -366,6 +419,29 @@ class GenerationEngine:
             for d in (self._jit_extend, self._jit_commit, self._jit_chunk,
                       self._jit_spec)
             for j in d.values()
+        )
+
+    def kv_pool_bytes(self) -> int:
+        """Configured KV-pool HBM footprint (pages + quant scales),
+        computed from shapes — no device pull. The serving gauge the
+        fleet aggregator watches for HBM headroom."""
+        cfg = self.cfg
+        elems = cfg.n_layers * self.n_pages * 2 * cfg.n_kv_heads * self.page
+        item = 1 if self.kv_quantized else jnp.dtype(cfg.dtype).itemsize
+        total = elems * cfg.head_dim * item
+        if self.kv_quantized:
+            total += elems * 4  # one f32 scale per (token slot, head, K|V)
+        return total
+
+    def kv_pool_occupancy(self) -> float:
+        """Fraction of pool pages currently held (slots + prefix cache)."""
+        return 1.0 - self.pool.n_free / max(self.n_pages, 1)
+
+    def _observe_occupancy(self):
+        """Fold the current pool occupancy into the telemetry histogram —
+        host arithmetic riding a chunk dispatch the engine already pays."""
+        metrics_mod.counters.observe(
+            metrics_mod.GEN_KV_POOL_OCCUPANCY, self.kv_pool_occupancy()
         )
 
     def prepare_params(self, params):
@@ -635,6 +711,11 @@ class GenerationEngine:
                     self.prefix.insert(ids, list(owned[:n_shared_full]))
             self.stats["prefill_tokens"] += len(row["tokens"])
             self.stats["admitted"] += 1
+            if self.kv_quantized and owned:
+                # these pages' KV lands int8 at the post-scan scatter
+                metrics_mod.counters.add(
+                    metrics_mod.GEN_KVQ_PAGES_QUANTIZED, len(owned)
+                )
             admitted.append((r, slot, row))
         still_pending.extend(take)  # slots/pool ran out: back in line
         if still_pending:
@@ -697,13 +778,20 @@ class GenerationEngine:
     # Decode
     # ------------------------------------------------------------------ #
 
-    def _chunk_fn(self, n_steps: int, width: int, warp: bool):
-        key = (n_steps, width, warp)
+    def _chunk_fn(self, n_steps: int, width: int, warp_bucket: int):
+        """``warp_bucket`` (STATIC jit key): power-of-two capacity of the
+        per-slot warping-index operand, 0 = no resident slot warps. The
+        top-p/top-k sort — the most expensive op of a decode step at a
+        152k vocab — runs over the warping slots ONLY
+        (``warp_logits_rows``); one top-p request no longer drags the
+        whole batch through a ``[B, V]`` sort, and greedy-only traffic
+        skips it entirely. Specializations stay bounded by log2 buckets."""
+        key = (n_steps, width, warp_bucket)
         if key in self._jit_chunk:
             return self._jit_chunk[key]
         cfg = self.cfg
 
-        def one_step(state: GenState, params, table):
+        def one_step(state: GenState, params, table, warp_rows):
             logits, cache, new_lens = tfm.decode_step_paged(
                 params, cfg, state.cache, state.last_tokens, table,
                 state.lens, state.active,
@@ -716,7 +804,12 @@ class GenerationEngine:
                 # through compiler-chosen per-op resharding
                 logits = jax.lax.with_sharding_constraint(logits, self._repl)
             rng, sub = jax.random.split(state.rng)
-            tokens, lp = sample_tokens(sub, logits, state.sp, warp=warp)
+            if warp_bucket == 0:
+                tokens, lp = sample_tokens(sub, logits, state.sp, warp=False)
+            else:
+                tokens, lp = sample_tokens(
+                    sub, logits, state.sp, warp=True, warp_rows=warp_rows
+                )
             tokens = jnp.where(state.active, tokens, state.last_tokens)
             rows = jnp.arange(tokens.shape[0])
             idx = jnp.clip(state.n_gen, 0, state.out_tokens.shape[1] - 1)
@@ -749,9 +842,9 @@ class GenerationEngine:
                 rng=rng,
             )
 
-        def chunk(params, state, table):
+        def chunk(params, state, table, warp_rows):
             def body(s, _):
-                return one_step(s, params, table), None
+                return one_step(s, params, table, warp_rows), None
 
             state, _ = jax.lax.scan(body, state, None, length=n_steps)
             # harvest flags ride as UNDONATED aux outputs: the pipelined
@@ -760,7 +853,7 @@ class GenerationEngine:
             return state, (state.active, state.n_gen, state.max_gen,
                            state.lens)
 
-        sharding_kw = self._jit_sharding(1)
+        sharding_kw = self._jit_sharding(2)
         if sharding_kw:
             # output is now (state, flags): the flag tuple replicates (it
             # is pulled to host) — a bare state out_sharding would be a
@@ -785,8 +878,8 @@ class GenerationEngine:
     # harvest protocol (pipelining, pause, weight swap untouched).
     # ------------------------------------------------------------------ #
 
-    def _spec_chunk_fn(self, n_steps: int, width: int, warp: bool):
-        key = (n_steps, width, warp, self.spec_k)
+    def _spec_chunk_fn(self, n_steps: int, width: int, warp_bucket: int):
+        key = (n_steps, width, warp_bucket, self.spec_k)
         if key in self._jit_spec:
             return self._jit_spec[key]
         cfg = self.cfg
@@ -794,7 +887,7 @@ class GenerationEngine:
         C = K + 1
         B, G, S = self.B, self.G, self.S
 
-        def one_spec_step(state: GenState, params, table):
+        def one_spec_step(state: GenState, params, table, warp_rows):
             draft = self.drafter.propose(
                 state.ctx_tokens, state.lens, state.fallback_token, K
             )                                             # [B, K]
@@ -821,8 +914,11 @@ class GenerationEngine:
                     logits, self._repl
                 )
             rng, sub = jax.random.split(state.rng)
+            # same per-slot warp narrowing as the vanilla chunk: only the
+            # warping slots' K+1 verify rows pay the sort
             a, cand, cand_lp, boundary_arg = spec_rejection_sample(
-                sub, logits, draft, state.sp, warp=warp
+                sub, logits, draft, state.sp, warp=warp_bucket > 0,
+                warp_rows=warp_rows if warp_bucket > 0 else None,
             )
             # masked variable-length advance: accepted drafts + one
             # residual token, capped at the remaining budget, truncated at
@@ -878,9 +974,9 @@ class GenerationEngine:
             )
             return new_state, (drafted, accepted)
 
-        def spec_chunk(params, state, table):
+        def spec_chunk(params, state, table, warp_rows):
             def body(s, _):
-                return one_spec_step(s, params, table)
+                return one_spec_step(s, params, table, warp_rows)
 
             state, (drafted, accepted) = jax.lax.scan(
                 body, state, None, length=n_steps
@@ -891,7 +987,7 @@ class GenerationEngine:
             return state, (state.active, state.n_gen, state.max_gen,
                            state.lens, drafted, accepted)
 
-        sharding_kw = self._jit_sharding(1)
+        sharding_kw = self._jit_sharding(2)
         if sharding_kw:
             sharding_kw = dict(sharding_kw)
             sharding_kw["out_shardings"] = (
@@ -921,15 +1017,37 @@ class GenerationEngine:
                 metrics_mod.GEN_SPEC_ACCEPT_LEN, float(v), n=int(c)
             )
 
+    def _warp_bucket(self, n: int) -> int:
+        """Power-of-two capacity bucket for the warping-slot index operand
+        (0 = nothing warps): jit specializations stay bounded by log2
+        buckets, never by the exact warping count."""
+        if n <= 0:
+            return 0
+        w = 1
+        while w < n:
+            w *= 2
+        return min(w, self.B)
+
     def _decode_chunk_fn(self, decode_steps: int, running: List[int]):
         """Pick the chunk program (spec or vanilla) plus its table-width
-        token bound for one dispatch. ``self.spec`` is read here, under the
-        engine lock — flipping it between chunks is safe and takes effect
-        on the next dispatch (both programs share one state pytree)."""
+        token bound and the per-slot warp operand for one dispatch.
+        ``self.spec`` is read here, under the engine lock — flipping it
+        between chunks is safe and takes effect on the next dispatch
+        (both programs share one state pytree).
+
+        The host knows exactly which resident slots warp (``_warp_host``,
+        set at admission), so the chunk receives their indices padded to a
+        power-of-two bucket — the sampling sort covers those rows only,
+        instead of one top-p request forcing the whole batch through the
+        ``[B, V]`` sort (the old static ``warp=True`` key did exactly
+        that)."""
         tok_bound = decode_steps * ((self.spec_k + 1) if self.spec else 1)
-        warp = bool(self._warp_host[running].any())
+        warp_slots = [b for b in running if self._warp_host[b]]
+        wb = self._warp_bucket(len(warp_slots))
+        warp_idx = np.full((wb,), self.B, np.int32)  # padding => scatter-drop
+        warp_idx[: len(warp_slots)] = warp_slots
         make = self._spec_chunk_fn if self.spec else self._chunk_fn
-        return make, tok_bound, warp
+        return make, tok_bound, wb, warp_idx
 
     def _pull_outputs(self) -> dict:
         """ONE device pull of every slot's accumulated outputs + flags."""
@@ -997,15 +1115,17 @@ class GenerationEngine:
                 return []
             # width-limit the chunk to the pages this chunk can touch
             running = [b for b, s in enumerate(self._slots) if s is not None]
-            make, tok_bound, warp = self._decode_chunk_fn(
+            make, tok_bound, wb, warp_idx = self._decode_chunk_fn(
                 decode_steps, running
             )
             W = self._table_width(
                 int(self._lens_host[running].max()) + tok_bound
             )
-            chunk = make(decode_steps, W, warp)
+            self._observe_occupancy()
+            chunk = make(decode_steps, W, wb)
             self.state, flags = chunk(
-                self.params, self.state, jnp.asarray(self._table_host[:, :W])
+                self.params, self.state,
+                jnp.asarray(self._table_host[:, :W]), jnp.asarray(warp_idx),
             )
             # one host sync per chunk
             flags = jax.device_get(flags)
@@ -1035,7 +1155,7 @@ class GenerationEngine:
         new_flags, new_running, new_ahead = None, (), 0
         if self.n_running():
             running = [b for b, s in enumerate(self._slots) if s is not None]
-            make, tok_bound, warp = self._decode_chunk_fn(
+            make, tok_bound, wb, warp_idx = self._decode_chunk_fn(
                 decode_steps, running
             )
             # _lens_host can be one in-flight chunk stale for continuing
@@ -1045,9 +1165,11 @@ class GenerationEngine:
                 int(self._lens_host[running].max())
                 + self._steps_ahead + tok_bound
             )
-            chunk = make(decode_steps, W, warp)
+            self._observe_occupancy()
+            chunk = make(decode_steps, W, wb)
             self.state, new_flags = chunk(
-                self.params, self.state, jnp.asarray(self._table_host[:, :W])
+                self.params, self.state,
+                jnp.asarray(self._table_host[:, :W]), jnp.asarray(warp_idx),
             )
             new_running = tuple(
                 (b, int(self._slot_epoch[b])) for b in running

@@ -10,7 +10,11 @@ reason gserver routing is sticky per qid — and prompts over one system
 preamble share the preamble pages).
 
 Device arrays live in the engine; this module is pure host bookkeeping
-(free list, refcounts, prefix registry) — no jax imports.
+(free list, refcounts, prefix registry) — no jax imports. It is also
+BYTE-AGNOSTIC: a page index addresses whatever the pool stores (raw
+bf16 pages or int8 pages + their parallel scales array — docs/
+performance.md "KV quantization"), so prefix sharing shares quantized
+pages and their scales without this module knowing either exists.
 """
 
 import dataclasses

@@ -73,6 +73,47 @@ def warp_logits(logits: jnp.ndarray, sp: SamplingParams) -> jnp.ndarray:
     return jnp.where(logits < thresh, NEG_INF, logits)
 
 
+def _plain_temperature(logits: jnp.ndarray, sp: SamplingParams) -> jnp.ndarray:
+    """The no-warp arm of sampling: f32 logits over temperature (floored),
+    broadcast over any number of trailing-position axes before the vocab."""
+    temp = jnp.maximum(sp.temperature, 1e-6).reshape(
+        sp.temperature.shape + (1,) * (logits.ndim - 1)
+    )
+    return logits.astype(jnp.float32) / temp
+
+
+def warp_logits_rows(
+    logits: jnp.ndarray, sp: SamplingParams, rows: jnp.ndarray
+) -> jnp.ndarray:
+    """Warp ONLY the slots named by ``rows`` (host-known warping-slot
+    indices, padded with an out-of-range index): the sort — the dominant
+    cost of a decode step at a 152k vocab — runs over ``[W, V]`` (or
+    ``[W*C, V]`` for the spec-verify ``[B, C, V]`` shape) where W is the
+    warping-slot bucket, never the whole batch; every other slot gets the
+    plain temperature scaling of the ``warp=False`` path. Exactly
+    equivalent per row to full-batch :func:`warp_logits` /
+    :func:`warp_logits_multi` — a greedy slot's result is identical either
+    way (temperature 0 passes warping through), so mixed batches stay
+    correct while greedy traffic stops paying for one top-p request."""
+    B = logits.shape[0]
+    safe = jnp.clip(rows, 0, B - 1)
+    sub_sp = SamplingParams(
+        temperature=sp.temperature[safe],
+        top_p=sp.top_p[safe],
+        top_k=sp.top_k[safe],
+    )
+    sub = logits[safe]
+    if logits.ndim == 3:
+        warped_rows = warp_logits_multi(sub, sub_sp)
+    else:
+        warped_rows = warp_logits(sub, sub_sp)
+    # padding indices (== B) drop; a clipped duplicate of row B-1 in the
+    # gather is then never scattered back
+    return _plain_temperature(logits, sp).at[rows].set(
+        warped_rows, mode="drop"
+    )
+
+
 def warp_logits_multi(logits: jnp.ndarray, sp: SamplingParams) -> jnp.ndarray:
     """Warp ``[B, C, V]`` logits (C query positions per slot, the spec-decode
     verify shape) with per-SLOT sampling params. ONE ``[B*C, V]`` sort serves
@@ -97,6 +138,7 @@ def spec_rejection_sample(
     warp: bool = True,
     greedy: Optional[jnp.ndarray] = None,
     q_logprobs: Optional[jnp.ndarray] = None,  # [B, K, V] proposal logprobs
+    warp_rows: Optional[jnp.ndarray] = None,   # [W] warping-slot indices
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Speculative-decoding acceptance: accept a prefix of the draft, then
     sample ONE residual token from the normalized difference distribution.
@@ -129,12 +171,13 @@ def spec_rejection_sample(
     """
     B, C, V = logits.shape
     K = C - 1
-    if warp:
-        warped = warp_logits_multi(logits, sp)
+    if not warp:
+        warped = _plain_temperature(logits, sp)
+    elif warp_rows is not None:
+        # host-known warping slots: only their rows pay the sort
+        warped = warp_logits_rows(logits, sp, warp_rows)
     else:
-        warped = logits.astype(jnp.float32) / jnp.maximum(
-            sp.temperature, 1e-6
-        )[:, None, None]
+        warped = warp_logits_multi(logits, sp)
     logp = jax.nn.log_softmax(warped, axis=-1)               # [B, C, V]
     argmax = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [B, C]
     if greedy is None:
@@ -206,6 +249,7 @@ def sample_tokens(
     sp: SamplingParams,
     greedy: Optional[jnp.ndarray] = None,
     warp: bool = True,
+    warp_rows: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Sample one token per slot. Returns (tokens [B] i32, logprobs [B] f32).
 
@@ -215,15 +259,17 @@ def sample_tokens(
     ``warp=False`` (STATIC) skips the top-k/top-p warp entirely — pure
     temperature sampling needs no ``[B, V]`` sort, and the sort is the
     single most expensive op of a decode step at a 152k vocab. Callers that
-    know no request warps (the engine tracks this host-side) pass False;
-    the result is EXACT either way.
+    know no request warps (the engine tracks this host-side) pass False.
+    ``warp_rows`` (with ``warp=True``) narrows the sort to the named slots
+    only (:func:`warp_logits_rows`) — mixed batches pay for their warping
+    requests, not for the batch. The result is EXACT in every mode.
     """
-    if warp:
-        warped = warp_logits(logits, sp)
+    if not warp:
+        warped = _plain_temperature(logits, sp)
+    elif warp_rows is not None:
+        warped = warp_logits_rows(logits, sp, warp_rows)
     else:
-        warped = logits.astype(jnp.float32) / jnp.maximum(
-            sp.temperature, 1e-6
-        )[:, None]
+        warped = warp_logits(logits, sp)
     logp = jax.nn.log_softmax(warped, axis=-1)
     sampled = jax.random.categorical(rng, warped, axis=-1)
     arg = jnp.argmax(logits, axis=-1)

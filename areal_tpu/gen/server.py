@@ -315,9 +315,17 @@ class GenerationHTTPServer:
             "gen_throughput": self._gen_tokens / max(time.time() - self._start, 1e-6),
             "version": self.engine.version,
             "max_slots": self.engine.B,
-            # paged KV pool + prefix cache observability
+            # paged KV pool + prefix cache observability: bytes, dtype and
+            # occupancy are the per-server HBM-headroom gauges the fleet
+            # aggregator / apps/obs watch (docs/observability.md)
+            # "pages_free" is the legacy alias of "n_pages_free" (the
+            # fleet-gauge name) — keep both until scrapers migrate
             "pages_free": self.engine.pool.n_free,
             "pages_total": self.engine.n_pages,
+            "n_pages_free": self.engine.pool.n_free,
+            "kv_dtype": self.engine.kv_dtype,
+            "kv_pool_bytes": self.engine.kv_pool_bytes(),
+            "kv_pool_occupancy": round(self.engine.kv_pool_occupancy(), 4),
             "prefix_pages": len(self.engine.prefix),
             # phase accounting: where serving wall time went
             "uptime_s": round(time.time() - self._start, 3),

@@ -87,6 +87,16 @@ class ModelConfig:
     # Compute dtype for activations (params kept fp32 master in the optimizer)
     dtype: str = "bfloat16"
 
+    # Paged-KV pool storage dtype for generation engines (docs/performance.md
+    # "KV quantization"): None = serving ``dtype`` (raw bf16 pages — the
+    # chip-verified default until the gen_kvq bench proves int8 on hardware);
+    # "int8" stores quantized pages with per-(page-slot, kv-head) scales in a
+    # parallel scales array, halving decode's HBM KV traffic and doubling
+    # resident pages at fixed pool HBM. The AREAL_KV_DTYPE env knob
+    # (base/constants.py) overrides a None here; an explicit engine argument
+    # overrides both.
+    kv_dtype: Optional[str] = None
+
     # Attention backend: None = auto (Pallas flash on TPU, XLA dense on CPU,
     # where pallas only runs interpreted); True/False force it.
     use_flash_attention: Optional[bool] = None

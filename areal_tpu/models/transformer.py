@@ -651,15 +651,45 @@ class PagedKVCache:
     tables, lengths) lives with the generation engine — the pool itself
     has no per-sequence structure, which is exactly what lets prompts
     share pages (counterpart of SGLang's radix-cache memory, SURVEY
-    §2.1)."""
+    §2.1).
+
+    ``scales`` (int8 mode, docs/performance.md "KV quantization"): pages
+    store int8 values and a parallel ``[L, P, 2, Hkv, page]`` f32 array
+    carries one dequant scale per (page slot, kv head) — page-structured
+    exactly like the pool, so page tables, TP's kv-head sharding, and
+    radix prefix sharing address both arrays with the same indices and
+    shared pages share their scales for free. Quantization happens at the
+    post-scan scatter (:func:`_scatter_chunk_kv`); dequant is fused into
+    every paged-attention entry point so int8 pages are read straight from
+    HBM and widened in-register — a bf16 copy of the pool never exists.
+    ``scales is None`` = raw serving-dtype pages (the default)."""
 
     pages: jnp.ndarray
+    scales: Optional[jnp.ndarray] = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.scales is not None
 
     @classmethod
-    def empty(cls, cfg: ModelConfig, n_pages: int, page_size: int) -> "PagedKVCache":
+    def empty(
+        cls,
+        cfg: ModelConfig,
+        n_pages: int,
+        page_size: int,
+        kv_dtype: Optional[str] = None,
+    ) -> "PagedKVCache":
+        """``kv_dtype``: normalized pool storage dtype — ``"int8"`` builds
+        the quantized pool + scales pair, anything else (None) stores raw
+        ``cfg.dtype`` pages."""
         shape = (
             cfg.n_layers, n_pages, 2, cfg.n_kv_heads, page_size, cfg.head_dim
         )
+        if kv_dtype == "int8":
+            return cls(
+                pages=jnp.zeros(shape, jnp.int8),
+                scales=jnp.zeros(shape[:-1], jnp.float32),
+            )
         return cls(pages=jnp.zeros(shape, jnp.dtype(cfg.dtype)))
 
 
@@ -676,7 +706,15 @@ def _scatter_chunk_kv(cache: PagedKVCache, ks, vs, table, positions, valid):
     row scatter keeps the default layout — the earlier multi-dim scatter
     was assigned a PERMUTED pool layout by XLA, forcing two full-pool
     relayout copies per decode step around the (default-layout) attention
-    kernel (~11 ms/step at a 1.5B/64-slot profile; HLO ``copy.14/.27``)."""
+    kernel (~11 ms/step at a 1.5B/64-slot profile; HLO ``copy.14/.27``).
+
+    Int8 mode (``cache.scales`` present): each token's K/V row quantizes
+    symmetrically over its head_dim (scale = amax/127 per (token, kv head,
+    K|V)) and the scale lands in the parallel scales array through the
+    SAME flat row indices — one extra [rows] scatter of scalars, no
+    second index computation. Per-row scales make incremental page fills
+    exact: a new token never forces requantizing its page's earlier
+    residents."""
     L, B, C, Hkv, D = ks.shape
     P, _, _, page = cache.pages.shape[1:5]
     M = table.shape[1]
@@ -685,7 +723,23 @@ def _scatter_chunk_kv(cache: PagedKVCache, ks, vs, table, positions, valid):
     )                                                   # [B, C]
     off = positions % page                              # [B, C]
     dt = cache.pages.dtype
-    kv = jnp.stack([ks, vs], axis=3).astype(dt)         # [L, B, C, 2, Hkv, D]
+    if cache.scales is not None:
+        kf = ks.astype(jnp.float32)
+        vf = vs.astype(jnp.float32)
+        amax = jnp.stack(
+            [jnp.max(jnp.abs(kf), axis=-1), jnp.max(jnp.abs(vf), axis=-1)],
+            axis=3,
+        )                                               # [L, B, C, 2, Hkv]
+        scale = jnp.where(amax > 0.0, amax / 127.0, 1.0)
+        kv = jnp.clip(
+            jnp.round(
+                jnp.stack([kf, vf], axis=3) / scale[..., None]
+            ),
+            -127.0, 127.0,
+        ).astype(jnp.int8)                              # [L, B, C, 2, Hkv, D]
+    else:
+        scale = None
+        kv = jnp.stack([ks, vs], axis=3).astype(dt)     # [L, B, C, 2, Hkv, D]
     # flat row = (((l*P + p)*2 + kv)*Hkv + h)*page + off
     n_rows = L * P * 2 * Hkv * page
     base = page_idx[None] + P * jnp.arange(L)[:, None, None]     # [L, B, C]
@@ -696,7 +750,14 @@ def _scatter_chunk_kv(cache: PagedKVCache, ks, vs, table, positions, valid):
     rows = jnp.where(valid[None, :, :, None, None], rows, n_rows)  # => drop
     flat = cache.pages.reshape(n_rows, D)
     flat = flat.at[rows].set(kv, mode="drop")
-    return PagedKVCache(pages=flat.reshape(cache.pages.shape))
+    if scale is None:
+        return PagedKVCache(pages=flat.reshape(cache.pages.shape))
+    flat_s = cache.scales.reshape(n_rows)
+    flat_s = flat_s.at[rows].set(scale, mode="drop")
+    return PagedKVCache(
+        pages=flat.reshape(cache.pages.shape),
+        scales=flat_s.reshape(cache.scales.shape),
+    )
 
 
 def _extend_layers(
@@ -731,6 +792,7 @@ def _extend_layers(
             softmax_scale=cfg.softmax_scale,
             soft_cap=cfg.attn_logits_soft_cap,
             sliding_window=cfg.sliding_window,
+            scales=cache.scales,
         )
         if verify:
             return paged_ops.paged_verify_attention(
@@ -863,6 +925,7 @@ def decode_step_paged(
             sliding_window=cfg.sliding_window,
             use_pallas=use_pallas,
             mesh=mesh,
+            scales=cache.scales,
         )
         x = x + _attn_out(lp["attn"], ctx.astype(x.dtype))
         h = _norm(cfg, lp["ln2"], x)

@@ -46,22 +46,7 @@ def _interpret() -> bool:
 
 
 def _decode_kernel(
-    layer_ref,   # [1] int32 scalar-prefetch: which layer of the pool
-    table_ref,   # [B, M] int32 scalar-prefetch
-    lens_ref,    # [B] int32 scalar-prefetch (pool-resident, EXCL. self)
-    q_ref,       # [SB, Hq, D]
-    ks_ref,      # [SB, Hkv, D] the current tokens' K (not in the pool)
-    vs_ref,      # [SB, Hkv, D]
-    kv_hbm,      # [L, P, 2, Hkv, page, D] whole pool, ANY/HBM
-    o_ref,       # [SB, Hq, D]
-    kv_scr,      # [2, SB, 2, Hkv, KP*page, D] DOUBLE-buffered page scratch
-                 # — pages DMA straight into the compute layout while the
-                 # previous grid step's buffer is being consumed
-    m_scr,       # [SB, HqP, LANES] f32
-    l_scr,       # [SB, HqP, LANES] f32
-    acc_scr,     # [SB, HqP, Dp] f32
-    sems,        # DMA semaphores [2, SB, KP]
-    *,
+    *refs,
     scale: float,
     page: int,
     kp: int,
@@ -70,7 +55,36 @@ def _decode_kernel(
     n_rep: int,
     soft_cap: Optional[float],
     sliding_window: Optional[int],
+    quantized: bool,
 ):
+    # Ref order (inputs, outputs, scratch); the int8 pool adds a scales
+    # input + a scales scratch/semaphore pair right after their KV twins:
+    #   layer_ref  [1] int32 scalar-prefetch: which layer of the pool
+    #   table_ref  [B, M] int32 scalar-prefetch
+    #   lens_ref   [B] int32 scalar-prefetch (pool-resident, EXCL. self)
+    #   q_ref      [SB, Hq, D]
+    #   ks_ref     [SB, Hkv, D] the current tokens' K (not in the pool)
+    #   vs_ref     [SB, Hkv, D]
+    #   kv_hbm     [L, P, 2, Hkv, page, D] whole pool, ANY/HBM
+    #   sc_hbm     [L, P, 2, Hkv, page] f32 scales, ANY/HBM   (quantized)
+    #   o_ref      [SB, Hq, D]
+    #   kv_scr     [2, SB, 2, Hkv, KP*page, D] DOUBLE-buffered page scratch
+    #              — pages DMA straight into the compute layout while the
+    #              previous grid step's buffer is being consumed
+    #   sc_scr     [2, SB, 2, Hkv, KP*page] f32 scale scratch (quantized)
+    #   m_scr      [SB, HqP, LANES] f32
+    #   l_scr      [SB, HqP, LANES] f32
+    #   acc_scr    [SB, HqP, Dp] f32
+    #   sems       DMA semaphores [2, SB, KP]
+    #   sc_sems    DMA semaphores [2, SB, KP]                 (quantized)
+    if quantized:
+        (layer_ref, table_ref, lens_ref, q_ref, ks_ref, vs_ref, kv_hbm,
+         sc_hbm, o_ref, kv_scr, sc_scr, m_scr, l_scr, acc_scr, sems,
+         sc_sems) = refs
+    else:
+        (layer_ref, table_ref, lens_ref, q_ref, ks_ref, vs_ref, kv_hbm,
+         o_ref, kv_scr, m_scr, l_scr, acc_scr, sems) = refs
+        sc_hbm = sc_scr = sc_sems = None
     bb = pl.program_id(0)
     j = pl.program_id(1)
     nblk = pl.num_programs(1)
@@ -113,6 +127,16 @@ def _decode_kernel(
                         kv_scr.at[buf, s, :, :, pl.ds(i * page, page), :],
                         sems.at[buf, s, i],
                     ).start()
+                    if quantized:
+                        # the page's scale stripe rides a second (tiny —
+                        # 1/D of the page bytes) DMA into the parallel
+                        # scale scratch; dequant happens in-register at
+                        # the dots, never as a widened pool copy
+                        pltpu.make_async_copy(
+                            sc_hbm.at[layer, pidx],
+                            sc_scr.at[buf, s, :, :, pl.ds(i * page, page)],
+                            sc_sems.at[buf, s, i],
+                        ).start()
 
                 @pl.when(
                     (j_t * kp + i >= n_used)
@@ -122,6 +146,10 @@ def _decode_kernel(
                     kv_scr[buf, s, :, :, pl.ds(i * page, page), :] = (
                         jnp.zeros((2, n_kv, page, D), kv_scr.dtype)
                     )
+                    if quantized:
+                        sc_scr[buf, s, :, :, pl.ds(i * page, page)] = (
+                            jnp.zeros((2, n_kv, page), sc_scr.dtype)
+                        )
 
     # Software pipeline over the (sequential) linearized grid: step g's
     # pages were prefetched at step g-1; here we kick off g+1's DMAs BEFORE
@@ -151,6 +179,12 @@ def _decode_kernel(
                     kv_scr.at[buf, s, :, :, pl.ds(i * page, page), :],
                     sems.at[buf, s, i],
                 ).wait()
+                if quantized:
+                    pltpu.make_async_copy(
+                        sc_hbm.at[layer, pidx],
+                        sc_scr.at[buf, s, :, :, pl.ds(i * page, page)],
+                        sc_sems.at[buf, s, i],
+                    ).wait()
 
     S = kp * page
     # per-slot resident lengths as an [SB, 1, S] operand built from stacked
@@ -174,10 +208,21 @@ def _decode_kernel(
         q = q_ref[...].reshape(sb * n_kv, n_rep, D)
         k = kv_scr[buf, :, 0].reshape(sb * n_kv, S, D)
         v = kv_scr[buf, :, 1].reshape(sb * n_kv, S, D)
+        if quantized:
+            # in-register widening: int8 in [-127, 127] is exact in bf16
+            # (8 mantissa bits cover 256), so casting to q's dtype loses
+            # nothing, and the per-(head, position) K scale folds into the
+            # SCORES after the dot — it is constant over D, so
+            # q·(k_int*s) == (q·k_int)*s with one [*, S] multiply instead
+            # of rescaling the whole [*, S, D] block
+            k = k.astype(q.dtype)
         sc = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         ) * scale                                             # [SB*Hkv,r,S]
+        if quantized:
+            k_sc = sc_scr[buf, :, 0].reshape(sb * n_kv, S)
+            sc = sc * k_sc[:, None, :]
         if soft_cap is not None:
             sc = soft_cap * jnp.tanh(sc / soft_cap)
         sc = sc.reshape(sb, Hq, S)
@@ -197,8 +242,17 @@ def _decode_kernel(
         l_new = corr * l_scr[:, :Hq, 0:1] + jnp.sum(
             p, axis=2, keepdims=True
         )
+        pq = p.reshape(sb * n_kv, n_rep, S)
+        if quantized:
+            # the V scale folds into the probabilities (constant over D):
+            # Σ_s p[s]·(v_int[s]·vs[s]) == Σ_s (p[s]·vs[s])·v_int[s]
+            v_sc = sc_scr[buf, :, 1].reshape(sb * n_kv, S)
+            pq = (pq * v_sc[:, None, :]).astype(jnp.float32)
+            v = v.astype(jnp.float32)
+        else:
+            pq = pq.astype(v.dtype)
         pv = jax.lax.dot_general(
-            p.reshape(sb * n_kv, n_rep, S).astype(v.dtype), v,
+            pq, v,
             (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         ).reshape(sb, Hq, D)
@@ -248,10 +302,17 @@ def decode(
     sliding_window: Optional[int] = None,
     pages_per_step: int = 8,
     slots_per_step: int = 8,
+    scales: Optional[jnp.ndarray] = None,  # [L, P, 2, Hkv, page] f32
 ) -> jnp.ndarray:
     """The pool rides in whole (ANY memory space); the kernel issues its own
     per-page DMAs keyed by the scalar-prefetched layer index and page table
-    — the caller's layer scan never slices or reshapes the pool."""
+    — the caller's layer scan never slices or reshapes the pool.
+
+    ``scales`` marks an int8 pool (docs/performance.md "KV quantization"):
+    each page's scale stripe DMAs alongside the page into a parallel
+    scratch and dequant fuses into the dots — the HBM read stays int8
+    (half the KV bytes of bf16 + a 1/D scale overhead), values widen only
+    in-register."""
     if _ANY_MEMORY_SPACE is None or not compat.compiler_params_available():
         # fail loudly at the boundary, not deep inside the kernel build:
         # the pool ref must stay in ANY/HBM, and the double-buffered page
@@ -267,10 +328,12 @@ def decode(
     L, P, _, Hkv, page, _ = pages.shape
     M = table.shape[1]
     n_rep = Hq // Hkv
-    if not _interpret() and (D % 128 != 0 or page % 8 != 0):
+    quantized = scales is not None
+    page_mult = 32 if quantized else 8  # int8 sublane tile is 32
+    if not _interpret() and (D % 128 != 0 or page % page_mult != 0):
         raise ValueError(
-            f"paged kernel needs head_dim%128==0 and page%8==0 on TPU; got "
-            f"D={D}, page={page} — use the XLA gather path"
+            f"paged kernel needs head_dim%128==0 and page%{page_mult}==0 "
+            f"on TPU; got D={D}, page={page} — use the XLA gather path"
         )
     if softmax_scale is None:
         softmax_scale = D ** -0.5
@@ -280,9 +343,16 @@ def decode(
     sb = slots_per_step
     while B % sb:
         sb //= 2
+
+    def _scratch_bytes(sb_):
+        # double-buffered KV pages + (quantized) their f32 scale stripes
+        b = 2 * 2 * sb_ * kp * page * Hkv * D * pages.dtype.itemsize
+        if quantized:
+            b += 2 * 2 * sb_ * kp * page * Hkv * 4
+        return b
+
     # VMEM budget: keep the (double-buffered) KV scratch under ~16 MB
-    while sb > 1 and 2 * 2 * sb * kp * page * Hkv * D * pages.dtype.itemsize \
-            > 16 * 1024 * 1024:
+    while sb > 1 and _scratch_bytes(sb) > 16 * 1024 * 1024:
         sb //= 2
 
     kernel = functools.partial(
@@ -295,43 +365,53 @@ def decode(
         n_rep=n_rep,
         soft_cap=soft_cap,
         sliding_window=sliding_window,
+        quantized=quantized,
     )
+    in_specs = [
+        pl.BlockSpec((sb, Hq, D), lambda b, j, ly, t, l: (b, 0, 0)),
+        pl.BlockSpec((sb, Hkv, D), lambda b, j, ly, t, l: (b, 0, 0)),
+        pl.BlockSpec((sb, Hkv, D), lambda b, j, ly, t, l: (b, 0, 0)),
+        pl.BlockSpec(memory_space=_ANY_MEMORY_SPACE),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((2, sb, 2, Hkv, kp * page, D), pages.dtype),
+        pltpu.VMEM((sb, hq_pad, LANES), jnp.float32),
+        pltpu.VMEM((sb, hq_pad, LANES), jnp.float32),
+        # lanes padded to a full tile; the kernel uses [:, :D]
+        pltpu.VMEM((sb, hq_pad, max(D, LANES)), jnp.float32),
+        pltpu.SemaphoreType.DMA((2, sb, kp)),
+    ]
+    operands = [
+        jnp.asarray(layer, jnp.int32).reshape(1), table, lens,
+        q, k_self, v_self, pages,
+    ]
+    if quantized:
+        # scales ride whole in ANY/HBM like the pool; their scratch and
+        # semaphores slot in right after their KV twins (kernel ref order)
+        in_specs.append(pl.BlockSpec(memory_space=_ANY_MEMORY_SPACE))
+        scratch_shapes.insert(
+            1, pltpu.VMEM((2, sb, 2, Hkv, kp * page), jnp.float32)
+        )
+        scratch_shapes.append(pltpu.SemaphoreType.DMA((2, sb, kp)))
+        operands.append(scales)
     return pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(B // sb, nblk),
-            in_specs=[
-                pl.BlockSpec((sb, Hq, D), lambda b, j, ly, t, l: (b, 0, 0)),
-                pl.BlockSpec((sb, Hkv, D), lambda b, j, ly, t, l: (b, 0, 0)),
-                pl.BlockSpec((sb, Hkv, D), lambda b, j, ly, t, l: (b, 0, 0)),
-                pl.BlockSpec(memory_space=_ANY_MEMORY_SPACE),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (sb, Hq, D), lambda b, j, ly, t, l: (b, 0, 0)
             ),
-            scratch_shapes=[
-                pltpu.VMEM((2, sb, 2, Hkv, kp * page, D), pages.dtype),
-                pltpu.VMEM((sb, hq_pad, LANES), jnp.float32),
-                pltpu.VMEM((sb, hq_pad, LANES), jnp.float32),
-                # lanes padded to a full tile; the kernel uses [:, :D]
-                pltpu.VMEM((sb, hq_pad, max(D, LANES)), jnp.float32),
-                pltpu.SemaphoreType.DMA((2, sb, kp)),
-            ],
+            scratch_shapes=scratch_shapes,
         ),
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         # the double-buffered page scratch alone can exceed the 16 MB
         # default scoped-vmem budget; size the limit from the actual
         # scratch + generous op margin (v5e VMEM is 128 MB)
         compiler_params=_compiler_params(
-            vmem_limit_bytes=(
-                2 * 2 * sb * Hkv * kp * page * D * pages.dtype.itemsize
-                + 32 * 2**20
-            ),
+            vmem_limit_bytes=_scratch_bytes(sb) + 32 * 2**20,
         ),
         interpret=_interpret(),
-    )(
-        jnp.asarray(layer, jnp.int32).reshape(1), table, lens,
-        q, k_self, v_self, pages,
-    )
+    )(*operands)
 

@@ -17,6 +17,9 @@ counterpart of the reference's "Generation throughput: X tokens/s" log,
 - ``gen_spec``: vanilla vs speculative decode A/B at the 64-slot config
   on repetitive prompts — accepted-tokens/s, accept rate, vs_baseline
   (docs/performance.md "Speculative decoding")
+- ``gen_kvq``: bf16 vs int8-quantized KV pool A/B at the 64-slot config
+  plus a doubled-slot int8 run at equal pool HBM — tokens/s, vs_baseline,
+  max decode logit delta (docs/performance.md "KV quantization")
 - ``ppo``: a complete in-process async-PPO round (generate a GRPO group
   per prompt -> verify -> decoupled-PPO train step -> weight swap into
   the engine) — reward-samples/sec/chip, the north-star unit
@@ -425,6 +428,120 @@ def _bench_gen_spec(
         "vs_baseline": round(
             spec["tokens_per_s"] / max(vanilla["tokens_per_s"], 1e-9), 4
         ),
+    }
+
+
+def _kvq_logit_delta(cfg, params, prompt) -> float:
+    """Max abs decode-logit delta between a raw-dtype and an int8 KV pool
+    holding the same prompt — the quantization-noise bound the gen_kvq
+    stanza reports next to its throughput numbers. Pure model-layer probe
+    (extend_paged -> decode_step_paged), no engine state involved."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.models import transformer as tfm
+
+    plen = len(prompt) - 1
+    page = 8 if plen < 128 else 128
+    M = -(-(plen + 1) // page)
+    table = jnp.arange(M, dtype=jnp.int32)[None]
+    toks = jnp.asarray(prompt[:plen], jnp.int32)[None]
+    last = jnp.asarray([prompt[plen]], jnp.int32)
+    out = {}
+    for kd in (None, "int8"):
+        cache = tfm.PagedKVCache.empty(cfg, M, page, kv_dtype=kd)
+        cache = tfm.extend_paged(
+            params, cfg, cache, toks, table,
+            jnp.zeros((1,), jnp.int32), jnp.asarray([plen], jnp.int32),
+        )
+        logits, _, _ = tfm.decode_step_paged(
+            params, cfg, cache, last, table,
+            jnp.asarray([plen], jnp.int32), jnp.ones((1,), bool),
+            use_pallas=False,
+        )
+        out[kd] = np.asarray(jax.device_get(logits))
+    return float(np.abs(out["int8"] - out[None]).max())
+
+
+def _bench_gen_kvq(
+    peak_bw: float,
+    peak: float,
+    cfg=None,
+    B: int = 64,
+    PLEN: int = 1024,
+    D_STEPS: int = 32,
+    N_CHUNKS: int = 4,
+):
+    """A/B the int8-quantized KV pool (docs/performance.md "KV
+    quantization") at the standard 64-slot/1024-prompt generation config:
+
+    - ``bf16``: raw serving-dtype pool, the baseline;
+    - ``int8``: same slot count, pool resized to the SAME page-array HBM
+      (itemsize-ratio x pages) — the pure bandwidth win: every decode step
+      reads half the KV bytes;
+    - ``int8_2x_slots``: twice the slots at that same pool HBM — the
+      capacity win (what quantization buys a serving fleet at fixed HBM).
+
+    Greedy sampling so every arm decodes the same workload; reports
+    tokens/s per arm, ``vs_baseline`` = int8/bf16 tokens/s at equal slots,
+    and the max decode logit delta from a teacher-forced probe. The small
+    ``cfg``/shape overrides exist so tests can smoke the stanza on CPU."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.gen.engine import GenerationEngine, GenRequest
+    from areal_tpu.models import transformer as tfm
+
+    cfg = cfg or _gen_model_cfg()
+    rng = np.random.default_rng(0)
+    params = tfm.init_params(cfg, jax.random.key(0), dtype=cfg.dtype)
+    page = min(128, max(8, PLEN // 4))
+    ratio = jnp.dtype(cfg.dtype).itemsize  # int8 pages per serving-dtype page
+    prompts = [
+        [int(x) for x in rng.integers(1, min(50000, cfg.vocab_size), PLEN)]
+        for _ in range(2 * B)
+    ]
+
+    def run_arm(tag, kv_dtype, slots, n_pages):
+        eng = GenerationEngine(
+            cfg, params, max_slots=slots, max_seqlen=2 * PLEN,
+            max_new_tokens_cap=PLEN, page_size=page,
+            enable_prefix_cache=False, admit_chunk_tokens=min(1024, PLEN),
+            kv_dtype=kv_dtype, n_pages=n_pages,
+        )
+        for i in range(slots):
+            eng.submit(GenRequest(
+                rid=f"{tag}{i}", input_ids=prompts[i],
+                max_new_tokens=PLEN, greedy=True,
+            ))
+        eng.step(decode_steps=1)           # admission + first decode
+        eng.step(decode_steps=D_STEPS)     # warm the chunk program
+        n0 = int(np.asarray(jax.device_get(eng.state.n_gen)).sum())
+        t0 = time.perf_counter()
+        for _ in range(N_CHUNKS):
+            eng.step(decode_steps=D_STEPS)
+        n1 = int(np.asarray(jax.device_get(eng.state.n_gen)).sum())  # drain
+        dt = time.perf_counter() - t0
+        pool_bytes = eng.kv_pool_bytes()
+        base_pages = eng.n_pages
+        eng.pause()
+        _free_engine(eng)
+        return (n1 - n0) / dt, pool_bytes, base_pages
+
+    bf16_tok_s, bf16_bytes, base_pages = run_arm("b", None, B, None)
+    int8_tok_s, int8_bytes, _ = run_arm("q", "int8", B, base_pages * ratio)
+    int8_2x_tok_s, _, _ = run_arm("d", "int8", 2 * B, base_pages * ratio)
+    return {
+        "bf16_tokens_per_s": round(bf16_tok_s, 1),
+        "int8_tokens_per_s": round(int8_tok_s, 1),
+        "int8_2x_slots_tokens_per_s": round(int8_2x_tok_s, 1),
+        "vs_baseline": round(int8_tok_s / max(bf16_tok_s, 1e-9), 4),
+        "max_logit_delta": round(
+            _kvq_logit_delta(cfg, params, prompts[0][: min(PLEN, 128)]), 5
+        ),
+        "slots": B, "slots_2x": 2 * B, "prompt_len": PLEN,
+        "bf16_pool_bytes": int(bf16_bytes),
+        "int8_pool_bytes": int(int8_bytes),
     }
 
 
@@ -1133,6 +1250,7 @@ def main():
         ("fwd_pipe", lambda: _bench_fwd_pipe(peak), True),
         ("gen_pipe", lambda: _bench_gen(peak_bw, peak, pipelined=True), True),
         ("gen_spec", lambda: _bench_gen_spec(peak_bw, peak), True),
+        ("gen_kvq", lambda: _bench_gen_kvq(peak_bw, peak), True),
         ("bwd_pipe",
          lambda: _bench_bwd_pipe(cfg_small, cfg_32k, peak), True),
         ("guard", lambda: _bench_guard(peak), True),

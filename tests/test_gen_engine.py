@@ -223,6 +223,84 @@ class TestWarpContract:
                 atol=1e-6,
             )
 
+    def test_warp_rows_matches_full_warp(self, rng):
+        """Per-slot warp narrowing (``warp_rows``): a mixed batch where
+        only some slots warp must sample exactly what the full-batch warp
+        samples — greedy/plain slots get the warp=False arm, warping
+        slots their warped rows, padding indices drop."""
+        from areal_tpu.gen.sampling import SamplingParams, sample_tokens
+
+        B, V = 6, 64
+        logits = jnp.asarray(rng.normal(size=(B, V)), jnp.float32)
+        sp = SamplingParams(
+            temperature=jnp.asarray([0.0, 1.0, 0.7, 0.0, 1.3, 1.0]),
+            top_p=jnp.asarray([1.0, 0.9, 1.0, 1.0, 0.8, 1.0]),
+            top_k=jnp.asarray(
+                [1 << 30, 1 << 30, 5, 1 << 30, 7, 1 << 30], jnp.int32
+            ),
+        )
+        rows = jnp.asarray([1, 2, 4, B], jnp.int32)  # B = padding -> drop
+        key = jax.random.key(7)
+        t1, lp1 = sample_tokens(key, logits, sp, warp=True)
+        t2, lp2 = sample_tokens(key, logits, sp, warp=True, warp_rows=rows)
+        assert t1.tolist() == t2.tolist()
+        np.testing.assert_allclose(np.asarray(lp1), np.asarray(lp2),
+                                   atol=1e-5)
+
+    def test_warp_rows_multi_matches_full(self, rng):
+        """The spec-verify [B, C, V] shape through warp_logits_rows."""
+        from areal_tpu.gen.sampling import (
+            SamplingParams, warp_logits_multi, warp_logits_rows,
+        )
+
+        B, C, V = 4, 3, 64
+        logits = jnp.asarray(rng.normal(size=(B, C, V)), jnp.float32)
+        sp = SamplingParams(
+            temperature=jnp.asarray([1.0, 0.5, 1.2, 0.9]),
+            top_p=jnp.asarray([0.9, 1.0, 0.5, 0.8]),
+            top_k=jnp.asarray([5, 1 << 30, 20, 3], jnp.int32),
+        )
+        rows = jnp.asarray([0, 2, 3, B], jnp.int32)
+        full = warp_logits_multi(logits, sp)
+        sparse = warp_logits_rows(logits, sp, rows)
+        for b in (0, 2, 3):
+            np.testing.assert_allclose(
+                np.asarray(sparse[b]), np.asarray(full[b]), atol=1e-6
+            )
+
+    def test_mixed_batch_one_warper_engine_exactness(self, params, rng):
+        """Engine-level pin: a batch of greedy requests plus ONE top-p
+        request must give the greedy slots exactly the tokens an all-greedy
+        engine gives them — the warping request no longer changes (or
+        slows) anyone else's path."""
+        prompts = [
+            [int(x) for x in rng.integers(1, 128, n)] for n in (5, 9, 7)
+        ]
+        ref = GenerationEngine(CFG, params, max_slots=4, max_seqlen=64,
+                               seed=0)
+        for i, p in enumerate(prompts):
+            ref.submit(GenRequest(
+                rid=f"g{i}", input_ids=p, max_new_tokens=8, greedy=True,
+            ))
+        want = {o.rid: o.output_ids
+                for o in ref.run_until_done(decode_steps=3)}
+        eng = GenerationEngine(CFG, params, max_slots=4, max_seqlen=64,
+                               seed=0)
+        for i, p in enumerate(prompts):
+            eng.submit(GenRequest(
+                rid=f"g{i}", input_ids=p, max_new_tokens=8, greedy=True,
+            ))
+        eng.submit(GenRequest(
+            rid="warp", input_ids=prompts[0], max_new_tokens=8,
+            temperature=1.0, top_p=0.9,
+        ))
+        got = {o.rid: o.output_ids
+               for o in eng.run_until_done(decode_steps=3)}
+        for rid, ids in want.items():
+            assert got[rid] == ids, rid
+        # the chunk specialized on the warp bucket, not a batch-wide bool
+        assert any(k[2] == 1 for k in eng._jit_chunk)  # bucket-1 program
+
 
 # --------------------------------------------------------------------------- #
 # Tensor-parallel serving (VERDICT r2 #1): engine over a `model` mesh
