@@ -6,7 +6,14 @@ PYTHON ?= python
 # Diff base for lint-fast: any git ref (branch, SHA, HEAD~1, ...).
 SINCE ?= HEAD
 
-.PHONY: lint lint-fast lint-rules
+.PHONY: lint lint-fast lint-rules serve
+
+# Local serving stack (docs/serving.md): one generation engine + gen
+# server + the OpenAI-compatible gateway in a single process. Pass a
+# checkpoint with ARGS="--model-path /path/to/hf_ckpt --port 8000";
+# without one it serves a tiny random-weight model (smoke-test mode).
+serve:
+	$(PYTHON) -m areal_tpu.gateway $(ARGS)
 
 # Full whole-program scan: areal_tpu/ tools/ tests/, project rules on,
 # baseline applied. This is what tier-1's TestFullTreeGate enforces.

@@ -175,6 +175,11 @@ def gen_server_main(cfg, server_idx: int):
                 # string and lives on /metrics_json instead
                 "kv_pool_bytes": float(engine.kv_pool_bytes()),
                 "kv_pool_occupancy": engine.kv_pool_occupancy(),
+                # admission/autoscale signal: excludes evictable
+                # prefix-cache-only pages
+                "kv_pool_demand_occupancy": (
+                    engine.kv_pool_demand_occupancy()
+                ),
                 "n_pages_free": float(engine.pool.n_free),
             },
         ).maybe_start()
@@ -249,6 +254,210 @@ def gserver_manager_main(cfg):
             await asyncio.sleep(1.0)
         tele.stop()
         hb.stop()
+
+    asyncio.run(main())
+
+
+def gateway_main(cfg):
+    """Serving-gateway worker (docs/serving.md): OpenAI-compatible API +
+    continuous-batching scheduler over the discovered gen servers, with
+    an optional autoscaler resizing the ROUTED subset live (and mirroring
+    every add/remove to the gserver manager so RL sticky routing follows)."""
+    import asyncio
+
+    _setup_worker_env(cfg, "cpu")
+    from areal_tpu.base import constants as _constants
+    from areal_tpu.base import name_resolve, names, network
+    from areal_tpu.gateway.api import (
+        ByteFallbackCodec,
+        GatewayConfig,
+        GatewayServer,
+        HFTokenizerCodec,
+        serve_gateway,
+    )
+    from areal_tpu.gateway.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        ScaleSignals,
+    )
+    from areal_tpu.gateway.qos import TenantSpec
+    from areal_tpu.gateway.scheduler import ContinuousBatchScheduler
+    from areal_tpu.system import telemetry
+
+    gspec = cfg.gateway
+
+    async def main():
+        from areal_tpu.system.worker_base import (
+            ExperimentStatusWatch,
+            Heartbeat,
+            TelemetryExporter,
+        )
+
+        # discovered fleet = scale-out ceiling; routed set starts full
+        all_urls = []
+        for i in range(cfg.gen.n_servers):
+            name_resolve.wait(
+                names.gen_server(cfg.experiment_name, cfg.trial_name, i),
+                timeout=300,
+            )
+            all_urls.append(
+                name_resolve.get(
+                    names.gen_server(cfg.experiment_name, cfg.trial_name, i)
+                )
+            )
+        # spec value 0 defers to the env knobs — for NAMED tenants too,
+        # or listing a tenant in tenant_weights would silently strip its
+        # rate limit while the anonymous tenant kept one
+        rate = gspec.rate_tokens_per_s or _constants.gateway_rate_tps()
+        burst = gspec.burst_tokens or _constants.gateway_burst()
+        tenants = {
+            name: TenantSpec(
+                name=name, weight=w,
+                rate_tokens_per_s=rate, burst_tokens=burst,
+            )
+            for name, w in gspec.tenant_weights.items()
+        }
+        scheduler = ContinuousBatchScheduler(
+            list(all_urls),
+            tenants,
+            default_tenant=TenantSpec(
+                name=gspec.default_tenant,
+                rate_tokens_per_s=rate,
+                burst_tokens=burst,
+            ),
+            max_queue=gspec.max_queue if gspec.max_queue >= 0 else None,
+            admit_occupancy=(
+                gspec.admit_occupancy if gspec.admit_occupancy >= 0 else None
+            ),
+        )
+        await scheduler.start()
+        tok_path = cfg.tokenizer_path or cfg.actor.path
+        codec = (
+            HFTokenizerCodec(tok_path) if tok_path
+            else ByteFallbackCodec(cfg.actor.model_config().vocab_size)
+        )
+        gw = GatewayServer(
+            scheduler, codec,
+            GatewayConfig(
+                model_id=cfg.experiment_name,
+                default_tenant=gspec.default_tenant,
+                api_keys=dict(gspec.api_keys),
+                require_api_key=gspec.require_api_key,
+                max_tokens_cap=cfg.gen.max_new_tokens_cap,
+            ),
+        )
+        port = gspec.port or _constants.gateway_port() or network.find_free_port()
+        runner = await serve_gateway(gw, "127.0.0.1", port)
+        name_resolve.add(
+            names.gateway(cfg.experiment_name, cfg.trial_name),
+            f"http://127.0.0.1:{port}",
+            replace=True,
+        )
+
+        autoscaler_task = None
+        if gspec.autoscale:
+            mgr_url = None
+
+            async def _sync_manager(url: str, add: bool):
+                nonlocal mgr_url
+                from areal_tpu.gen.client import GenAPIClient
+
+                if mgr_url is None:
+                    try:
+                        mgr_url = name_resolve.get(
+                            names.gserver_manager(
+                                cfg.experiment_name, cfg.trial_name
+                            )
+                        )
+                    except name_resolve.NameEntryNotFoundError:
+                        return
+                try:
+                    async with GenAPIClient(timeout=10.0) as c:
+                        await c.post_json(
+                            mgr_url,
+                            "/add_server" if add else "/remove_server",
+                            {"url": url}, op="autoscale",
+                        )
+                except Exception:
+                    logger.exception("manager routed-set sync failed")
+
+            def fetch_signals():
+                scalars = telemetry.collect_fleet_scalars(
+                    cfg.experiment_name, cfg.trial_name
+                ) or {}
+                routed = scheduler.server_urls()
+                # occupancy averages over the ROUTED set: idle unrouted
+                # servers report ~0 and would dilute routed-pool
+                # saturation below the grow threshold
+                sig = ScaleSignals.from_fleet_scalars(
+                    scalars, routed=len(routed),
+                    n_gen_servers=max(len(routed), 1),
+                )
+                # the gateway's own queue is live, not telemetry-lagged
+                sig.queue_depth = float(scheduler.queue_depth())
+                return sig
+
+            def grow(n: int) -> int:
+                routed = scheduler.server_urls()
+                spare = [u for u in all_urls if u not in routed][:n]
+                if spare:
+                    scheduler.set_servers(routed + spare)
+                    for u in spare:
+                        t = asyncio.get_event_loop().create_task(
+                            _sync_manager(u, add=True)
+                        )
+                        _bg_tasks.add(t)
+                        t.add_done_callback(_bg_tasks.discard)
+                return len(spare)
+
+            def shrink(n: int) -> int:
+                routed = scheduler.server_urls()
+                n = min(n, max(len(routed) - gspec.min_servers, 0))
+                victims = routed[len(routed) - n:] if n else []
+                if victims:
+                    scheduler.set_servers(
+                        [u for u in routed if u not in victims]
+                    )
+                    for u in victims:
+                        t = asyncio.get_event_loop().create_task(
+                            _sync_manager(u, add=False)
+                        )
+                        _bg_tasks.add(t)
+                        t.add_done_callback(_bg_tasks.discard)
+                return len(victims)
+
+            _bg_tasks: set = set()
+            autoscaler = Autoscaler(
+                AutoscalerConfig(
+                    min_servers=gspec.min_servers,
+                    max_servers=cfg.gen.n_servers,
+                    interval_s=gspec.autoscale_interval_s,
+                    cooldown_s=gspec.autoscale_cooldown_s,
+                ),
+                fetch_signals, grow, shrink,
+            )
+            autoscaler_task = asyncio.get_event_loop().create_task(
+                autoscaler.run()
+            )
+
+        watch = ExperimentStatusWatch(cfg.experiment_name, cfg.trial_name)
+        hb = Heartbeat(cfg.experiment_name, cfg.trial_name, "gateway").start()
+        tele = TelemetryExporter(
+            cfg.experiment_name, cfg.trial_name, "gateway", "gateway",
+            gauges_fn=lambda: {
+                "gw_queue_depth": float(scheduler.queue_depth()),
+                "gw_inflight": float(scheduler.inflight()),
+                "gw_routed_servers": float(len(scheduler.server_urls())),
+            },
+        ).maybe_start()
+        while watch.alive():
+            await asyncio.sleep(1.0)
+        tele.stop()
+        hb.stop()
+        if autoscaler_task is not None:
+            autoscaler_task.cancel()
+        await scheduler.stop()
+        await runner.cleanup()
 
     asyncio.run(main())
 
@@ -480,6 +689,7 @@ def evaluator_main(cfg, stop_event=None):
 ROLE_MAINS = {
     "gen_server": gen_server_main,
     "gserver_manager": gserver_manager_main,
+    "gateway": gateway_main,
     "rollout_worker": rollout_worker_main,
     "trainer": trainer_main,
     "evaluator": evaluator_main,
@@ -543,6 +753,12 @@ def _spawn_all(cfg) -> Dict[str, mp.Process]:
         ctx.Process(target=gserver_manager_main, args=(cfg,), daemon=True),
         True,
     )
+    if getattr(cfg, "gateway", None) is not None and cfg.gateway.enabled:
+        start(
+            "gateway",
+            ctx.Process(target=gateway_main, args=(cfg,), daemon=True),
+            True,
+        )
     for i in range(cfg.rollout.n_workers):
         start(
             f"rollout_worker/{i}",

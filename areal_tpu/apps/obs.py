@@ -80,6 +80,7 @@ _ROLE_HEADLINE = {
     "rollout": ("pushed", "rollout/pushed"),
     "gen_server": ("served", "gen/served"),
     "manager": ("scheduled", "manager/schedule_requests"),
+    "gateway": ("completed", "gw/completed"),
 }
 
 

@@ -39,6 +39,12 @@ SPEC_DECODE_ENV = "AREAL_SPEC_DECODE"   # draft-and-verify decode chunks
 SPEC_K_ENV = "AREAL_SPEC_K"             # draft tokens per slot per spec step
 # KV-pool quantization (docs/performance.md "KV quantization").
 KV_DTYPE_ENV = "AREAL_KV_DTYPE"         # paged KV pool storage dtype
+# Serving gateway (docs/serving.md): OpenAI-compatible frontend knobs.
+GATEWAY_PORT_ENV = "AREAL_GATEWAY_PORT"          # 0 = pick a free port
+GATEWAY_RATE_TPS_ENV = "AREAL_GW_RATE_TPS"       # per-tenant token bucket
+GATEWAY_BURST_ENV = "AREAL_GW_BURST"             # token-bucket burst size
+GATEWAY_MAX_QUEUE_ENV = "AREAL_GW_MAX_QUEUE"     # gateway queue cap
+GATEWAY_ADMIT_OCC_ENV = "AREAL_GW_ADMIT_OCCUPANCY"  # KV-pool admit gate
 
 
 # --------------------------------------------------------------------- #
@@ -222,6 +228,40 @@ def kv_dtype() -> Optional[str]:
     return None
 
 
+def gateway_port() -> int:
+    """``AREAL_GATEWAY_PORT`` (default 0 = pick a free port): TCP port the
+    OpenAI-compatible serving gateway binds (docs/serving.md)."""
+    return env_int(GATEWAY_PORT_ENV, 0)
+
+
+def gateway_rate_tps() -> float:
+    """``AREAL_GW_RATE_TPS`` (default 0 = unlimited): default per-tenant
+    token-bucket refill rate in tokens/second (prompt + budgeted new
+    tokens are charged at admission; unused budget is refunded at
+    completion). Per-tenant overrides come from the gateway config."""
+    return env_float(GATEWAY_RATE_TPS_ENV, 0.0)
+
+
+def gateway_burst() -> float:
+    """``AREAL_GW_BURST`` (default 0 = 4x the refill rate, itself 0 =
+    unlimited): default per-tenant token-bucket burst capacity."""
+    return env_float(GATEWAY_BURST_ENV, 0.0)
+
+
+def gateway_max_queue() -> int:
+    """``AREAL_GW_MAX_QUEUE`` (default 256): gateway-wide cap on queued
+    (not yet dispatched) requests; past it new requests get 429."""
+    return env_int(GATEWAY_MAX_QUEUE_ENV, 256)
+
+
+def gateway_admit_occupancy() -> float:
+    """``AREAL_GW_ADMIT_OCCUPANCY`` (default 0.95): KV-pool occupancy
+    fraction past which the gateway stops dispatching to a server (the
+    request waits in the fair queue instead of deep-queuing behind a
+    full pool)."""
+    return env_float(GATEWAY_ADMIT_OCC_ENV, 0.95)
+
+
 def native_disabled() -> bool:
     """``AREAL_DISABLE_NATIVE``: skip building/loading the C packer
     extension (pure-python fallback)."""
@@ -403,6 +443,11 @@ def get_env_vars(**extra) -> dict:
         WATCHDOG_TIMEOUT_ENV,
         WATCHDOG_ABORT_ENV,
         TELEMETRY_EXPORT_ENV,
+        GATEWAY_PORT_ENV,
+        GATEWAY_RATE_TPS_ENV,
+        GATEWAY_BURST_ENV,
+        GATEWAY_MAX_QUEUE_ENV,
+        GATEWAY_ADMIT_OCC_ENV,
         "JAX_PLATFORMS",
         "XLA_FLAGS",
         "TPU_VISIBLE_DEVICES",

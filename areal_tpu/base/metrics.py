@@ -401,6 +401,28 @@ GEN_SPEC_ACCEPT_LEN = "gen/spec_accept_len"
 GEN_KVQ_PAGES_QUANTIZED = "gen/kvq_pages_quantized"
 GEN_KV_POOL_OCCUPANCY = "gen/kv_pool_occupancy"
 
+# --------------------------------------------------------------------- #
+# Serving-gateway namespace (``gw/``, docs/serving.md): every admission /
+# QoS / scaling decision the OpenAI-compatible frontend makes. The queue
+# histograms are the autoscaler's primary latency signals; the per-tenant
+# token family (``gw/tenant_tokens/<tenant>``) is dynamic and therefore
+# registered by its prefix constant only (same exemption as
+# ``faults/<point>`` — it cannot be enumerated statically).
+# --------------------------------------------------------------------- #
+
+GW_REQUESTS = "gw/requests"               # API requests past validation
+GW_ADMITTED = "gw/admitted"               # requests dispatched into a slot
+GW_REJECTED_429 = "gw/rejected_429"       # rate-limit / queue-full rejections
+GW_REJECTED_4XX = "gw/rejected_4xx"       # validation rejections (400/401)
+GW_COMPLETED = "gw/completed"             # requests finished (any reason)
+GW_STREAMED_TOKENS = "gw/streamed_tokens" # tokens emitted to API clients
+GW_RESUBMITS = "gw/resubmits"             # interrupted gens resumed transparently
+GW_QUEUE_WAIT_S = "gw/queue_wait_s"       # histogram: enqueue -> dispatch
+GW_TTFT_S = "gw/ttft_s"                   # histogram: enqueue -> first token
+GW_SCALE_UPS = "gw/scale_ups"             # autoscaler grew the routed set
+GW_SCALE_DOWNS = "gw/scale_downs"         # autoscaler shrank the routed set
+GW_TENANT_TOKENS_PREFIX = "gw/tenant_tokens/"  # + <tenant>: per-tenant sums
+
 # Fraction edges for the pool-occupancy histogram: occupancy lives in
 # [0, 1] and the log-spaced duration edges would put the whole range into
 # two buckets; 0.9+ gets finer edges because that is where admission
@@ -430,6 +452,8 @@ METRIC_KINDS: Dict[str, str] = {
     REWARD_LAG_S: KIND_HISTOGRAM,
     GEN_SPEC_ACCEPT_LEN: KIND_HISTOGRAM,
     GEN_KV_POOL_OCCUPANCY: KIND_HISTOGRAM,
+    GW_QUEUE_WAIT_S: KIND_HISTOGRAM,
+    GW_TTFT_S: KIND_HISTOGRAM,
 }
 
 # Non-default bucket edges per histogram key (default: the log-spaced

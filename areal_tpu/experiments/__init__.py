@@ -9,6 +9,7 @@ from areal_tpu.experiments.config import (  # noqa: F401
     AsyncPPOExperiment,
     DatasetSpec,
     EvaluatorSpec,
+    GatewaySpec,
     GenFleetSpec,
     ModelSpec,
     RolloutSpec,

@@ -96,6 +96,33 @@ class GenFleetSpec:
 
 
 @dataclasses.dataclass
+class GatewaySpec:
+    """OpenAI-compatible serving gateway over the gen fleet
+    (docs/serving.md): continuous batching, per-tenant QoS, autoscaling."""
+
+    enabled: bool = False
+    # 0 -> AREAL_GATEWAY_PORT (itself 0 -> a free port)
+    port: int = 0
+    default_tenant: str = "anonymous"
+    require_api_key: bool = False
+    api_keys: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # per-tenant WFQ weights (unlisted tenants weigh 1.0)
+    tenant_weights: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # 0 -> AREAL_GW_RATE_TPS / AREAL_GW_BURST env defaults
+    rate_tokens_per_s: float = 0.0
+    burst_tokens: float = 0.0
+    # <0 -> AREAL_GW_MAX_QUEUE / AREAL_GW_ADMIT_OCCUPANCY env defaults
+    max_queue: int = -1
+    admit_occupancy: float = -1.0
+    # autoscaler: resizes the ROUTED subset of the spawned gen servers
+    # from the fleet/ telemetry aggregate (gateway/autoscaler.py)
+    autoscale: bool = False
+    min_servers: int = 1
+    autoscale_interval_s: float = 10.0
+    autoscale_cooldown_s: float = 30.0
+
+
+@dataclasses.dataclass
 class RolloutSpec:
     n_workers: int = 1
     max_concurrent_tasks: int = 16
@@ -153,6 +180,7 @@ class AsyncPPOExperiment:
     hf_family: str = "qwen2"
     dataset: DatasetSpec = dataclasses.field(default_factory=DatasetSpec)
     gen: GenFleetSpec = dataclasses.field(default_factory=GenFleetSpec)
+    gateway: GatewaySpec = dataclasses.field(default_factory=GatewaySpec)
     rollout: RolloutSpec = dataclasses.field(default_factory=RolloutSpec)
     manager: ManagerSpec = dataclasses.field(default_factory=ManagerSpec)
     ppo: PPOHyperparameters = dataclasses.field(default_factory=PPOHyperparameters)

@@ -1,0 +1,40 @@
+"""Production serving gateway (docs/serving.md).
+
+Fronts the interruptible gen fleet with an OpenAI-compatible HTTP API
+backed by continuous batching, per-tenant QoS (token-bucket rate limits +
+weighted fair queueing), KV-occupancy admission control, and a
+telemetry-driven autoscaler that resizes the routed server set live.
+
+Modules:
+
+- ``qos``        — tenants, token buckets, weighted fair queue (pure)
+- ``scheduler``  — continuous-batching dispatch onto gen servers
+- ``api``        — /v1/completions + /v1/chat/completions (SSE + buffered)
+- ``autoscaler`` — fleet-aggregate -> scale decisions -> routed-set edits
+"""
+
+from areal_tpu.gateway.api import (  # noqa: F401
+    ByteFallbackCodec,
+    GatewayConfig,
+    GatewayServer,
+    HFTokenizerCodec,
+    TokenCodec,
+    serve_gateway,
+)
+from areal_tpu.gateway.autoscaler import (  # noqa: F401
+    Autoscaler,
+    AutoscalerConfig,
+    ScaleDecision,
+    ScaleSignals,
+    decide,
+)
+from areal_tpu.gateway.qos import (  # noqa: F401
+    TenantSpec,
+    TokenBucket,
+    WeightedFairQueue,
+)
+from areal_tpu.gateway.scheduler import (  # noqa: F401
+    ContinuousBatchScheduler,
+    GatewayRequest,
+    RateLimited,
+)

@@ -1,0 +1,540 @@
+"""OpenAI-compatible HTTP frontend for the gen fleet (docs/serving.md).
+
+An aiohttp app exposing:
+
+- ``POST /v1/completions`` — prompt as a string (via the configured
+  tokenizer codec) or a raw token-id array (the OpenAI token-array form;
+  what the tests and RL-side tooling use), SSE streaming or buffered.
+- ``POST /v1/chat/completions`` — messages rendered through a minimal
+  chat template, same streaming surface (``chat.completion.chunk``).
+- ``GET /v1/models``, ``GET /health``, ``GET /metrics_json``.
+
+Validation is answered with OpenAI-style 4xx error bodies
+(``{"error": {"message", "type", "code"}}``) before anything reaches the
+scheduler; rate-limit and queue-full answers are 429 with a
+``Retry-After`` hint. Tenancy comes from the ``Authorization: Bearer``
+key (mapped through the configured key table) or an ``X-Tenant`` header,
+defaulting to the anonymous tenant.
+
+Token<->text conversion goes through a :class:`TokenCodec`. Production
+wires an HF tokenizer (``HFTokenizerCodec``); the fallback
+:class:`ByteFallbackCodec` keeps the surface usable against random-weight
+models (tests, ``make serve`` without a checkpoint) where text is
+meaningless anyway.
+"""
+
+import asyncio
+import dataclasses
+import json
+import time
+from typing import Dict, List, Optional, Tuple
+
+from aiohttp import web
+
+from areal_tpu.base import logging
+from areal_tpu.base import metrics as metrics_mod
+from areal_tpu.gateway.scheduler import (
+    ContinuousBatchScheduler,
+    GatewayRequest,
+    RateLimited,
+)
+
+logger = logging.getLogger("areal_tpu.gateway.api")
+
+
+# --------------------------------------------------------------------- #
+# token <-> text codecs
+# --------------------------------------------------------------------- #
+
+
+class TokenCodec:
+    """encode/decode between user-facing text and engine token ids."""
+
+    def encode(self, text: str) -> List[int]:
+        raise NotImplementedError
+
+    def decode(self, ids: List[int]) -> str:
+        raise NotImplementedError
+
+
+class ByteFallbackCodec(TokenCodec):
+    """UTF-8 bytes clamped into the model vocab. Deterministic and
+    reversible for ids < 256 — a placeholder codec for random-weight
+    serving, NOT a tokenizer (documented in docs/serving.md)."""
+
+    def __init__(self, vocab_size: int):
+        self.vocab_size = vocab_size
+
+    def encode(self, text: str) -> List[int]:
+        return [b % self.vocab_size for b in text.encode("utf-8")]
+
+    def decode(self, ids: List[int]) -> str:
+        return bytes(int(t) % 256 for t in ids).decode(
+            "latin-1", errors="replace"
+        )
+
+
+class HFTokenizerCodec(TokenCodec):
+    """Wraps a HuggingFace tokenizer (lazy transformers import)."""
+
+    def __init__(self, path: str):
+        import transformers
+
+        self.tok = transformers.AutoTokenizer.from_pretrained(path)
+
+    def encode(self, text: str) -> List[int]:
+        return list(self.tok.encode(text, add_special_tokens=False))
+
+    def decode(self, ids: List[int]) -> str:
+        return self.tok.decode(ids)
+
+
+# --------------------------------------------------------------------- #
+# config + validation
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class GatewayConfig:
+    model_id: str = "areal-tpu"
+    default_tenant: str = "anonymous"
+    # API key -> tenant name; empty + require_api_key=False means every
+    # unauthenticated request rides the default (anonymous) tenant
+    api_keys: Dict[str, str] = dataclasses.field(default_factory=dict)
+    require_api_key: bool = False
+    max_tokens_cap: int = 2048
+    default_max_tokens: int = 256
+
+
+class BadRequest(Exception):
+    def __init__(self, message: str, status: int = 400, code: str = ""):
+        super().__init__(message)
+        self.status = status
+        self.code = code or "invalid_request_error"
+
+
+def _error_response(message: str, status: int, code: str, **headers):
+    metrics_mod.counters.add(
+        metrics_mod.GW_REJECTED_4XX if status != 429 else
+        metrics_mod.GW_REJECTED_429
+    )
+    return web.json_response(
+        {
+            "error": {
+                "message": message,
+                "type": "invalid_request_error" if status < 500 else
+                "server_error",
+                "code": code,
+            }
+        },
+        status=status,
+        headers={k.replace("_", "-"): str(v) for k, v in headers.items()},
+    )
+
+
+def _require(cond: bool, message: str):
+    if not cond:
+        raise BadRequest(message)
+
+
+def parse_sampling(d: dict, cfg: GatewayConfig) -> Tuple[Dict, bool]:
+    """Shared OpenAI sampling-surface validation -> (engine
+    sampling_params, stream flag)."""
+    try:
+        max_tokens = int(d.get("max_tokens", cfg.default_max_tokens))
+        temperature = float(d.get("temperature", 1.0))
+        top_p = float(d.get("top_p", 1.0))
+        n = int(d.get("n", 1))
+        stream = bool(d.get("stream", False))
+    except (TypeError, ValueError) as e:
+        raise BadRequest(f"malformed sampling parameter: {e}")
+    _require(1 <= max_tokens <= cfg.max_tokens_cap,
+             f"max_tokens must be in [1, {cfg.max_tokens_cap}]")
+    _require(temperature >= 0.0, "temperature must be >= 0")
+    _require(0.0 < top_p <= 1.0, "top_p must be in (0, 1]")
+    _require(n == 1, "n > 1 is not supported")
+    sp = {
+        "max_new_tokens": max_tokens,
+        "temperature": temperature,
+        "top_p": top_p,
+        "greedy": temperature == 0.0,
+    }
+    return sp, stream
+
+
+def encode_stop(stop, codec: TokenCodec) -> List[int]:
+    """OpenAI ``stop`` strings -> engine stop token ids. Only stops that
+    encode to exactly one token are expressible at the engine level; a
+    multi-token stop is a clear 400, not a silent ignore."""
+    if stop is None:
+        return []
+    if isinstance(stop, str):
+        stop = [stop]
+    _require(isinstance(stop, list) and len(stop) <= 4,
+             "stop must be a string or a list of up to 4 strings")
+    out = []
+    for s in stop:
+        if isinstance(s, int):
+            out.append(s)
+            continue
+        _require(isinstance(s, str), "stop entries must be strings")
+        ids = codec.encode(s)
+        _require(
+            len(ids) == 1,
+            f"stop sequence {s!r} does not map to a single token; pass "
+            "stop token ids directly via 'stop_token_ids'",
+        )
+        out.append(ids[0])
+    return out
+
+
+# --------------------------------------------------------------------- #
+# server
+# --------------------------------------------------------------------- #
+
+
+class GatewayServer:
+    def __init__(
+        self,
+        scheduler: ContinuousBatchScheduler,
+        codec: TokenCodec,
+        config: Optional[GatewayConfig] = None,
+    ):
+        self.scheduler = scheduler
+        self.codec = codec
+        self.config = config or GatewayConfig()
+        # tenants that may be named via the UNAUTHENTICATED X-Tenant
+        # header: the INITIAL configured set MINUS key-mapped tenants —
+        # an arbitrary header must neither mint a fresh full token bucket
+        # per unseen name (rate-limit bypass + unbounded state) nor
+        # impersonate a tenant whose identity is an API key (draining its
+        # budget/weight would be a cross-tenant denial of service)
+        self._known_tenants = set(scheduler.tenants) - set(
+            self.config.api_keys.values()
+        )
+        self._start_t = time.time()
+        self.app = web.Application()
+        self.app.router.add_post("/v1/completions", self._completions)
+        self.app.router.add_post(
+            "/v1/chat/completions", self._chat_completions
+        )
+        self.app.router.add_get("/v1/models", self._models)
+        self.app.router.add_get("/health", self._health)
+        self.app.router.add_get("/metrics_json", self._metrics)
+
+    # ---------------------------- tenancy ----------------------------- #
+
+    def _tenant_of(self, request: web.Request) -> str:
+        auth = request.headers.get("Authorization", "")
+        key = auth[7:].strip() if auth.startswith("Bearer ") else ""
+        if key:
+            tenant = self.config.api_keys.get(key)
+            if tenant is None and self.config.require_api_key:
+                raise BadRequest("invalid API key", status=401,
+                                 code="invalid_api_key")
+            if tenant is not None:
+                return tenant
+        if self.config.require_api_key:
+            raise BadRequest("missing API key", status=401,
+                             code="invalid_api_key")
+        header = request.headers.get("X-Tenant", "")
+        if header and header in self._known_tenants:
+            return header
+        # unknown names collapse into the default tenant (shared bucket
+        # and fair-queue lane) instead of minting unbounded tenant state
+        return self.config.default_tenant
+
+    # --------------------------- handlers ----------------------------- #
+
+    async def _json_body(self, request: web.Request) -> dict:
+        try:
+            d = await request.json()
+        except (ValueError, TypeError):
+            raise BadRequest("body is not valid JSON")
+        _require(isinstance(d, dict), "body must be a JSON object")
+        return d
+
+    def _prompt_ids(self, prompt) -> List[int]:
+        if isinstance(prompt, str):
+            _require(len(prompt) > 0, "prompt must be non-empty")
+            return self.codec.encode(prompt)
+        if isinstance(prompt, list) and prompt and all(
+            isinstance(t, int) and not isinstance(t, bool) for t in prompt
+        ):
+            return list(prompt)
+        raise BadRequest(
+            "prompt must be a non-empty string or a non-empty array of "
+            "token ids"
+        )
+
+    def _check_capacity(self, input_ids: List[int], sp: Dict) -> None:
+        """Reject prompts the backend engines cannot hold — a 400 HERE,
+        not a 502 when the dispatch hits the gen server's validator (the
+        request would also have burned queue + rate budget)."""
+        cap = self.scheduler.min_slot_capacity()
+        if cap and len(input_ids) - 1 + sp["max_new_tokens"] > cap:
+            raise BadRequest(
+                f"prompt ({len(input_ids)} tokens) + max_tokens "
+                f"({sp['max_new_tokens']}) exceeds the backend per-slot "
+                f"capacity {cap}"
+            )
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            tenant = self._tenant_of(request)
+            d = await self._json_body(request)
+            _require("prompt" in d, "missing required field 'prompt'")
+            input_ids = self._prompt_ids(d["prompt"])
+            sp, stream = parse_sampling(d, self.config)
+            stops = encode_stop(d.get("stop"), self.codec)
+            extra = d.get("stop_token_ids", [])
+            _require(
+                isinstance(extra, list)
+                and all(isinstance(t, int) for t in extra),
+                "stop_token_ids must be a list of integers",
+            )
+            stops.extend(extra)
+            if stops:
+                sp["stop_token_ids"] = stops
+            self._check_capacity(input_ids, sp)
+            req = GatewayRequest.build(tenant, input_ids, sp)
+            self.scheduler.submit(req)
+        except BadRequest as e:
+            return _error_response(str(e), e.status, e.code)
+        except RateLimited as e:
+            if e.permanent:  # can never be admitted: a client error
+                return _error_response(str(e), 400, "invalid_request_error")
+            return _error_response(
+                str(e), 429, "rate_limit_exceeded",
+                Retry_After=max(1, int(e.retry_after_s + 0.999)),
+            )
+        if stream:
+            return await self._stream_out(
+                request, req, object_name="text_completion",
+                make_delta=lambda text, first: {"text": text},
+            )
+        return await self._buffered_out(request, req, chat=False)
+
+    async def _chat_completions(
+        self, request: web.Request
+    ) -> web.StreamResponse:
+        try:
+            tenant = self._tenant_of(request)
+            d = await self._json_body(request)
+            msgs = d.get("messages")
+            _require(
+                isinstance(msgs, list) and len(msgs) > 0,
+                "messages must be a non-empty list",
+            )
+            for m in msgs:
+                _require(
+                    isinstance(m, dict)
+                    and isinstance(m.get("role"), str)
+                    and isinstance(m.get("content"), str),
+                    "each message needs string 'role' and 'content'",
+                )
+            input_ids = self.codec.encode(render_chat(msgs))
+            _require(len(input_ids) > 0, "messages rendered to an empty prompt")
+            sp, stream = parse_sampling(d, self.config)
+            stops = encode_stop(d.get("stop"), self.codec)
+            if stops:
+                sp["stop_token_ids"] = stops
+            self._check_capacity(input_ids, sp)
+            req = GatewayRequest.build(tenant, input_ids, sp)
+            self.scheduler.submit(req)
+        except BadRequest as e:
+            return _error_response(str(e), e.status, e.code)
+        except RateLimited as e:
+            if e.permanent:  # can never be admitted: a client error
+                return _error_response(str(e), 400, "invalid_request_error")
+            return _error_response(
+                str(e), 429, "rate_limit_exceeded",
+                Retry_After=max(1, int(e.retry_after_s + 0.999)),
+            )
+        if stream:
+            return await self._stream_out(
+                request, req, object_name="chat.completion.chunk",
+                make_delta=lambda text, first: {
+                    "delta": (
+                        {"role": "assistant", "content": text}
+                        if first else {"content": text}
+                    )
+                },
+            )
+        return await self._buffered_out(request, req, chat=True)
+
+    # ------------------------- output shaping ------------------------- #
+
+    def _envelope(self, req: GatewayRequest, object_name: str) -> dict:
+        return {
+            "id": f"cmpl-{req.rid}",
+            "object": object_name,
+            "created": int(self._start_t),
+            "model": self.config.model_id,
+        }
+
+    @staticmethod
+    def _finish(reason: Optional[str]) -> str:
+        return "length" if reason == "length" else "stop"
+
+    async def _next_event(self, request: web.Request, req: GatewayRequest):
+        """Next scheduler event, polling the transport while waiting: a
+        client that hangs up while its request is still QUEUED must
+        release the queue slot + token-bucket charge now, not after the
+        request ran to completion against a dead socket."""
+        while True:
+            try:
+                return await asyncio.wait_for(req.events.get(), timeout=0.5)
+            except asyncio.TimeoutError:
+                tr = request.transport
+                if tr is None or tr.is_closing():
+                    raise ConnectionResetError("client went away")
+
+    async def _stream_out(self, request, req, object_name, make_delta):
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            }
+        )
+        await resp.prepare(request)
+        first = True
+        # incremental detokenization: decode the FULL accumulated ids and
+        # emit the text suffix — per-chunk decode garbles graphemes whose
+        # tokens straddle a chunk boundary under a real (BPE) codec
+        all_ids: List[int] = []
+        emitted = 0
+        try:
+            while True:
+                ev = await self._next_event(request, req)
+                if "error" in ev:
+                    frame = {
+                        **self._envelope(req, object_name),
+                        "choices": [],
+                        "error": {"message": ev["error"],
+                                  "type": "server_error"},
+                    }
+                    await resp.write(
+                        b"data: " + json.dumps(frame).encode() + b"\n\n"
+                    )
+                    break
+                all_ids.extend(ev.get("token_ids", []))
+                full = self.codec.decode(all_ids)
+                text, emitted = full[emitted:], len(full)
+                reason = ev.get("finish_reason")
+                choice = {
+                    "index": 0,
+                    "finish_reason": self._finish(reason) if reason else None,
+                    **make_delta(text, first),
+                }
+                first = False
+                frame = {
+                    **self._envelope(req, object_name),
+                    "choices": [choice],
+                }
+                await resp.write(
+                    b"data: " + json.dumps(frame).encode() + b"\n\n"
+                )
+                if reason:
+                    break
+            await resp.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            self.scheduler.cancel(req)
+            raise
+        return resp
+
+    async def _buffered_out(
+        self, request: web.Request, req: GatewayRequest, chat: bool
+    ) -> web.Response:
+        tokens: List[int] = []
+        logprobs: List[float] = []
+        reason = None
+        try:
+            while reason is None:
+                ev = await self._next_event(request, req)
+                if "error" in ev:
+                    return web.json_response(
+                        {"error": {"message": ev["error"],
+                                   "type": "server_error"}},
+                        status=502,
+                    )
+                tokens.extend(ev.get("token_ids", []))
+                logprobs.extend(ev.get("logprobs", []))
+                reason = ev.get("finish_reason")
+        except (ConnectionResetError, asyncio.CancelledError):
+            self.scheduler.cancel(req)
+            raise
+        text = self.codec.decode(tokens)
+        if chat:
+            choice = {
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": self._finish(reason),
+            }
+            obj = "chat.completion"
+        else:
+            choice = {
+                "index": 0,
+                "text": text,
+                "logprobs": None,
+                "finish_reason": self._finish(reason),
+            }
+            obj = "text_completion"
+        return web.json_response(
+            {
+                **self._envelope(req, obj),
+                "object": obj,
+                "choices": [choice],
+                "usage": {
+                    "prompt_tokens": len(req.input_ids),
+                    "completion_tokens": len(tokens),
+                    "total_tokens": len(req.input_ids) + len(tokens),
+                },
+            }
+        )
+
+    # ------------------------- control plane -------------------------- #
+
+    async def _models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {
+                        "id": self.config.model_id,
+                        "object": "model",
+                        "created": int(self._start_t),
+                        "owned_by": "areal_tpu",
+                    }
+                ],
+            }
+        )
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "uptime_s": round(time.time() - self._start_t, 3),
+                **self.scheduler.metrics_dict(),
+            }
+        )
+
+
+def render_chat(messages: List[dict]) -> str:
+    """Minimal chat template (an HF codec could template instead; this
+    keeps the wire format stable across codecs)."""
+    parts = [f"{m['role']}: {m['content']}" for m in messages]
+    return "\n".join(parts) + "\nassistant:"
+
+
+async def serve_gateway(
+    server: GatewayServer, host: str, port: int
+) -> web.AppRunner:
+    runner = web.AppRunner(server.app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    logger.info("serving gateway on %s:%d", host, port)
+    return runner

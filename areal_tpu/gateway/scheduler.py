@@ -1,0 +1,475 @@
+"""Continuous-batching scheduler: the gateway's dispatch plane.
+
+Bridges the OpenAI-compatible API surface (``gateway/api.py``) to the gen
+fleet's chunked engines. Requests are NOT batched at request boundaries:
+each one is dispatched into an engine slot the moment one frees up (the
+engine's chunked admission protocol, ``gen/engine.py``), subject to three
+gates applied in order:
+
+1. **rate limit** — per-tenant token bucket, charged the budgeted cost
+   (prompt + ``max_tokens``) at arrival, refunded the unused budget at
+   completion. Over-budget requests answer 429 immediately.
+2. **queue** — admitted requests wait in a weighted fair queue
+   (``gateway/qos.py``): one heavy tenant's backlog cannot starve the
+   rest. A full queue answers 429.
+3. **admission** — the dispatch loop releases the queue head to a backend
+   only when one has a free slot AND its KV-pool occupancy is below the
+   admit threshold (the signal ``gen/pages.py`` exposes through
+   ``/metrics_json``); otherwise the request waits, keeping deep queues
+   in the gateway (visible, fair, cancellable) instead of inside engines.
+
+Generation streams back chunk-by-chunk over ``GenAPIClient.
+generate_stream``; a weight-update interruption is resumed transparently
+(resubmit with accumulated tokens — the partial-rollout protocol reused
+for user traffic). The routed server set is LIVE: the autoscaler
+(``gateway/autoscaler.py``) grows/shrinks it between requests.
+"""
+
+import asyncio
+import dataclasses
+import time
+import uuid
+from typing import AsyncIterator, Dict, List, Optional
+
+from areal_tpu.base import constants, logging
+from areal_tpu.base import metrics as metrics_mod
+from areal_tpu.gateway.qos import (
+    TenantSpec,
+    TokenBucket,
+    WeightedFairQueue,
+    build_buckets,
+    request_cost,
+)
+from areal_tpu.gen.client import GenAPIClient
+
+logger = logging.getLogger("areal_tpu.gateway.scheduler")
+
+
+@dataclasses.dataclass
+class GatewayRequest:
+    """One in-flight API request as the scheduler sees it."""
+
+    rid: str
+    tenant: str
+    input_ids: List[int]
+    sampling_params: Dict
+    cost: float = 0.0
+    enqueue_t: float = 0.0
+    events: asyncio.Queue = dataclasses.field(default_factory=asyncio.Queue)
+    cancelled: bool = False
+    n_generated: int = 0
+    finish_reason: Optional[str] = None
+
+    @classmethod
+    def build(cls, tenant: str, input_ids: List[int], sampling_params: Dict):
+        return cls(
+            rid=f"gw-{uuid.uuid4().hex[:16]}",
+            tenant=tenant,
+            input_ids=list(input_ids),
+            sampling_params=dict(sampling_params),
+            cost=request_cost(
+                len(input_ids), int(sampling_params.get("max_new_tokens", 256))
+            ),
+            enqueue_t=time.monotonic(),
+        )
+
+
+class RateLimited(Exception):
+    """``permanent`` marks a request that can NEVER be admitted (cost
+    above the tenant's burst capacity) — the API answers 400, not a 429
+    that would send the client into an infinite retry loop."""
+
+    def __init__(
+        self, reason: str, retry_after_s: float = 1.0,
+        permanent: bool = False,
+    ):
+        super().__init__(reason)
+        self.retry_after_s = max(retry_after_s, 0.0)
+        self.permanent = permanent
+
+
+@dataclasses.dataclass
+class ServerState:
+    """The scheduler's capacity view of one backend."""
+
+    url: str
+    max_slots: int = 1
+    inflight: int = 0
+    kv_occupancy: float = 0.0
+    healthy: bool = True
+    slot_capacity: int = 0  # per-slot token capacity (0 = not polled yet)
+
+    def free_slots(self, admit_occupancy: float) -> int:
+        if not self.healthy or self.kv_occupancy >= admit_occupancy:
+            return 0
+        return max(self.max_slots - self.inflight, 0)
+
+
+class ContinuousBatchScheduler:
+    def __init__(
+        self,
+        server_urls: List[str],
+        tenants: Optional[Dict[str, TenantSpec]] = None,
+        *,
+        default_tenant: Optional[TenantSpec] = None,
+        max_queue: Optional[int] = None,
+        admit_occupancy: Optional[float] = None,
+        metrics_poll_interval: float = 2.0,
+        client: Optional[GenAPIClient] = None,
+        clock=time.monotonic,
+    ):
+        self.tenants = dict(tenants or {})
+        self.default_tenant = default_tenant or TenantSpec(
+            name="anonymous",
+            rate_tokens_per_s=constants.gateway_rate_tps(),
+            burst_tokens=constants.gateway_burst(),
+        )
+        self.max_queue = (
+            max_queue if max_queue is not None else constants.gateway_max_queue()
+        )
+        self.admit_occupancy = (
+            admit_occupancy
+            if admit_occupancy is not None
+            else constants.gateway_admit_occupancy()
+        )
+        self.metrics_poll_interval = metrics_poll_interval
+        self._clock = clock
+        self._wfq = WeightedFairQueue()
+        self._buckets: Dict[str, TokenBucket] = build_buckets(
+            self.tenants, clock=clock
+        )
+        self._servers: Dict[str, ServerState] = {
+            u: ServerState(url=u) for u in server_urls
+        }
+        # servers removed from routing with requests still draining: their
+        # state object (the live inflight count) is restored on re-add so
+        # a grow right after a shrink cannot over-commit the engine
+        self._retired: Dict[str, ServerState] = {}
+        self._client = client
+        self._owns_client = client is None
+        self._wake = asyncio.Event()
+        self._tasks: set = set()
+        self._loops: List[asyncio.Task] = []
+        self._stopped = False
+        # completions since start, by finish reason (metrics_json view)
+        self.completed: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------ #
+    # lifecycle / live server set
+    # ------------------------------------------------------------------ #
+
+    async def start(self):
+        if self._client is None:
+            self._client = GenAPIClient(timeout=3600.0)
+            await self._client.__aenter__()
+        loop = asyncio.get_event_loop()
+        self._loops = [
+            loop.create_task(self._dispatch_loop()),
+            loop.create_task(self._poll_loop()),
+        ]
+        # one eager capacity poll so the first dispatch sees real slot
+        # counts instead of the max_slots=1 placeholder
+        await self.poll_capacity()
+        return self
+
+    async def stop(self):
+        self._stopped = True
+        for t in [*self._loops, *self._tasks]:
+            t.cancel()
+        if self._loops or self._tasks:
+            await asyncio.gather(
+                *self._loops, *self._tasks, return_exceptions=True
+            )
+        self._loops = []
+        if self._owns_client and self._client is not None:
+            await self._client.__aexit__(None, None, None)
+            self._client = None
+
+    def set_servers(self, urls: List[str]) -> None:
+        """Replace the routed server set (autoscaler hook). In-flight
+        requests on removed servers drain naturally — only NEW dispatches
+        see the new set; a re-added server resumes its draining state's
+        inflight count instead of starting a fresh (over-committing) one."""
+        for u in urls:
+            if u not in self._servers:
+                self._servers[u] = self._retired.pop(u, None) or ServerState(
+                    url=u
+                )
+        for u in list(self._servers):
+            if u not in urls:
+                s = self._servers.pop(u)
+                if s.inflight > 0:
+                    self._retired[u] = s
+        self._wake.set()
+
+    def server_urls(self) -> List[str]:
+        return list(self._servers)
+
+    # ------------------------------------------------------------------ #
+    # intake
+    # ------------------------------------------------------------------ #
+
+    def _tenant_spec(self, tenant: str) -> TenantSpec:
+        spec = self.tenants.get(tenant)
+        if spec is None:
+            spec = dataclasses.replace(self.default_tenant, name=tenant)
+            self.tenants[tenant] = spec
+        return spec
+
+    def _bucket(self, tenant: str) -> TokenBucket:
+        b = self._buckets.get(tenant)
+        if b is None:
+            spec = self._tenant_spec(tenant)
+            b = TokenBucket(
+                spec.rate_tokens_per_s, spec.resolved_burst(), clock=self._clock
+            )
+            self._buckets[tenant] = b
+        return b
+
+    def submit(self, req: GatewayRequest) -> GatewayRequest:
+        """Admit a request into the fair queue (raises RateLimited — the
+        API layer counts the 429 once, in its error response path)."""
+        if len(self._wfq) >= self.max_queue:
+            raise RateLimited(
+                f"gateway queue full ({self.max_queue} waiting)",
+                retry_after_s=1.0,
+            )
+        bucket = self._bucket(req.tenant)
+        if not bucket.unlimited and req.cost > bucket.burst:
+            raise RateLimited(
+                f"request cost {req.cost:.0f} tokens exceeds tenant "
+                f"{req.tenant!r} burst capacity {bucket.burst:.0f} — it "
+                "can never be admitted; lower max_tokens",
+                permanent=True,
+            )
+        if not bucket.try_acquire(req.cost):
+            raise RateLimited(
+                f"tenant {req.tenant!r} over its token rate limit",
+                retry_after_s=bucket.retry_after_s(req.cost),
+            )
+        spec = self._tenant_spec(req.tenant)
+        req.enqueue_t = self._clock()
+        self._wfq.push(req.tenant, req.cost, spec.weight, req)
+        metrics_mod.counters.add(metrics_mod.GW_REQUESTS)
+        self._wake.set()
+        return req
+
+    def cancel(self, req: GatewayRequest) -> None:
+        """Client went away: drop from the queue if still queued (the
+        dispatch path checks ``cancelled`` before and during streaming).
+        The full-cost refund applies only to still-queued requests — a
+        running one settles its real consumption in ``_run_request``'s
+        finally (refunding here too would double-credit the bucket)."""
+        req.cancelled = True
+        if self._wfq.drop_where(lambda it: it is req):
+            self._bucket(req.tenant).refund(req.cost)
+
+    def queue_depth(self) -> int:
+        return len(self._wfq)
+
+    def inflight(self) -> int:
+        return sum(s.inflight for s in self._servers.values())
+
+    # ------------------------------------------------------------------ #
+    # capacity view
+    # ------------------------------------------------------------------ #
+
+    async def poll_capacity(self):
+        """Refresh every backend's slot count + KV occupancy (the
+        admission signals) from /metrics_json; unreachable servers are
+        marked unhealthy until the next successful poll."""
+        servers = list(self._servers.values())
+        if not servers:
+            return
+        results = await asyncio.gather(
+            *(self._client.metrics(s.url) for s in servers),
+            return_exceptions=True,
+        )
+        for s, r in zip(servers, results):
+            if isinstance(r, BaseException):
+                s.healthy = False
+                continue
+            s.healthy = True
+            s.max_slots = int(r.get("max_slots", s.max_slots) or 1)
+            # DEMAND occupancy (excludes evictable prefix-cache pages):
+            # gating on raw occupancy livelocks against a cache-warm but
+            # otherwise idle server (falls back for older backends)
+            s.kv_occupancy = float(
+                r.get(
+                    "kv_pool_demand_occupancy",
+                    r.get("kv_pool_occupancy", 0.0),
+                )
+            )
+            s.slot_capacity = int(r.get("slot_capacity", s.slot_capacity))
+        self._wake.set()
+
+    def min_slot_capacity(self) -> int:
+        """Smallest per-slot token capacity across polled backends (0 =
+        none polled yet) — the gateway's prompt-size validation bound."""
+        caps = [s.slot_capacity for s in self._servers.values()
+                if s.slot_capacity > 0]
+        return min(caps) if caps else 0
+
+    async def _poll_loop(self):
+        while not self._stopped:
+            await asyncio.sleep(self.metrics_poll_interval)
+            try:
+                await self.poll_capacity()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("gateway capacity poll failed")
+
+    def _pick_server(self) -> Optional[ServerState]:
+        best, best_free = None, 0
+        for s in self._servers.values():
+            free = s.free_slots(self.admit_occupancy)
+            if free > best_free:
+                best, best_free = s, free
+        return best
+
+    # ------------------------------------------------------------------ #
+    # dispatch
+    # ------------------------------------------------------------------ #
+
+    async def _dispatch_loop(self):
+        while not self._stopped:
+            await self._wake.wait()
+            self._wake.clear()
+            while len(self._wfq):
+                srv = self._pick_server()
+                if srv is None:
+                    break  # a completion or capacity poll re-wakes us
+                req = self._wfq.pop()
+                if req is None or req.cancelled:
+                    continue
+                srv.inflight += 1
+                t = asyncio.get_event_loop().create_task(
+                    self._run_request(req, srv)
+                )
+                self._tasks.add(t)
+                t.add_done_callback(self._tasks.discard)
+
+    async def _run_request(self, req: GatewayRequest, srv: ServerState):
+        wait_s = self._clock() - req.enqueue_t
+        metrics_mod.counters.add(metrics_mod.GW_ADMITTED)
+        metrics_mod.counters.observe(metrics_mod.GW_QUEUE_WAIT_S, wait_s)
+        first_token = True
+        try:
+            # transparent resume across weight-update interruptions: the
+            # engine harvests partials, we resubmit prompt+partial with
+            # the remaining budget (partial-rollout protocol)
+            ids = list(req.input_ids)
+            sp = dict(req.sampling_params)
+            budget = int(sp.get("max_new_tokens", 256))
+            while True:
+                finish = None
+                agen = self._client.generate_stream(
+                    srv.url, f"{req.rid}-c{req.n_generated}", ids, sp
+                )
+                async for ev in agen:
+                    toks = ev.get("token_ids", [])
+                    if toks and first_token:
+                        first_token = False
+                        metrics_mod.counters.observe(
+                            metrics_mod.GW_TTFT_S,
+                            self._clock() - req.enqueue_t,
+                        )
+                    req.n_generated += len(toks)
+                    ids.extend(toks)
+                    finish = ev.get("finish_reason")
+                    if req.cancelled:
+                        await agen.aclose()  # closes the HTTP stream;
+                        # the gen server's disconnect path frees the slot
+                        finish = "cancelled"
+                        break
+                    if finish == "interrupted":
+                        # weight update paused the fleet mid-request: keep
+                        # the delta, strip the finish — the client must
+                        # see one seamless stream across the resubmit
+                        if toks:
+                            await req.events.put(
+                                {**ev, "finish_reason": None}
+                            )
+                    elif toks or finish:
+                        await req.events.put(ev)
+                if finish != "interrupted":
+                    req.finish_reason = finish or "error"
+                    if finish is None and not req.cancelled:
+                        # stream ended without a final frame (backend
+                        # died): the handler must not wait forever
+                        await req.events.put(
+                            {"error": "stream ended early",
+                             "finish_reason": "error"}
+                        )
+                    break
+                remaining = budget - req.n_generated
+                if remaining <= 0:
+                    req.finish_reason = "length"
+                    await req.events.put(
+                        {"token_ids": [], "logprobs": [],
+                         "finish_reason": "length"}
+                    )
+                    break
+                metrics_mod.counters.add(metrics_mod.GW_RESUBMITS)
+                sp["max_new_tokens"] = remaining
+        except asyncio.CancelledError:
+            # scheduler shutdown with traffic in flight: a handler blocked
+            # in events() must still wake (best-effort, never blocks)
+            req.finish_reason = "cancelled"
+            req.events.put_nowait(
+                {"error": "request cancelled", "finish_reason": "cancelled"}
+            )
+            raise
+        except Exception as e:
+            logger.exception("gateway request %s failed", req.rid)
+            req.finish_reason = "error"
+            await req.events.put(
+                {"error": repr(e), "finish_reason": "error"}
+            )
+        finally:
+            srv.inflight = max(srv.inflight - 1, 0)
+            if srv.inflight == 0 and self._retired.get(srv.url) is srv:
+                del self._retired[srv.url]  # fully drained
+            # refund the unused token budget; charge what actually ran
+            used = len(req.input_ids) + req.n_generated
+            self._bucket(req.tenant).refund(max(req.cost - used, 0.0))
+            metrics_mod.counters.add(metrics_mod.GW_COMPLETED)
+            metrics_mod.counters.add(
+                metrics_mod.GW_STREAMED_TOKENS, req.n_generated
+            )
+            metrics_mod.counters.add(
+                metrics_mod.GW_TENANT_TOKENS_PREFIX + req.tenant, used
+            )
+            reason = req.finish_reason or "error"
+            self.completed[reason] = self.completed.get(reason, 0) + 1
+            self._wake.set()
+
+    # ------------------------------------------------------------------ #
+    # consumption
+    # ------------------------------------------------------------------ #
+
+    async def events(self, req: GatewayRequest) -> AsyncIterator[Dict]:
+        """Yield the request's event frames until the final one."""
+        while True:
+            ev = await req.events.get()
+            yield ev
+            if ev.get("finish_reason"):
+                return
+
+    def metrics_dict(self) -> Dict:
+        return {
+            "queue_depth": self.queue_depth(),
+            "inflight": self.inflight(),
+            "servers": {
+                u: {
+                    "max_slots": s.max_slots,
+                    "inflight": s.inflight,
+                    "kv_occupancy": round(s.kv_occupancy, 4),
+                    "healthy": s.healthy,
+                }
+                for u, s in self._servers.items()
+            },
+            "completed": dict(self.completed),
+            "tenants": sorted(self.tenants),
+        }

@@ -1,7 +1,8 @@
 """Async HTTP client for the generation fleet.
 
 Counterpart of the reference's ``SGLangAPIClient``
-(``realhf/impl/model/backend/sglang.py:62``): generate + weight-update calls
+(``realhf/impl/model/backend/sglang.py:62``): generate (buffered and
+chunk-granular streaming, ``generate_stream``) + weight-update calls
 with the same retry/timeout posture, hardened for preemptible fleets:
 
 - capped exponential backoff with jitter on idempotent calls (generate and
@@ -19,6 +20,7 @@ Retries are observable via ``metrics.counters``: ``ft/client_retries``.
 
 import asyncio
 import dataclasses
+import json
 import random
 from typing import Dict, List, Optional
 
@@ -190,6 +192,60 @@ class GenAPIClient:
             version=d["version"],
         )
 
+    async def generate_stream(
+        self,
+        server_url: str,
+        rid: str,
+        input_ids: List[int],
+        sampling_params: Dict,
+    ):
+        """Chunk-granular async iterator over ``/generate_stream``: yields
+        one dict per SSE frame (``token_ids``/``logprobs`` deltas; the
+        final frame carries ``finish_reason`` + ``version``).
+
+        The retry/backoff policy applies ONLY to the pre-first-chunk
+        connect (connection refused fails in milliseconds and provably
+        never reached the engine); once the response is open, a drop
+        mid-stream surfaces to the caller — the server may have generated
+        and the slot-cancel path owns cleanup, so re-sending here would
+        double-bill the rid (same posture as ``generate``)."""
+        body = {
+            "rid": rid,
+            "input_ids": input_ids,
+            "sampling_params": sampling_params,
+        }
+        attempt = 0
+        while True:
+            try:
+                await faults.maybe_fail_async(
+                    "gen.http", url=server_url, op="generate_stream"
+                )
+                resp = await self._session.post(
+                    f"{server_url}/generate_stream", json=body
+                )
+                break
+            except Exception as e:
+                retryable = isinstance(
+                    e, CONNECTION_ERRORS
+                ) and not isinstance(e, asyncio.TimeoutError)
+                attempt += 1
+                if not retryable or attempt >= self.retry.max_attempts:
+                    raise
+                metrics_mod.counters.add(metrics_mod.FT_CLIENT_RETRIES)
+                await asyncio.sleep(self.retry.delay(attempt - 1, self._rng))
+        try:
+            resp.raise_for_status()
+            async for raw in resp.content:
+                line = raw.strip()
+                if not line.startswith(b"data:"):
+                    continue
+                payload = line[len(b"data:"):].strip()
+                if payload == b"[DONE]":
+                    break
+                yield json.loads(payload)
+        finally:
+            resp.release()
+
     async def update_weights_from_disk(
         self,
         server_url: str,
@@ -226,6 +282,18 @@ class GenAPIClient:
             "/spec_decode",
             op="spec_decode",
             json_body={"enabled": bool(enabled)},
+            timeout=self._request_timeout,
+        )
+
+    async def post_json(
+        self, server_url: str, endpoint: str, json_body: Dict,
+        op: str = "control",
+    ) -> Dict:
+        """Generic idempotent control-plane POST (manager /add_server,
+        /remove_server, ...): short per-call timeout, full retry policy —
+        the public surface for endpoints without a dedicated wrapper."""
+        return await self._request_json(
+            "POST", server_url, endpoint, op=op, json_body=json_body,
             timeout=self._request_timeout,
         )
 

@@ -437,6 +437,15 @@ class GenerationEngine:
         """Fraction of pool pages currently held (slots + prefix cache)."""
         return 1.0 - self.pool.n_free / max(self.n_pages, 1)
 
+    def kv_pool_demand_occupancy(self) -> float:
+        """Occupancy excluding prefix-cache-only pages (instantly
+        evictable under pressure) — the ADMISSION signal external gates
+        (the serving gateway) should use: raw occupancy counts cache the
+        next admission would evict, so a cache-warm idle server would
+        read as permanently full."""
+        free_eq = self.pool.n_free + self.prefix.n_reclaimable()
+        return 1.0 - free_eq / max(self.n_pages, 1)
+
     def _observe_occupancy(self):
         """Fold the current pool occupancy into the telemetry histogram —
         host arithmetic riding a chunk dispatch the engine already pays."""
@@ -466,6 +475,70 @@ class GenerationEngine:
             self.params = params
             self.version = version if version is not None else self.version + 1
             self.prefix.clear()
+
+    def partial_outputs(
+        self, rids: Optional[Sequence[str]] = None
+    ) -> Dict[str, Tuple[List[int], List[float]]]:
+        """Accumulated (tokens, logprobs) so far for running slots — the
+        per-chunk harvest the streaming endpoint emits between finishes.
+
+        ONE device pull serves every requested slot (same batching rule as
+        ``_harvest``). Callers off the event loop only: the pull blocks on
+        any in-flight chunk."""
+        with self._lock:
+            wanted = None if rids is None else set(rids)
+            sel = [
+                (b, s.rid)
+                for b, s in enumerate(self._slots)
+                if s is not None and (wanted is None or s.rid in wanted)
+            ]
+            if not sel:
+                return {}
+            host = self._pull_outputs()
+            out: Dict[str, Tuple[List[int], List[float]]] = {}
+            for b, rid in sel:
+                n = int(host["n_gen"][b])
+                out[rid] = (
+                    host["out_tokens"][b, :n].tolist(),
+                    host["out_logprobs"][b, :n].tolist(),
+                )
+            return out
+
+    def cancel(self, rid: str) -> bool:
+        """Abort a request (client disconnected): drop it from the pending
+        queue, or release its slot + pages mid-generation. Safe against
+        in-flight pipelined chunks — the harvested slot is ``None`` so
+        stale flags skip it (same guard as slot turnover), and the
+        dispatched chunk's writes to the released pages are sequenced
+        before any new occupant's prefill by the state data dependency.
+        Returns False when the rid is unknown (already finished)."""
+        with self._pending_lock:
+            for i, r in enumerate(self._pending):
+                if r.rid == rid:
+                    del self._pending[i]
+                    self._req_meta.pop(rid, None)
+                    return True
+        with self._lock:
+            for b, s in enumerate(self._slots):
+                if s is not None and s.rid == rid:
+                    self._slots[b] = None
+                    self.pool.release(s.pages)
+                    if s.borrowed:
+                        self.pool.release(s.borrowed)
+                    self._table_host[b] = 0
+                    self._lens_host[b] = 0
+                    self._warp_host[b] = False
+                    with self._pending_lock:
+                        self._req_meta.pop(rid, None)
+                    # deactivate on device so later chunks stop feeding the
+                    # slot (one small scatter; cancels are rare)
+                    self.state = dataclasses.replace(
+                        self.state,
+                        active=self.state.active.at[b].set(False),
+                        lens=self.state.lens.at[b].set(0),
+                    )
+                    return True
+        return False
 
     def pause(self) -> List[GenOutput]:
         """Stop generating and harvest all running slots as interrupted."""

@@ -146,6 +146,21 @@ class PrefixRegistry:
                 node.last_used = self._tick
             children = node.children
 
+    def n_reclaimable(self) -> int:
+        """Pages held ONLY by the registry (refcount 1) — instantly
+        evictable by the next admission under pool pressure. The
+        admission-control occupancy signal subtracts these: raw occupancy
+        counts cache an idle server would happily evict, which reads as
+        "full" to an external admission gate and livelocks it."""
+        out = 0
+        stack = list(self._children.values())
+        while stack:
+            n = stack.pop()
+            if self.pool.refcount(n.page) == 1:
+                out += 1
+            stack.extend(n.children.values())
+        return out
+
     def evict_lru(self, n_pages_needed: int) -> int:
         """Drop least-recently-used LEAVES (a node only goes after all its
         descendants) until the pool could satisfy ``n_pages_needed``. Nodes

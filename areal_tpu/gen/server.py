@@ -6,6 +6,11 @@ aiohttp app around :class:`GenerationEngine` exposing the same protocol
 surface the rollout side depends on —
 
 - ``POST /generate``: submit a request, await completion (or interruption).
+- ``POST /generate_stream``: same request shape, but the response is an
+  SSE stream of per-chunk token deltas (the engine's per-chunk harvest
+  protocol made visible over HTTP — what the serving gateway's
+  continuous-batching frontend consumes, docs/serving.md). A client
+  disconnect mid-stream cancels the request and releases its slot.
 - ``POST /update_weights_from_disk``: pause → harvest running requests as
   interrupted (clients re-submit, ≈ the SGLang ``InterruptAllReq`` patch) →
   reload params from an HF checkpoint dir → resume. Returns ``num_paused``.
@@ -37,6 +42,81 @@ from areal_tpu.gen.engine import GenerationEngine, GenOutput, GenRequest
 logger = logging.getLogger("areal_tpu.gen.server")
 
 
+class RequestValidationError(ValueError):
+    """Malformed /generate payload — answered 400, never a 500 from deep
+    inside the engine (4xx does not feed the manager's circuit breaker)."""
+
+
+def parse_generate_request(
+    d: dict, vocab_size: int, max_capacity: int, max_new_cap: int = 1 << 30
+) -> GenRequest:
+    """Validate a /generate(-_stream) JSON body into a GenRequest.
+
+    Every reachable malformation is rejected HERE with a message naming
+    the offending field; the engine only ever sees well-formed requests."""
+    if not isinstance(d, dict):
+        raise RequestValidationError("body must be a JSON object")
+    if "rid" not in d:
+        raise RequestValidationError("missing required field 'rid'")
+    ids = d.get("input_ids")
+    if not isinstance(ids, (list, tuple)) or not ids:
+        raise RequestValidationError(
+            "'input_ids' must be a non-empty list of token ids"
+        )
+    try:
+        ids = [int(t) for t in ids]
+    except (TypeError, ValueError):
+        raise RequestValidationError("'input_ids' must all be integers")
+    bad = [t for t in ids if t < 0 or t >= vocab_size]
+    if bad:
+        raise RequestValidationError(
+            f"input token {bad[0]} outside vocab [0, {vocab_size})"
+        )
+    sp = d.get("sampling_params", {})
+    if not isinstance(sp, dict):
+        raise RequestValidationError("'sampling_params' must be an object")
+    try:
+        max_new = int(sp.get("max_new_tokens", 256))
+        min_new = int(sp.get("min_new_tokens", 0))
+        temperature = float(sp.get("temperature", 1.0))
+        top_p = float(sp.get("top_p", 1.0))
+        top_k = int(sp.get("top_k", 1 << 30))
+        greedy = bool(sp.get("greedy", False))
+        stop_ids = [int(t) for t in sp.get("stop_token_ids", [])]
+    except (TypeError, ValueError) as e:
+        raise RequestValidationError(f"malformed sampling_params: {e}")
+    if max_new < 1:
+        raise RequestValidationError("max_new_tokens must be >= 1")
+    if min_new < 0 or min_new > max_new:
+        raise RequestValidationError(
+            "min_new_tokens must be in [0, max_new_tokens]"
+        )
+    if temperature < 0.0:
+        raise RequestValidationError("temperature must be >= 0")
+    if not 0.0 < top_p <= 1.0:
+        raise RequestValidationError("top_p must be in (0, 1]")
+    if top_k < 1:
+        raise RequestValidationError("top_k must be >= 1")
+    # mirror engine.submit's admissibility check (max_new is clamped to
+    # the engine's per-request cap before it counts against the slot)
+    if len(ids) - 1 + min(max_new, max_new_cap) > max_capacity:
+        raise RequestValidationError(
+            f"prompt {len(ids)} + max_new_tokens {max_new} exceeds "
+            f"per-slot capacity {max_capacity}"
+        )
+    return GenRequest(
+        rid=str(d["rid"]),
+        input_ids=ids,
+        max_new_tokens=max_new,
+        min_new_tokens=min_new,
+        temperature=temperature,
+        top_p=top_p,
+        top_k=top_k,
+        greedy=greedy,
+        stop_token_ids=stop_ids,
+    )
+
+
 class GenerationHTTPServer:
     def __init__(
         self,
@@ -44,14 +124,27 @@ class GenerationHTTPServer:
         decode_steps: int = 16,
         metrics_dump_path: Optional[str] = None,
         overlap_load: bool = True,
+        stream_interval_s: float = 0.0,
     ):
         self.engine = engine
         self.decode_steps = decode_steps
         self.metrics_dump_path = metrics_dump_path
+        # min seconds between streaming partial emissions: each emission
+        # is ONE extra all-slot device pull (~100 ms RTT on a tunneled
+        # chip) riding the serve loop — 0 emits every chunk (lowest
+        # latency, right for CPU/local), a chip deployment co-resident
+        # with RL traffic sets ~0.5 to bound the added host syncs.
+        # (Future: ride the chunk's existing flags-tuple sync instead.)
+        self.stream_interval_s = stream_interval_s
+        self._next_stream_emit = 0.0
         # stage new weights on device while decoding (2x transient param
         # residency); per-request overridable
         self.overlap_load = overlap_load
         self._futures: Dict[str, asyncio.Future] = {}
+        # streaming subscriptions: rid -> event queue + tokens already sent
+        # (the /generate_stream handler owns registration and cleanup)
+        self._stream_subs: Dict[str, asyncio.Queue] = {}
+        self._stream_sent: Dict[str, int] = {}
         self._served = 0
         self._gen_tokens = 0
         self._start = time.time()
@@ -68,6 +161,7 @@ class GenerationHTTPServer:
         self._lock = asyncio.Lock()
         self.app = web.Application()
         self.app.router.add_post("/generate", self._generate)
+        self.app.router.add_post("/generate_stream", self._generate_stream)
         self.app.router.add_post(
             "/update_weights_from_disk", self._update_weights
         )
@@ -110,6 +204,45 @@ class GenerationHTTPServer:
             fut = self._futures.pop(o.rid, None)
             if fut is not None and not fut.done():
                 fut.set_result(o)
+            q = self._stream_subs.get(o.rid)
+            if q is not None:
+                sent = self._stream_sent.get(o.rid, 0)
+                q.put_nowait(
+                    {
+                        "rid": o.rid,
+                        "token_ids": o.output_ids[sent:],
+                        "logprobs": o.output_logprobs[sent:],
+                        "finish_reason": o.finish_reason,
+                        "version": o.version,
+                    }
+                )
+
+    async def _emit_stream_partials(self, loop):
+        """Push the newest per-chunk token deltas to every live streaming
+        subscriber: ONE device pull covers all of them (engine batching
+        rule), run off the event loop because the pull can wait out an
+        in-flight chunk."""
+        rids = [r for r in self._stream_subs if r in self.engine._req_meta]
+        if not rids:
+            return
+        partials = await loop.run_in_executor(
+            None, self.engine.partial_outputs, rids
+        )
+        for rid, (toks, lps) in partials.items():
+            q = self._stream_subs.get(rid)
+            if q is None:
+                continue
+            sent = self._stream_sent.get(rid, 0)
+            if len(toks) > sent:
+                q.put_nowait(
+                    {
+                        "rid": rid,
+                        "token_ids": toks[sent:],
+                        "logprobs": lps[sent:],
+                        "finish_reason": None,
+                    }
+                )
+                self._stream_sent[rid] = len(toks)
 
     async def _run(self):
         loop = asyncio.get_event_loop()
@@ -153,28 +286,32 @@ class GenerationHTTPServer:
                 )
                 self._t_step_busy += time.monotonic() - t0
             self._resolve(outs)
+            if self._stream_subs and time.monotonic() >= self._next_stream_emit:
+                self._next_stream_emit = (
+                    time.monotonic() + self.stream_interval_s
+                )
+                await self._emit_stream_partials(loop)
 
     # ------------------------------------------------------------------ #
     # handlers
     # ------------------------------------------------------------------ #
 
-    async def _generate(self, request: web.Request) -> web.Response:
+    async def _parse_request(self, request: web.Request) -> GenRequest:
+        """Decode + validate one generate payload (raises
+        RequestValidationError with a field-naming message)."""
         try:
             d = await request.json()
-            sp = d.get("sampling_params", {})
-            req = GenRequest(
-                rid=str(d["rid"]),
-                input_ids=list(d["input_ids"]),
-                max_new_tokens=int(sp.get("max_new_tokens", 256)),
-                min_new_tokens=int(sp.get("min_new_tokens", 0)),
-                temperature=float(sp.get("temperature", 1.0)),
-                top_p=float(sp.get("top_p", 1.0)),
-                top_k=int(sp.get("top_k", 1 << 30)),
-                greedy=bool(sp.get("greedy", False)),
-                stop_token_ids=list(sp.get("stop_token_ids", [])),
-            )
-        except (KeyError, TypeError, ValueError) as e:
-            return web.json_response({"error": repr(e)}, status=400)
+        except (ValueError, TypeError):
+            raise RequestValidationError("body is not valid JSON")
+        return parse_generate_request(
+            d, self.engine.cfg.vocab_size, self.engine.S, self.engine.G
+        )
+
+    async def _generate(self, request: web.Request) -> web.Response:
+        try:
+            req = await self._parse_request(request)
+        except RequestValidationError as e:
+            return web.json_response({"error": str(e)}, status=400)
         fut = asyncio.get_event_loop().create_future()
         self._futures[req.rid] = fut
         try:
@@ -196,6 +333,83 @@ class GenerationHTTPServer:
                 "version": out.version,
             }
         )
+
+    async def _generate_stream(self, request: web.Request) -> web.StreamResponse:
+        """SSE variant of /generate: per-chunk token deltas as they are
+        harvested, a final frame carrying ``finish_reason``, then
+        ``data: [DONE]``. A client disconnect cancels the request and
+        releases its engine slot immediately."""
+        try:
+            req = await self._parse_request(request)
+        except RequestValidationError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        loop = asyncio.get_event_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        self._stream_subs[req.rid] = q
+        self._stream_sent[req.rid] = 0
+        try:
+            self.engine.submit(req)
+        except ValueError as e:
+            self._stream_subs.pop(req.rid, None)
+            self._stream_sent.pop(req.rid, None)
+            return web.json_response({"error": str(e)}, status=400)
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            }
+        )
+        finished = False
+        n_tokens = 0
+        try:
+            await resp.prepare(request)
+            try:
+                while True:
+                    try:
+                        ev = await asyncio.wait_for(q.get(), timeout=0.5)
+                    except asyncio.TimeoutError:
+                        # poll the transport so a silent disconnect
+                        # releases the slot promptly, not at next write
+                        tr = request.transport
+                        if tr is None or tr.is_closing():
+                            raise ConnectionResetError("client went away")
+                        continue
+                    await resp.write(
+                        b"data: " + json.dumps(ev).encode() + b"\n\n"
+                    )
+                    n_tokens += len(ev.get("token_ids", ()))
+                    if ev.get("finish_reason"):
+                        finished = True
+                        break
+                await resp.write(b"data: [DONE]\n\n")
+            except (ConnectionResetError, ConnectionError):
+                # client went away: not a server error — free the slot
+                # (in finally) and end the response quietly
+                logger.info("stream %s: client disconnected", req.rid)
+        finally:
+            self._stream_subs.pop(req.rid, None)
+            self._stream_sent.pop(req.rid, None)
+            if not finished:
+                # disconnect / handler cancellation mid-generation: free
+                # the slot (engine lock can wait out a chunk -> executor)
+                await self._cancel_rid(loop, req.rid)
+        metrics_mod.counters.add(metrics_mod.GEN_SERVED)
+        metrics_mod.counters.add(metrics_mod.GEN_TOKENS, n_tokens)
+        return resp
+
+    async def _cancel_rid(self, loop, rid: str):
+        """Cancel with a short retry: a rid can transiently be in neither
+        the pending queue nor a slot while _admit_pending holds it in its
+        local lookahead — cancel() returns False then, but _req_meta still
+        lists the rid, so retry until the admission lands (or the request
+        finished, which drops it from _req_meta)."""
+        for _ in range(40):
+            if await loop.run_in_executor(None, self.engine.cancel, rid):
+                return
+            if rid not in self.engine._req_meta:
+                return  # already finished/harvested
+            await asyncio.sleep(0.05)
+        logger.warning("could not cancel %s (still mid-admission?)", rid)
 
     async def _update_weights(self, request: web.Request) -> web.Response:
         d = await request.json()
@@ -315,6 +529,8 @@ class GenerationHTTPServer:
             "gen_throughput": self._gen_tokens / max(time.time() - self._start, 1e-6),
             "version": self.engine.version,
             "max_slots": self.engine.B,
+            # per-slot token capacity: the gateway's prompt-size bound
+            "slot_capacity": self.engine.S,
             # paged KV pool + prefix cache observability: bytes, dtype and
             # occupancy are the per-server HBM-headroom gauges the fleet
             # aggregator / apps/obs watch (docs/observability.md)
@@ -326,6 +542,12 @@ class GenerationHTTPServer:
             "kv_dtype": self.engine.kv_dtype,
             "kv_pool_bytes": self.engine.kv_pool_bytes(),
             "kv_pool_occupancy": round(self.engine.kv_pool_occupancy(), 4),
+            # admission signal: excludes instantly-evictable cache-only
+            # pages (the gateway gates dispatch on THIS, not the raw
+            # occupancy — a cache-warm idle server is not "full")
+            "kv_pool_demand_occupancy": round(
+                self.engine.kv_pool_demand_occupancy(), 4
+            ),
             "prefix_pages": len(self.engine.prefix),
             # phase accounting: where serving wall time went
             "uptime_s": round(time.time() - self._start, 3),

@@ -5,7 +5,12 @@ Semantics ported faithfully (they are the heart of async RL):
 
 - **Routing** (``/schedule_request``, ≈ :375-408): round-robin /
   least-requests / least-token-usage, sticky per (qid, version) so all group
-  samples of one prompt share a server and its prefix cache.
+  samples of one prompt share a server and its prefix cache. Sticky keys
+  are tenant-qualified when the caller stamps a ``tenant`` (the serving
+  gateway's multi-tenant traffic, docs/serving.md); per-tenant
+  request/token tallies ride ``/metrics_json``. The routed set is LIVE:
+  ``/add_server`` / ``/remove_server`` let the gateway's autoscaler grow
+  and shrink it (sticky qids remap off removed servers immediately).
 - **Staleness gate** (``/allocate_rollout``, ≈ :417-452 + ``is_staled:351``):
   ``expected_version = (trained_samples + running) // train_batch_size``;
   reject when ``expected_version > max_head_offpolicyness + version`` or when
@@ -83,6 +88,11 @@ class GserverManager:
         self._qid_to_server: Dict[str, str] = {}
         self._request_counts: Dict[str, int] = defaultdict(int)
         self._token_usage: Dict[str, float] = defaultdict(float)
+        # per-tenant accounting (the serving gateway stamps its traffic
+        # with a "tenant" field; RL rollout traffic has none and lands in
+        # the implicit "" bucket) — the /metrics_json QoS view
+        self._tenant_requests: Dict[str, int] = defaultdict(int)
+        self._tenant_tokens: Dict[str, float] = defaultdict(float)
         # per-qid, per-server accounting so finish_rollout can release
         # exactly what the qid's schedule_request calls accumulated (chunks ×
         # group members) — per-server because an eviction mid-rollout remaps
@@ -114,6 +124,8 @@ class GserverManager:
         self.app.router.add_post("/allocate_rollout", self._allocate_rollout)
         self.app.router.add_post("/finish_rollout", self._finish_rollout)
         self.app.router.add_post("/report_failure", self._report_failure)
+        self.app.router.add_post("/add_server", self._add_server)
+        self.app.router.add_post("/remove_server", self._remove_server)
         self.app.router.add_post("/get_model_version", self._get_version)
         self.app.router.add_get("/health", self._health)
         self.app.router.add_get("/metrics_json", self._metrics)
@@ -430,6 +442,13 @@ class GserverManager:
             # the probe loop is working on re-admission
             metrics_mod.counters.add(metrics_mod.FT_ROUTE_NO_HEALTHY)
             urls = self.server_urls
+        if not urls:
+            # routed set empty (discovery hasn't run / everything removed):
+            # a clean error the caller's retry plane understands, not a
+            # ZeroDivisionError 500
+            raise web.HTTPServiceUnavailable(
+                reason="no generation servers registered"
+            )
         if self.config.schedule_policy == "least_requests":
             return min(urls, key=lambda u: self._request_counts[u])
         if self.config.schedule_policy == "least_token_usage":
@@ -449,7 +468,13 @@ class GserverManager:
                 and self.fleet.is_healthy(prev_url)
             ):
                 return web.json_response({"url": prev_url, "version": self.version})
+            # tenant-qualified sticky key: two tenants reusing one qid
+            # string must not share a sticky assignment (or each other's
+            # prefix-cache locality)
+            tenant = str(meta.get("tenant") or "")
             qid = str(meta["qid"])
+            if tenant:
+                qid = f"{tenant}/{qid}"
             url = self._qid_to_server.get(qid)
             if url is not None and not self.fleet.is_healthy(url):
                 url = None  # sticky target was evicted: remap
@@ -461,6 +486,8 @@ class GserverManager:
             ) * meta.get("group_size", 1)
             self._request_counts[url] += 1
             self._token_usage[url] += tokens
+            self._tenant_requests[tenant] += 1
+            self._tenant_tokens[tenant] += tokens
             per_url = self._qid_sched.setdefault(qid, {})
             acct = per_url.setdefault(url, {"n": 0, "tokens": 0.0})
             acct["n"] += 1
@@ -523,6 +550,49 @@ class GserverManager:
                     self.rollout_stat.accepted += 1
             return web.json_response({"success": True})
 
+    async def _add_server(self, request: web.Request) -> web.Response:
+        """Add a server to routing live (autoscaler grow / re-route).
+        Idempotent; the new server starts closed (healthy) and is probed
+        on the normal heartbeat cadence."""
+        d = await request.json()
+        url = str(d.get("url", ""))
+        if not url:
+            return web.json_response({"error": "missing 'url'"}, status=400)
+        async with self._lock:
+            if url not in self.server_urls:
+                self.server_urls.append(url)
+            self.fleet.add_server(url)
+            return web.json_response(
+                {"success": True, "servers": list(self.server_urls)}
+            )
+
+    async def _remove_server(self, request: web.Request) -> web.Response:
+        """Remove a server from routing live (autoscaler shrink). Its
+        sticky qids are remapped on their next schedule_request; in-flight
+        generates drain on the server itself."""
+        d = await request.json()
+        url = str(d.get("url", ""))
+        async with self._lock:
+            if self.server_urls == [url]:
+                # never empty the routed set: _pick_server would have
+                # nothing to fall back on and every schedule_request
+                # would 500 with no recovery path but /add_server
+                return web.json_response(
+                    {
+                        "success": False,
+                        "error": "refusing to remove the last server",
+                        "servers": list(self.server_urls),
+                    },
+                    status=409,
+                )
+            if url in self.server_urls:
+                self.server_urls.remove(url)
+            self.fleet.remove_server(url)
+            self._remap_stickies()
+            return web.json_response(
+                {"success": True, "servers": list(self.server_urls)}
+            )
+
     async def _report_failure(self, request: web.Request) -> web.Response:
         """Passive failure observation from routing: a rollout worker's
         generate against ``url`` failed after client-level retries."""
@@ -571,6 +641,11 @@ class GserverManager:
                 "healthy_servers": self.fleet.healthy_urls(),
                 "fleet": self.fleet.snapshot(),
                 "request_counts": dict(self._request_counts),
+                # per-tenant QoS view ("" = untagged RL rollout traffic)
+                "tenant_requests": dict(self._tenant_requests),
+                "tenant_tokens": {
+                    t: round(v, 1) for t, v in self._tenant_tokens.items()
+                },
                 # off-loop: collect_fleet_scalars sweeps the name_resolve
                 # backend (an os.walk + file reads when file-backed), which
                 # must not stall the loop serving /schedule_request

@@ -545,6 +545,122 @@ def _bench_gen_kvq(
     }
 
 
+def _bench_gateway():
+    """Continuous batching through the serving gateway (docs/serving.md):
+    N concurrent streaming clients share engine slots vs the same N
+    serialized one-at-a-time. ``vs_baseline`` = concurrent/serialized
+    tokens/s — continuous batching amortizes the per-chunk dispatch +
+    params sweep across slots, so > 1.0 is the bar (CPU and chip alike).
+    Runs a small model so the section stays cheap on CPU."""
+    import asyncio
+
+    import aiohttp
+    import jax
+
+    from areal_tpu.base import network
+    from areal_tpu.gateway.api import (
+        ByteFallbackCodec,
+        GatewayConfig,
+        GatewayServer,
+        serve_gateway,
+    )
+    from areal_tpu.gateway.scheduler import ContinuousBatchScheduler
+    from areal_tpu.gen.engine import GenerationEngine
+    from areal_tpu.gen.server import serve as serve_gen
+    from areal_tpu.models import transformer as tfm
+    from areal_tpu.models.config import ModelConfig
+
+    N, MAX_NEW, PLEN = 8, 64, 32
+    cfg = ModelConfig(
+        n_layers=2, n_q_heads=4, n_kv_heads=2, head_dim=16, hidden_dim=64,
+        intermediate_dim=128, vocab_size=256, dtype="float32",
+    )
+
+    async def run():
+        eng = GenerationEngine(
+            cfg, tfm.init_params(cfg, jax.random.key(0)),
+            max_slots=N, max_seqlen=256,
+            # one admit bucket: staggered HTTP arrivals would otherwise
+            # compile fresh [n_rows] extend/commit programs mid-window
+            admit_buckets=(N,),
+        )
+        gen_port = network.find_free_port()
+        gen_runner = await serve_gen(
+            eng, "127.0.0.1", gen_port, decode_steps=8
+        )
+        sched = ContinuousBatchScheduler(
+            [f"http://127.0.0.1:{gen_port}"], max_queue=256,
+        )
+        await sched.start()
+        gw = GatewayServer(
+            sched, ByteFallbackCodec(cfg.vocab_size),
+            GatewayConfig(max_tokens_cap=1024),
+        )
+        gw_port = network.find_free_port()
+        gw_runner = await serve_gateway(gw, "127.0.0.1", gw_port)
+        url = f"http://127.0.0.1:{gw_port}/v1/completions"
+        rng = np.random.default_rng(0)
+        prompts = [
+            [int(x) for x in rng.integers(1, cfg.vocab_size, PLEN)]
+            for _ in range(N)
+        ]
+
+        async def one(session, prompt):
+            async with session.post(
+                url,
+                json={
+                    "prompt": prompt, "max_tokens": MAX_NEW,
+                    "temperature": 1.0, "stream": True,
+                },
+            ) as resp:
+                resp.raise_for_status()
+                async for raw in resp.content:
+                    if raw.strip() == b"data: [DONE]":
+                        break
+
+        timeout = aiohttp.ClientTimeout(total=600)
+        try:
+            async with aiohttp.ClientSession(timeout=timeout) as session:
+                # warmup covers BOTH arms' jit paths: one full concurrent
+                # round (admission + decode at occupancy) + one solo
+                warm = await asyncio.gather(
+                    *(one(session, p) for p in prompts),
+                    return_exceptions=True,
+                )
+                errs = [r for r in warm if isinstance(r, BaseException)]
+                if errs:
+                    raise errs[0]
+                await one(session, prompts[0])
+                t0 = time.perf_counter()
+                res = await asyncio.gather(
+                    *(one(session, p) for p in prompts),
+                    return_exceptions=True,
+                )
+                t_concurrent = time.perf_counter() - t0
+                errs = [r for r in res if isinstance(r, BaseException)]
+                if errs:
+                    raise errs[0]
+                t0 = time.perf_counter()
+                for p in prompts:
+                    await one(session, p)
+                t_serial = time.perf_counter() - t0
+        finally:
+            await sched.stop()
+            await gw_runner.cleanup()
+            await gen_runner.cleanup()
+            _free_engine(eng)
+        # no stop tokens + random weights: every request runs to MAX_NEW
+        tok = N * MAX_NEW
+        return {
+            "clients": N, "max_tokens": MAX_NEW,
+            "concurrent_tokens_per_s": round(tok / t_concurrent, 1),
+            "serialized_tokens_per_s": round(tok / t_serial, 1),
+            "vs_baseline": round(t_serial / t_concurrent, 3),
+        }
+
+    return asyncio.run(run())
+
+
 def _bench_bwd_pipe(cfg_small, cfg_32k, peak):
     """A/B the flash-bwd cross-block software pipeline (round-5 kernel
     work, default OFF until proven): re-measure the primary and ctx32k
@@ -1250,6 +1366,7 @@ def main():
         ("fwd_pipe", lambda: _bench_fwd_pipe(peak), True),
         ("gen_pipe", lambda: _bench_gen(peak_bw, peak, pipelined=True), True),
         ("gen_spec", lambda: _bench_gen_spec(peak_bw, peak), True),
+        ("gateway", lambda: _bench_gateway(), True),
         ("gen_kvq", lambda: _bench_gen_kvq(peak_bw, peak), True),
         ("bwd_pipe",
          lambda: _bench_bwd_pipe(cfg_small, cfg_32k, peak), True),

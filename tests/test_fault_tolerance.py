@@ -265,6 +265,17 @@ async def test_push_fault_requeues_without_duplicates():
         dataset=ListDataset(3), max_concurrent_tasks=2,
         pusher=pusher, manager_url=f"http://127.0.0.1:{mgr_port}",
     )
+    # pin to ONE dataset epoch: load_next_data wraps epochs and clears
+    # _used_qids, so a fast enough loop can legitimately re-roll q0 before
+    # the accepted_cnt>=3 check below fires — that duplicate is epoch-wrap
+    # behavior, not the requeue duplication this test is about
+    orig_load = worker.load_next_data
+
+    def _load_single_epoch():
+        s = orig_load()  # the epoch wrap happens INSIDE load_next_data
+        return None if worker._epoch > 0 else s
+
+    worker.load_next_data = _load_single_epoch
     rule = faults.inject("rollout.push", qid="q1", times=1)
     run = asyncio.get_event_loop().create_task(worker.run_async())
     try:
