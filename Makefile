@@ -6,7 +6,15 @@ PYTHON ?= python
 # Diff base for lint-fast: any git ref (branch, SHA, HEAD~1, ...).
 SINCE ?= HEAD
 
-.PHONY: lint lint-fast lint-rules serve
+.PHONY: lint lint-fast lint-rules serve chaos
+
+# Chaos soak, short seeded schedule (CI-sized): drive the 4-process
+# elastic CPU fault world through one seeded kill/hang + the serving-side
+# probe and assert the end-state invariants (docs/fault_tolerance.md
+# "Elastic multihost"). The long soak is `pytest -m slow
+# tests/test_elastic_multihost.py`.
+chaos:
+	$(PYTHON) -m tools.chaos --seed 1 --faults 1 --steps 8 --ckpt-every 3
 
 # Local serving stack (docs/serving.md): one generation engine + gen
 # server + the OpenAI-compatible gateway in a single process. Pass a

@@ -549,11 +549,45 @@ def trainer_main(cfg):
     _setup_worker_env(cfg, cfg.trainer_device)
     # pod-scale runs: each host's launcher sets AREAL_COORDINATOR/_NUM_
     # PROCESSES/_PROCESS_ID (or AREAL_COORDINATOR=auto on Cloud TPU) and the
-    # trainer joins the jax.distributed world before building its mesh
+    # trainer joins the jax.distributed world before building its mesh.
+    # With AREAL_ELASTIC on, the world comes up through the world-epoch
+    # protocol instead: a WorldSupervisor owns the epoch record, this rank
+    # joins it, and a rank death/hang mid-run reforms the world surgically
+    # rather than crashing it (docs/fault_tolerance.md "Elastic multihost").
+    from areal_tpu.base import constants
     from areal_tpu.parallel import multihost
 
-    multihost.maybe_initialize_from_env()
-    from areal_tpu.base import constants
+    elastic_mgr = None
+    try:
+        n_ranks = constants.multihost_num_processes()
+    except KeyError:
+        n_ranks = 0
+    if constants.elastic_enabled() and n_ranks > 1:
+        from areal_tpu.parallel import elastic as elastic_mod
+
+        multihost.enable_cpu_collectives()
+        elastic_mgr = elastic_mod.WorldEpochManager(
+            elastic_mod.ElasticConfig(
+                experiment_name=cfg.experiment_name,
+                trial_name=cfg.trial_name,
+                num_processes=n_ranks,
+                process_id=constants.multihost_process_id(),
+            )
+        )
+        elastic_mgr.join()
+    else:
+        if constants.elastic_enabled():
+            # elastic mode needs a WorldSupervisor-managed multi-rank
+            # world (AREAL_NUM_PROCESSES + a supervisor writing the
+            # world-epoch record); the single-process local launcher has
+            # neither — waiting for a record nobody writes would stall
+            # every recover attempt for the full join timeout
+            logger.warning(
+                "AREAL_ELASTIC set but no multi-rank world "
+                "(AREAL_NUM_PROCESSES absent or 1); running the standard "
+                "restart-the-world path"
+            )
+        multihost.maybe_initialize_from_env()
     from areal_tpu.base.metrics import MetricLogger
     from areal_tpu.system.stream_dataset import PullerStreamDataset
     from areal_tpu.system.trainer_worker import (
@@ -601,13 +635,28 @@ def trainer_main(cfg):
         max_head_offpolicyness=cfg.manager.max_head_offpolicyness,
     )
     recovered = False
-    if cfg.recover_mode in ("auto", "resume"):
-        # a successful recover republishes the restored model_version +
-        # training_samples itself (trainer_worker.load_recover_checkpoint)
-        recovered = worker.load_recover_checkpoint()
-    if not recovered:
-        # publish v0 weights so the fleet starts from the trainer's init
-        worker.publish_weights()
+    if elastic_mgr is not None:
+        # elastic startup (initial OR a relaunched rank rejoining a live
+        # trial): restore without publishing, then the COLLECTIVE version
+        # agreement + single publish — the exact sequence survivors run
+        # in _elastic_recover, so a relaunched rank's collectives line up
+        # with theirs and every rank adopts the same new version. The
+        # restore is UNCONDITIONAL (not gated on recover_mode): survivors
+        # always restore during a reform, and a relaunched rank skipping
+        # the (collective) restore would desynchronize the new epoch;
+        # recover_mode keeps governing only the outer restart-the-world
+        # loop.
+        recovered = worker.load_recover_checkpoint(publish=False)
+        worker._agree_version_and_publish(floor=0)
+    else:
+        if cfg.recover_mode in ("auto", "resume"):
+            # a successful recover republishes the restored model_version
+            # + training_samples itself (load_recover_checkpoint)
+            recovered = worker.load_recover_checkpoint()
+        if not recovered:
+            # publish v0 weights so the fleet starts from the trainer's
+            # init
+            worker.publish_weights()
     tele = None
     if multihost.is_main():
         tele = worker_base.TelemetryExporter(
@@ -615,13 +664,41 @@ def trainer_main(cfg):
             step_fn=lambda: worker.step,
             gauges_fn=worker.telemetry_gauges,
         ).maybe_start()
+    rc = 0
     try:
-        worker.run(shutdown=shutdown)
+        worker.run(
+            shutdown=shutdown,
+            elastic=elastic_mgr,
+            # surgical recovery rebuilds the engines from scratch (every
+            # device array died with the old world epoch) and re-restores
+            # them from the committed recover checkpoint
+            engine_factory=(
+                (lambda: _load_ppo_engines(cfg, total))
+                if elastic_mgr is not None
+                else None
+            ),
+        )
+    except Exception:
+        if elastic_mgr is None:
+            raise
+        # an elastic rank must not unwind through normal interpreter
+        # teardown (parked runtime objects LOG(FATAL) on destruction);
+        # EXIT_WORLD_FAILED tells the supervisor/launcher to escalate to
+        # restart-the-world
+        logger.exception("trainer rank failed beyond surgical recovery")
+        rc = worker_base.EXIT_WORLD_FAILED
     finally:
         if tele is not None:
             tele.stop()
     if worker.preempted:
-        sys.exit(worker_base.EXIT_PREEMPTED)
+        rc = worker_base.EXIT_PREEMPTED
+    if elastic_mgr is not None:
+        elastic_mgr.stop()
+        from areal_tpu.parallel import elastic as elastic_mod
+
+        elastic_mod.hard_exit(rc)
+    if rc:
+        sys.exit(rc)
 
 
 def evaluator_main(cfg, stop_event=None):
@@ -777,6 +854,378 @@ def _spawn_all(cfg) -> Dict[str, mp.Process]:
             cfg.evaluator.device == "cpu",
         )
     return procs
+
+
+# --------------------------------------------------------------------------- #
+# Elastic world supervision (docs/fault_tolerance.md "Elastic multihost")
+# --------------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class WorldSupervisorConfig:
+    """Config for one supervised N-rank elastic trainer world."""
+
+    experiment_name: str
+    trial_name: str
+    num_processes: int
+    # argv for rank r's process (the rank body must run the
+    # parallel/elastic.py join/reform protocol; see tools/chaos.py)
+    rank_cmd: "object" = None                 # Callable[[int], List[str]]
+    rank_env: Optional[dict] = None           # extra env for every rank
+    poll_s: float = 0.25
+    # must match the ranks' AREAL_COLLECTIVE_TIMEOUT_S: the hang-path
+    # grace is derived from it (see run())
+    collective_timeout_s: float = 120.0
+    # coalescing window for simultaneous rank exits
+    exit_grace_s: float = 1.0
+    # extra margin on top of collective_timeout_s before an alive,
+    # unreported rank is declared wedged (covers the spread between the
+    # first and last survivor reaching its collective deadline)
+    report_grace_s: float = 10.0
+    # total rank relaunches before the supervisor gives up and lets the
+    # launcher's restart-the-world loop take over
+    max_rank_restarts: int = 8
+    # bound on detect -> every rank live at the new epoch
+    reform_timeout_s: float = 300.0
+    log_dir: Optional[str] = None             # per-rank stdout capture
+
+
+class WorldSupervisor:
+    """Launcher-side owner of the elastic world-epoch protocol.
+
+    Spawns ``num_processes`` rank subprocesses, then watches two failure
+    signals, handled differently:
+
+    - **rank exit** (a dead rank): reform immediately — sweep the dead
+      ranks' name_resolve residue, bump the monotonic world epoch with a
+      fresh coordinator port, relaunch ONLY the dead ranks with the same
+      ``--process-id``. Nobody is killed: survivors detect the broken
+      world on their own (transport error or bounded-collective timeout),
+      detach, and rejoin at the new epoch in place.
+    - **timeout reports with no exit** (a wedged rank): surviving ranks'
+      bounded collectives expired and they reported; the wedged rank is
+      the alive rank that did NOT report. Because a slow-to-detect
+      survivor is indistinguishable from a wedged rank until its own
+      collective deadline passes, the supervisor waits a full
+      ``collective_timeout_s + report_grace_s`` after the first report
+      before SIGKILLing the non-reporters (a hung rank never exits on
+      its own) and reforming as above.
+
+    Counters: ``ft/rank_restarts``, ``ft/world_epochs``, and a
+    ``recovery_time_s`` histogram (detection -> every rank's lease live at
+    the new epoch). The supervisor is the ONLY writer of the world record
+    AND the host of every epoch's coordination service
+    (``elastic.host_service``) — so no rank death can close a service
+    socket that surviving clients poll, there is no leader election, and
+    a dead rank 0 recovers exactly like any other rank.
+    """
+
+    def __init__(self, cfg: WorldSupervisorConfig):
+        self.cfg = cfg
+        self.epoch = -1
+        self.procs: Dict[int, "object"] = {}
+        self.rank_restarts = 0
+        self.recovery_times: List[float] = []
+        self._log_files: Dict[int, object] = {}
+
+    # -- spawning --------------------------------------------------------
+
+    def _spawn_rank(self, rank: int):
+        import subprocess
+
+        from areal_tpu.base import constants
+
+        env = dict(os.environ)
+        env.update(constants.get_env_vars(
+            AREAL_NUM_PROCESSES=self.cfg.num_processes,
+            AREAL_PROCESS_ID=rank,
+        ))
+        # per-world overrides win over inherited/forwarded values
+        env.update(self.cfg.rank_env or {})
+        stdout = None
+        if self.cfg.log_dir:
+            os.makedirs(self.cfg.log_dir, exist_ok=True)
+            prev = self._log_files.pop(rank, None)
+            if prev is not None:
+                try:  # a relaunch must not leak the old incarnation's fd
+                    prev.close()
+                except OSError:
+                    pass
+            f = open(
+                os.path.join(self.cfg.log_dir, f"rank{rank}.log"), "ab"
+            )
+            self._log_files[rank] = f
+            stdout = f
+        self.procs[rank] = subprocess.Popen(
+            self.cfg.rank_cmd(rank), env=env,
+            stdout=stdout, stderr=subprocess.STDOUT if stdout else None,
+        )
+        logger.info(
+            "world rank %d spawned (pid %d)", rank, self.procs[rank].pid
+        )
+
+    def _write_world(self):
+        from areal_tpu.base import network
+        from areal_tpu.parallel import elastic as elastic_mod
+
+        port = network.find_free_port()
+        # the supervisor hosts the epoch's coordination service itself —
+        # see the class docstring; the service must be up before the
+        # record is visible, or a fast rank's connect would race it
+        elastic_mod.host_service(port, self.cfg.num_processes)
+        elastic_mod.write_world(
+            self.cfg.experiment_name, self.cfg.trial_name,
+            elastic_mod.WorldState(
+                epoch=self.epoch,
+                coordinator=f"127.0.0.1:{port}",
+                num_processes=self.cfg.num_processes,
+            ),
+        )
+        logger.info(
+            "world epoch %d published (coordinator port %d)",
+            self.epoch, port,
+        )
+
+    def start(self):
+        """Publish epoch 0 and spawn every rank. When the telemetry knob
+        is on, the supervisor also exports its own snapshots (role
+        ``supervisor``, step = world epoch) so ``ft/rank_restarts`` /
+        ``ft/world_epochs`` and the ``recovery_time_s`` histogram reach
+        the ``fleet/`` aggregate and the obs CLI's supervisor row."""
+        from areal_tpu.system import worker_base
+
+        self.epoch = 0
+        self._write_world()
+        for r in range(self.cfg.num_processes):
+            self._spawn_rank(r)
+        self._tele = worker_base.TelemetryExporter(
+            self.cfg.experiment_name, self.cfg.trial_name,
+            "world_supervisor", "supervisor",
+            step_fn=lambda: self.epoch,
+            gauges_fn=lambda: {
+                "world_epoch": float(self.epoch),
+                "ranks_alive": float(sum(
+                    1 for p in self.procs.values() if p.poll() is None
+                )),
+            },
+        ).maybe_start()
+        return self
+
+    # -- failure handling ------------------------------------------------
+
+    @staticmethod
+    def decide_culprits(
+        exited: Dict[int, int],
+        reports: Dict[int, dict],
+        alive: List[int],
+        wedge_deadline_passed: bool = False,
+    ) -> List[int]:
+        """Who must be relaunched: every non-zero exit always; *alive*
+        ranks without a survivor report only once the wedge deadline
+        (collective timeout + grace since the first report) has passed —
+        before that, a slow-to-detect survivor is indistinguishable from a
+        wedged rank. Clean exits (code 0) are never culprits."""
+        culprits = {r for r, code in exited.items() if code != 0}
+        if wedge_deadline_passed:
+            culprits |= {r for r in alive if r not in reports}
+        return sorted(culprits)
+
+    def _reform(
+        self,
+        culprits: List[int],
+        exited: Dict[int, int],
+        reports: Dict[int, dict],
+        detect_t: float,
+    ) -> None:
+        import signal as signal_mod
+
+        from areal_tpu.base import metrics as metrics_mod
+        from areal_tpu.parallel import elastic as elastic_mod
+
+        logger.warning(
+            "world epoch %d failed: exited=%s reports=%s -> culprits=%s",
+            self.epoch, exited, sorted(reports), culprits,
+        )
+        for r in culprits:
+            p = self.procs.get(r)
+            if p is not None and p.poll() is None:
+                logger.warning("SIGKILLing wedged rank %d (pid %d)", r, p.pid)
+                p.send_signal(signal_mod.SIGKILL)
+                p.wait()
+        # lease hygiene: dead ranks' keys must not accumulate across
+        # reformations (regression-tested in tests/test_elastic.py)
+        for r in culprits:
+            elastic_mod.sweep_rank_keys(
+                self.cfg.experiment_name, self.cfg.trial_name, r
+            )
+        elastic_mod.sweep_timeout_reports(
+            self.cfg.experiment_name, self.cfg.trial_name, self.epoch
+        )
+        self.epoch += 1
+        self._write_world()
+        for r in culprits:
+            self._spawn_rank(r)
+        self.rank_restarts += len(culprits)
+        metrics_mod.counters.add(metrics_mod.FT_RANK_RESTARTS, len(culprits))
+        metrics_mod.counters.add(metrics_mod.FT_WORLD_EPOCHS)
+        # recovery completes when every rank's lease is live at the new
+        # epoch (the world actually re-formed, not merely re-published)
+        deadline = time.monotonic() + self.cfg.reform_timeout_s
+        while time.monotonic() < deadline:
+            leases = elastic_mod.read_leases(
+                self.cfg.experiment_name, self.cfg.trial_name
+            )
+            at_epoch = [
+                r for r, d in leases.items()
+                if d.get("epoch") == self.epoch
+            ]
+            if len(at_epoch) >= self.cfg.num_processes:
+                break
+            if any(
+                p.poll() is not None and p.returncode != 0
+                for p in self.procs.values()
+            ):
+                break  # the new epoch is already failing; next loop turn
+            time.sleep(self.cfg.poll_s)
+        took = time.monotonic() - detect_t
+        self.recovery_times.append(took)
+        metrics_mod.counters.observe(metrics_mod.RECOVERY_TIME_S, took)
+        logger.warning(
+            "world reformed into epoch %d in %.1fs (%d rank restarts total)",
+            self.epoch, took, self.rank_restarts,
+        )
+
+    def run(self, timeout: Optional[float] = None) -> int:
+        """Supervise until every rank exits 0 (returns 0), the restart
+        budget is exhausted, or ``timeout`` expires (returns 1 after
+        tearing the world down)."""
+        from areal_tpu.parallel import elastic as elastic_mod
+
+        t0 = time.monotonic()
+        first_report_t: Optional[float] = None
+        try:
+            while True:
+                if timeout is not None and time.monotonic() - t0 > timeout:
+                    logger.error("world supervision timed out")
+                    return 1
+                codes = {r: p.poll() for r, p in self.procs.items()}
+                if all(c == 0 for c in codes.values()):
+                    return 0
+                exited = {
+                    r: c for r, c in codes.items()
+                    if c is not None and c != 0
+                }
+                # Two exit codes end supervision instead of triggering a
+                # relaunch: EXIT_WORLD_FAILED (a rank explicitly
+                # escalating — its reform budget is spent; a fresh budget
+                # would multiply the churn the code exists to stop) and
+                # EXIT_PREEMPTED (the slice is being reclaimed — the rank
+                # committed its recover checkpoint and relaunching it just
+                # burns the preemption grace window on churn).
+                from areal_tpu.system import worker_base as wb
+
+                gave_up = [
+                    r for r, c in exited.items()
+                    if c == wb.EXIT_WORLD_FAILED
+                ]
+                if gave_up:
+                    logger.error(
+                        "rank(s) %s exited EXIT_WORLD_FAILED: escalating "
+                        "to restart-the-world", gave_up,
+                    )
+                    return 1
+                preempted = [
+                    r for r, c in exited.items()
+                    if c == wb.EXIT_PREEMPTED
+                ]
+                if preempted:
+                    logger.warning(
+                        "rank(s) %s exited EXIT_PREEMPTED: world preempted"
+                        " — state is the committed checkpoint; not "
+                        "relaunching", preempted,
+                    )
+                    return wb.EXIT_PREEMPTED
+                reports = elastic_mod.read_timeout_reports(
+                    self.cfg.experiment_name, self.cfg.trial_name, self.epoch
+                )
+                if not exited and not reports:
+                    first_report_t = None
+                    time.sleep(self.cfg.poll_s)
+                    continue
+                if self.rank_restarts >= self.cfg.max_rank_restarts:
+                    logger.error(
+                        "rank-restart budget (%d) exhausted; giving up on "
+                        "surgical recovery", self.cfg.max_rank_restarts,
+                    )
+                    return 1
+                if exited:
+                    # dead-rank path: reform NOW, relaunch only the dead.
+                    # Survivors detect the broken world on their own
+                    # (transport error / bounded timeout) and rejoin —
+                    # nobody gets killed on a guess.
+                    detect_t = time.monotonic()
+                    time.sleep(self.cfg.exit_grace_s)  # coalesce siblings
+                    exited = {
+                        r: p.returncode
+                        for r, p in self.procs.items()
+                        if p.poll() is not None and p.returncode != 0
+                    }
+                    reports = elastic_mod.read_timeout_reports(
+                        self.cfg.experiment_name, self.cfg.trial_name,
+                        self.epoch,
+                    )
+                    alive = [
+                        r for r, p in self.procs.items() if p.poll() is None
+                    ]
+                    culprits = self.decide_culprits(
+                        exited, reports, alive, wedge_deadline_passed=False
+                    )
+                    self._reform(culprits, exited, reports, detect_t)
+                    first_report_t = None
+                    continue
+                # hang path: reports but no exit. A wedged rank can only
+                # be told apart from a slow-to-detect survivor after every
+                # survivor's own collective deadline had a chance to fire.
+                if first_report_t is None:
+                    first_report_t = time.monotonic()
+                alive = [
+                    r for r, p in self.procs.items() if p.poll() is None
+                ]
+                deadline_passed = (
+                    time.monotonic() - first_report_t
+                    > self.cfg.collective_timeout_s + self.cfg.report_grace_s
+                )
+                if deadline_passed or all(r in reports for r in alive):
+                    culprits = self.decide_culprits(
+                        {}, reports, alive,
+                        wedge_deadline_passed=deadline_passed,
+                    )
+                    self._reform(culprits, {}, reports, first_report_t)
+                    first_report_t = None
+                    continue
+                time.sleep(self.cfg.poll_s)
+        finally:
+            self.terminate()
+
+    def terminate(self):
+        tele = getattr(self, "_tele", None)
+        if tele is not None:
+            tele.stop()
+            self._tele = None
+        for r, p in self.procs.items():
+            if p.poll() is None:
+                p.kill()
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        for f in self._log_files.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._log_files.clear()
 
 
 def run_async_ppo(cfg) -> int:

@@ -81,6 +81,10 @@ _ROLE_HEADLINE = {
     "gen_server": ("served", "gen/served"),
     "manager": ("scheduled", "manager/schedule_requests"),
     "gateway": ("completed", "gw/completed"),
+    # elastic world supervisor (docs/fault_tolerance.md "Elastic
+    # multihost"): rank relaunches headline the recovery activity; its
+    # step gauge is the current world epoch
+    "supervisor": ("restarts", "ft/rank_restarts"),
 }
 
 

@@ -39,6 +39,11 @@ SPEC_DECODE_ENV = "AREAL_SPEC_DECODE"   # draft-and-verify decode chunks
 SPEC_K_ENV = "AREAL_SPEC_K"             # draft tokens per slot per spec step
 # KV-pool quantization (docs/performance.md "KV quantization").
 KV_DTYPE_ENV = "AREAL_KV_DTYPE"         # paged KV pool storage dtype
+# Elastic multihost (docs/fault_tolerance.md "Elastic multihost").
+ELASTIC_ENV = "AREAL_ELASTIC"                    # surgical rank recovery
+COLLECTIVE_TIMEOUT_ENV = "AREAL_COLLECTIVE_TIMEOUT_S"  # bounded host collectives
+ELASTIC_LEASE_INTERVAL_ENV = "AREAL_ELASTIC_LEASE_INTERVAL_S"
+ELASTIC_MAX_REFORMS_ENV = "AREAL_ELASTIC_MAX_REFORMS"  # then restart-the-world
 # Serving gateway (docs/serving.md): OpenAI-compatible frontend knobs.
 GATEWAY_PORT_ENV = "AREAL_GATEWAY_PORT"          # 0 = pick a free port
 GATEWAY_RATE_TPS_ENV = "AREAL_GW_RATE_TPS"       # per-tenant token bucket
@@ -323,6 +328,38 @@ def functioncall_dp() -> int:
     return env_int("AREAL_FUNCTIONCALL_DP", 16)
 
 
+def elastic_enabled() -> bool:
+    """``AREAL_ELASTIC`` (default off): surgical rank-level recovery for
+    the multihost trainer world — bounded host collectives, world-epoch
+    reformation on rank death/hang, supervisor-driven relaunch of only the
+    dead rank (docs/fault_tolerance.md "Elastic multihost")."""
+    return env_flag(ELASTIC_ENV, False)
+
+
+def collective_timeout_s() -> float:
+    """``AREAL_COLLECTIVE_TIMEOUT_S`` (default 120): deadline for one
+    host-side ``multihost`` collective when elastic mode is on. Past it
+    the collective raises ``CollectiveTimeoutError`` instead of hanging —
+    size it well above the slowest legitimate collective (a multihost
+    checkpoint barrier), or stragglers read as wedged ranks."""
+    return env_float(COLLECTIVE_TIMEOUT_ENV, 120.0)
+
+
+def elastic_lease_interval_s() -> float:
+    """``AREAL_ELASTIC_LEASE_INTERVAL_S`` (default 2): refresh cadence of
+    the per-rank liveness lease in name_resolve. The supervisor treats a
+    lease older than 5x this as stale (auxiliary signal only; process
+    exit and timeout reports are the authoritative ones)."""
+    return env_float(ELASTIC_LEASE_INTERVAL_ENV, 2.0)
+
+
+def elastic_max_reforms() -> int:
+    """``AREAL_ELASTIC_MAX_REFORMS`` (default 8): world reformations one
+    trainer incarnation will attempt before giving up and escalating to
+    restart-the-world (the launcher's recover_mode loop)."""
+    return env_int(ELASTIC_MAX_REFORMS_ENV, 8)
+
+
 def multihost_coordinator() -> Optional[str]:
     """``AREAL_COORDINATOR``: jax.distributed coordinator ``host:port``,
     or "auto" for Cloud-TPU topology autodetection; None -> single host."""
@@ -443,6 +480,10 @@ def get_env_vars(**extra) -> dict:
         WATCHDOG_TIMEOUT_ENV,
         WATCHDOG_ABORT_ENV,
         TELEMETRY_EXPORT_ENV,
+        ELASTIC_ENV,
+        COLLECTIVE_TIMEOUT_ENV,
+        ELASTIC_LEASE_INTERVAL_ENV,
+        ELASTIC_MAX_REFORMS_ENV,
         GATEWAY_PORT_ENV,
         GATEWAY_RATE_TPS_ENV,
         GATEWAY_BURST_ENV,

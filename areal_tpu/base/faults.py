@@ -43,6 +43,12 @@ point                 where                                      kwargs
                       the step's loss weights -> non-finite)
 ``signal.term``       GracefulShutdown.should_stop (trip:        (none)
                       simulate a delivered SIGTERM)
+``rank.kill``         elastic rank step loop (trip: the rank     step, epoch
+                      SIGKILLs itself -- hard death mid-step)
+``rank.hang``         elastic rank step loop (trip: the rank     step, epoch
+                      wedges forever without exiting)
+``collective.timeout`` elastic CollectiveGuard (trip: treat the  label
+                      in-flight collective as timed out now)
 ====================  ========================================  ==========
 """
 
@@ -69,6 +75,9 @@ FAULT_POINTS = (
     "ckpt.save",
     "train.step",
     "signal.term",
+    "rank.kill",
+    "rank.hang",
+    "collective.timeout",
 )
 
 

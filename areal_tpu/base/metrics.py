@@ -340,6 +340,16 @@ FT_STALE_DROPPED_ON_RECOVER = "ft/stale_dropped_on_recover"
 FT_PUBLISH_FAILURES = "ft/publish_failures"        # background weight publish raised
 FT_PREEMPTIONS = "ft/preemptions"                  # graceful-stop requests honored
 
+# Elastic multihost (docs/fault_tolerance.md "Elastic multihost"): the
+# surgical rank-recovery plane. rank_restarts/world_epochs are counted by
+# the WorldSupervisor; collective_timeouts by the rank that aborted a
+# bounded collective. recovery_time_s (histogram below) measures fault
+# detection -> every rank live at the new epoch.
+FT_RANK_RESTARTS = "ft/rank_restarts"              # dead/wedged ranks relaunched
+FT_WORLD_EPOCHS = "ft/world_epochs"                # world reformations committed
+FT_COLLECTIVE_TIMEOUTS = "ft/collective_timeouts"  # bounded collectives aborted
+RECOVERY_TIME_S = "recovery_time_s"                # histogram: detect -> reformed
+
 
 # --------------------------------------------------------------------- #
 # Trainer guardrail namespace (``guard/``) — the step-level anomaly plane
@@ -452,6 +462,7 @@ METRIC_KINDS: Dict[str, str] = {
     REWARD_LAG_S: KIND_HISTOGRAM,
     GEN_SPEC_ACCEPT_LEN: KIND_HISTOGRAM,
     GEN_KV_POOL_OCCUPANCY: KIND_HISTOGRAM,
+    RECOVERY_TIME_S: KIND_HISTOGRAM,
     GW_QUEUE_WAIT_S: KIND_HISTOGRAM,
     GW_TTFT_S: KIND_HISTOGRAM,
 }

@@ -79,3 +79,40 @@ def telemetry(experiment_name, trial_name, worker_name) -> str:
 
 def telemetry_root(experiment_name, trial_name) -> str:
     return f"{trial_root(experiment_name, trial_name)}/telemetry"
+
+
+# ------------------------------------------------------------------ #
+# Elastic multihost (docs/fault_tolerance.md "Elastic multihost"):
+# the world-epoch record, per-rank liveness leases, and per-epoch
+# collective-timeout reports that drive surgical rank recovery.
+# ------------------------------------------------------------------ #
+
+
+def elastic_root(experiment_name, trial_name) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/elastic"
+
+
+def elastic_world(experiment_name, trial_name) -> str:
+    """The current world-epoch record (JSON: epoch, coordinator,
+    num_processes) — written ONLY by the supervisor."""
+    return f"{elastic_root(experiment_name, trial_name)}/world"
+
+
+def elastic_lease(experiment_name, trial_name, rank: int) -> str:
+    """Per-rank liveness lease (JSON: epoch, time, pid), refreshed by the
+    rank's lease thread next to its heartbeat."""
+    return f"{elastic_root(experiment_name, trial_name)}/lease/{rank}"
+
+
+def elastic_lease_root(experiment_name, trial_name) -> str:
+    return f"{elastic_root(experiment_name, trial_name)}/lease"
+
+
+def elastic_timeout(experiment_name, trial_name, epoch: int, rank: int) -> str:
+    """A survivor's collective-timeout report for one epoch — the signal
+    the supervisor uses to tell wedged ranks from timed-out survivors."""
+    return f"{elastic_root(experiment_name, trial_name)}/timeout/{epoch}/{rank}"
+
+
+def elastic_timeout_root(experiment_name, trial_name, epoch: int) -> str:
+    return f"{elastic_root(experiment_name, trial_name)}/timeout/{epoch}"

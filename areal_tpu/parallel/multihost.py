@@ -42,6 +42,54 @@ PROCESS_ID_ENV = "AREAL_PROCESS_ID"
 _initialized = False
 
 
+def mark_initialized(flag: bool = True) -> None:
+    """Keep the module's idempotence flag truthful when the distributed
+    runtime is brought up (or re-formed) by ``parallel.elastic`` instead of
+    :func:`initialize`."""
+    global _initialized
+    _initialized = flag
+
+
+def enable_cpu_collectives() -> bool:
+    """Enable cross-process CPU collectives (gloo) — required for any
+    multi-process world on the CPU backend (the jaxlib default of ``none``
+    fails every collective with "Multiprocess computations aren't
+    implemented on the CPU backend"). Must run before the first backend
+    touch; no-op (returns False) when the option does not exist or a
+    backend already exists."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        return True
+    except Exception:  # option absent in this jax: single-process only
+        return False
+
+
+# --------------------------------------------------------------------- #
+# Collective guard hook (parallel/elastic.py). When installed, every
+# host-side collective below runs through guard.run(fn, label) — a
+# bounded-timeout, abortable execution — so a dead or wedged peer turns
+# into a CollectiveTimeoutError instead of an eternal hang. None (the
+# default) preserves the direct-call behavior bit for bit.
+# --------------------------------------------------------------------- #
+
+_collective_guard = None
+
+
+def set_collective_guard(guard) -> None:
+    global _collective_guard
+    _collective_guard = guard
+
+
+def collective_guard():
+    return _collective_guard
+
+
+def _run_collective(fn, label: str):
+    if _collective_guard is None:
+        return fn()
+    return _collective_guard.run(fn, label)
+
+
 def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -120,7 +168,10 @@ def barrier(name: str = "areal_barrier") -> None:
     if is_multihost():
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(name)
+        _run_collective(
+            lambda: multihost_utils.sync_global_devices(name),
+            f"barrier:{name}",
+        )
 
 
 def local_slice(n_global: int) -> Tuple[int, int]:
@@ -173,7 +224,12 @@ def _gather(x: np.ndarray) -> np.ndarray:
     from jax.experimental import multihost_utils
 
     # arealint: ok(deliberate host collective: numpy in, numpy out — the per-step agreement rounds train_batch budgets via collective_rounds())
-    return np.asarray(multihost_utils.process_allgather(np.asarray(x)))
+    return np.asarray(
+        _run_collective(
+            lambda: multihost_utils.process_allgather(np.asarray(x)),
+            "allgather",
+        )
+    )
 
 
 def allreduce_sum(x: np.ndarray) -> np.ndarray:
@@ -272,7 +328,15 @@ def gather_params_to_host(params):
     from jax.sharding import NamedSharding, PartitionSpec
 
     def leaf(x):
-        rep = jax.device_put(x, NamedSharding(x.sharding.mesh, PartitionSpec()))
-        return np.asarray(rep) if is_main() else None
+        def gather():
+            rep = jax.device_put(
+                x, NamedSharding(x.sharding.mesh, PartitionSpec())
+            )
+            return np.asarray(rep) if is_main() else None
+
+        # the per-leaf reshard is a cross-host collective: with the
+        # elastic guard installed it gets the same bounded-timeout/abort
+        # path as the explicit reductions above
+        return _run_collective(gather, "gather_params")
 
     return jax.tree.map(leaf, params)

@@ -536,12 +536,21 @@ class AsyncPPOTrainerWorker:
         self.publish_weights()
         return True
 
-    def run(self, shutdown=None):
+    def run(self, shutdown=None, elastic=None, engine_factory=None):
         """Main loop. ``shutdown`` (a :class:`worker_base.GracefulShutdown`)
         makes SIGTERM/SIGINT end the loop through
         :meth:`_handle_preemption`: commit a recover checkpoint, republish
         ``model_version``, set ``self.preempted`` so the caller exits with
-        the distinct preemption code."""
+        the distinct preemption code.
+
+        ``elastic`` (a :class:`parallel.elastic.WorldEpochManager`) +
+        ``engine_factory`` (rebuilds the actor/ref/critic/reward engines)
+        turn a world failure — a peer rank dead or wedged, surfaced as a
+        bounded-collective timeout or a transport error — into *surgical
+        recovery* instead of a crash: reform into the next world epoch,
+        rebuild the engines, roll back to the last committed recover
+        checkpoint, and keep training (docs/fault_tolerance.md "Elastic
+        multihost")."""
         from areal_tpu.system import worker_base
 
         watchdog = None
@@ -556,31 +565,60 @@ class AsyncPPOTrainerWorker:
         self._watchdog = watchdog
         try:
             while self.step < self.control.total_train_steps:
-                # process 0 decides for everyone: SIGTERM lands on each
-                # host at a slightly different instant, and a host-local
-                # branch into the (collective-bearing) preemption save while
-                # siblings are mid-train-step would deadlock the pod — the
-                # same rule as the ckpt timer below (multihost.main_decides;
-                # machine-checked by arealint host-divergence-collective).
-                # Cost: one extra per-step allgather on multihost (free
-                # single-host), marginal next to _collect_batch's existing
-                # per-iteration allreduces.
-                if shutdown is not None and multihost.main_decides(
-                    shutdown.should_stop()
-                ):
-                    # the preemption save is a legitimate long stall: the
-                    # watchdog must not dump (or, abort-gated, kill us)
-                    # mid-commit of the very checkpoint preemption exists
-                    # to produce
+                try:
+                    # process 0 decides for everyone: SIGTERM lands on each
+                    # host at a slightly different instant, and a host-local
+                    # branch into the (collective-bearing) preemption save
+                    # while siblings are mid-train-step would deadlock the
+                    # pod — the same rule as the ckpt timer below
+                    # (multihost.main_decides; machine-checked by arealint
+                    # host-divergence-collective). Cost: one extra per-step
+                    # allgather on multihost (free single-host), marginal
+                    # next to _collect_batch's existing allreduces.
+                    if shutdown is not None and multihost.main_decides(
+                        shutdown.should_stop()
+                    ):
+                        # the preemption save is a legitimate long stall:
+                        # the watchdog must not dump (or, abort-gated, kill
+                        # us) mid-commit of the very checkpoint preemption
+                        # exists to produce
+                        if watchdog is not None:
+                            watchdog.stop()
+                        self._handle_preemption(shutdown)
+                        break
+                    if watchdog is not None:
+                        watchdog.bump()
+                    if self.run_step() is None:
+                        logger.warning(
+                            "no data from rollout stream; stopping"
+                        )
+                        break
+                except Exception as e:  # noqa: BLE001 — classified below
+                    if elastic is None or engine_factory is None:
+                        raise
+                    from areal_tpu.parallel import elastic as elastic_mod
+
+                    wf = elastic_mod.as_world_failure(e)
+                    if wf is None:
+                        raise
+                    # a reform (waiting out the supervisor's epoch bump +
+                    # relaunch, then an engine rebuild + orbax restore) is
+                    # a legitimate long stall far beyond any per-step
+                    # watchdog budget: STOP the watchdog — an abort-gated
+                    # one would os._exit a healthy survivor mid-recovery,
+                    # turning one dead rank into two — and re-arm a fresh
+                    # one once the world is whole again
                     if watchdog is not None:
                         watchdog.stop()
-                    self._handle_preemption(shutdown)
-                    break
-                if watchdog is not None:
-                    watchdog.bump()
-                if self.run_step() is None:
-                    logger.warning("no data from rollout stream; stopping")
-                    break
+                        watchdog = None
+                        self._watchdog = None
+                    self._elastic_recover(elastic, engine_factory, wf)
+                    if self.control.watchdog_timeout_secs:
+                        watchdog = worker_base.HangWatchdog(
+                            "trainer",
+                            timeout_s=self.control.watchdog_timeout_secs,
+                        ).start()
+                        self._watchdog = watchdog
         finally:
             if watchdog is not None:
                 watchdog.stop()
@@ -636,6 +674,112 @@ class AsyncPPOTrainerWorker:
                 took, shutdown.remaining(),
             )
 
+    def _elastic_recover(self, elastic, engine_factory, failure):
+        """Surgical world recovery: reform into the next epoch, rebuild
+        every engine (all device state died with the old epoch's backend),
+        roll back to the last committed recover checkpoint so every rank —
+        survivors and the relaunched one alike — resumes on an identical
+        step, and republish the restored weights under a NEW monotonic
+        version (the manager drops non-advancing announces; the gen fleet
+        keeps serving the last published weights throughout the reform).
+        Raises (-> restart-the-world) past the reform budget."""
+        logger.error(
+            "world failure at step %d: %s — attempting surgical recovery",
+            self.step, failure,
+        )
+        live_version = self.actor_engine.version
+        # pending deferred stats hold device arrays of the dead backend;
+        # their steps re-execute after rollback anyway
+        dropped_stats = len(self._pending_stats)
+        self._pending_stats = []
+        self._consec_anomalies = 0
+        try:
+            # the in-flight background export writes host arrays gathered
+            # BEFORE the failure; join it so it cannot interleave with the
+            # post-recovery republish (a failed one is superseded anyway)
+            self._join_publish()
+        except RuntimeError:
+            logger.warning(
+                "in-flight weight publish failed during the world failure; "
+                "superseded by the post-recovery republish"
+            )
+        elastic.reform(str(failure))
+        actor, ref, critic, reward = engine_factory()
+        self.actor_engine = actor
+        self.ref_engine = ref
+        self.critic_engine = critic
+        engines = {"actor": actor}
+        if ref is not None:
+            engines["ref"] = ref
+        if critic is not None:
+            engines["critic"] = critic
+        if reward is not None:
+            engines["reward"] = reward
+        self.executor = FunctionExecutor(
+            self.executor.graph, engines, self.executor.interfaces,
+            default_mb_spec=self.mb_spec,
+        )
+        self.actor_if = self.executor.interfaces.get("actor_train")
+        recovered = self.load_recover_checkpoint(publish=False)
+        if not recovered:
+            # no committed checkpoint anywhere (shared FS: every rank —
+            # survivor or relaunched — reads the same absence): the
+            # relaunched rank starts at step 0 with fresh engines, so
+            # survivors must RESET to the identical fresh start; keeping
+            # their pre-failure step would desynchronize every step-keyed
+            # collective branch (save cadence, loop bound) and wedge the
+            # reformed world
+            logger.error(
+                "no committed recover checkpoint after reform; world "
+                "restarts from step 0 with freshly initialized engines"
+            )
+            self.step = 0
+            self.samples_consumed = 0
+        # buffered trajectories predate the rollback — the policy that
+        # produced them is ahead of the restored step (same hazard as the
+        # guardrail rollback); load_recover_checkpoint cleared the stream
+        stale = self._buffer.clear()
+        if stale:
+            metrics_mod.counters.add(
+                metrics_mod.FT_STALE_DROPPED_ON_RECOVER, stale
+            )
+        # COLLECTIVE version agreement + ONE publish. A survivor-local
+        # bump would desynchronize the world: the relaunched rank runs
+        # trainer_main's startup (one publish), and survivors running an
+        # extra publish would issue a gather with no matching participant
+        # — and their engine versions would diverge from the relaunched
+        # rank's restored number. The allreduce hands every rank the same
+        # base (the survivors' pre-failure live version wins), so the
+        # fleet sees one new monotonic version the manager cannot drop.
+        self._agree_version_and_publish(floor=live_version)
+        self._counters_before = metrics_mod.counters.snapshot()
+        logger.warning(
+            "surgical recovery complete: epoch %d, resumed at step %d "
+            "(v%d, %d pending stats dropped, %d buffered trajectories "
+            "dropped)",
+            elastic.world.epoch, self.step, self.actor_engine.version,
+            dropped_stats, stale,
+        )
+
+    def _agree_version_and_publish(self, floor: int = 0):
+        """Elastic-world version convergence: every rank of the (re)formed
+        world calls this at the same point of its flow — survivors from
+        :meth:`_elastic_recover`, the relaunched rank from the launcher's
+        elastic startup. One allreduce agrees on the highest version any
+        rank has seen (``floor`` carries a survivor's pre-failure live
+        version; the relaunched rank contributes its restored number),
+        every rank adopts ``agreed + 1``, and ONE publish announces it —
+        strictly above anything the fleet saw, so the manager's
+        non-advancing check cannot drop it."""
+        base = int(
+            multihost.allreduce_max(
+                np.int64(max(floor, self.actor_engine.version))
+            )
+        )
+        self.actor_engine.version = base + 1
+        self.publish_weights()
+        self._join_publish()
+
     # ------------------------------------------------------------------ #
     # recovery (≈ master_worker.__recover_save:585)
     # ------------------------------------------------------------------ #
@@ -659,13 +803,18 @@ class AsyncPPOTrainerWorker:
             recover.dump(info)
         multihost.barrier("recover_ckpt")
 
-    def load_recover_checkpoint(self) -> bool:
+    def load_recover_checkpoint(self, publish: bool = True) -> bool:
         """Restart-the-world resume (the load side of
         ``save_recover_checkpoint``): restore engine state + step counters,
         republish ``model_version`` and ``training_samples`` so the manager
         and the gen fleet converge on the RESTORED version (not whatever the
         crashed run last announced), and drop in-flight trajectories — they
-        were generated against pre-crash weights/counters."""
+        were generated against pre-crash weights/counters.
+
+        ``publish=False`` (elastic callers): skip the version republish —
+        the elastic paths publish exactly once through
+        :meth:`_agree_version_and_publish` so survivors and a relaunched
+        rank issue identical collective sequences."""
         root = os.path.join(constants.get_recover_root(), "trainer")
         info = recover.load()
         if info is None:
@@ -735,8 +884,9 @@ class AsyncPPOTrainerWorker:
                 str(self.samples_consumed),
                 replace=True,
             )
-        self.publish_weights()
-        self._join_publish()
+        if publish:
+            self.publish_weights()
+            self._join_publish()
         logger.info(
             "recovered trainer at step %d (v%d, %d samples consumed)",
             self.step, self.actor_engine.version, self.samples_consumed,

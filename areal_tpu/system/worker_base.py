@@ -54,9 +54,14 @@ DEFAULT_DEATH_TIMEOUT = 300.0
 # Distinct trainer exit codes the launcher switches on. 75 = EX_TEMPFAIL
 # ("try again"): the trial state is intact — a committed recover checkpoint
 # was saved — and a restart resumes it. 76: the watchdog killed a hung
-# worker; state is whatever the last committed checkpoint holds.
+# worker; state is whatever the last committed checkpoint holds. 77: an
+# elastic trainer rank failed beyond surgical recovery (reform budget
+# exhausted or an unrecoverable world failure) — state is the last
+# committed checkpoint; the caller escalates to restart-the-world
+# (docs/fault_tolerance.md "Elastic multihost").
 EXIT_PREEMPTED = 75
 EXIT_WATCHDOG = 76
+EXIT_WORLD_FAILED = 77
 
 
 def mark_experiment_running(experiment_name: str, trial_name: str):

@@ -452,12 +452,29 @@ class TrainEngine:
         # compile on the second round of every run (64.7 s at bench shape;
         # VERDICT r3 weak #1). With the pin, round 2 hits the round-1 cache.
         repl = NamedSharding(self.mesh, P())
-        self.opt_state = jax.tree.map(
-            lambda x: x if isinstance(x.sharding, NamedSharding)
-            else jax.device_put(x, repl),
-            # arealint: ok(one-time optimizer-state init at setup, not a per-step rebuild)
-            jax.jit(self.tx.init)(self.params),
-        )
+        # arealint: ok(one-time optimizer-state init at setup, not a per-step rebuild)
+        raw = jax.jit(self.tx.init)(self.params)
+
+        def pin(x):
+            if isinstance(x.sharding, NamedSharding):
+                return x
+            # COMMUNICATION-FREE replication: the un-pinned leaves are the
+            # optax scalar counts — tiny, identical on every process.
+            # Re-putting the per-process SingleDeviceSharding arrays into
+            # a multi-process sharding compiles to a cross-host transfer,
+            # and dozens of those tiny collectives dispatched around the
+            # engine-build window interleave differently per rank — which
+            # wedged the gloo transport with mismatched message sizes
+            # (`op.preamble.length <= op.nbytes` aborts) whenever an
+            # elastic world re-formed under CPU contention. Building the
+            # global array from the local host value touches only local
+            # devices: no collective, no ordering hazard.
+            host = np.asarray(x)
+            return jax.make_array_from_callback(
+                host.shape, repl, lambda idx, h=host: h[idx]
+            )
+
+        self.opt_state = jax.tree.map(pin, raw)
         return self
 
     # ------------------------------------------------------------------ #

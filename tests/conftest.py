@@ -34,10 +34,34 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 import asyncio
+import contextlib
 import inspect
+import tempfile
 
 import numpy as np
 import pytest
+
+
+@contextlib.contextmanager
+def multihost_world_lock():
+    """Serialize multi-process CPU worlds ACROSS pytest processes.
+
+    An N-process gloo world is timing-sensitive (bounded collectives,
+    coordinator rendezvous); two suites launching worlds concurrently on
+    a shared CI box starve each other into spurious timeouts — the
+    standalone test_multihost failures noted in the PR-8 log. A
+    system-wide flock makes world launches mutually exclusive; the lock
+    file lives in the shared tempdir so unrelated pytest invocations
+    contend on the same lock."""
+    import fcntl
+
+    path = os.path.join(tempfile.gettempdir(), "areal_tpu_multihost.lock")
+    with open(path, "w") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
 
 
 def pytest_pyfunc_call(pyfuncitem):

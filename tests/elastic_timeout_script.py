@@ -1,0 +1,84 @@
+"""Subprocess body for the collective-timeout semantics test
+(tests/test_elastic_multihost.py): a 2-process world where rank 1 wedges
+BEFORE entering the barrier, and rank 0's `multihost.barrier` must raise
+`CollectiveTimeoutError` within the configured deadline instead of
+hanging forever.
+
+Run as one rank:
+    python elastic_timeout_script.py --rank 0 --nr-root /tmp/x \
+        --timeout-s 5 --out r0.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--nr-root", required=True)
+    ap.add_argument("--timeout-s", type=float, default=5.0)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from areal_tpu.base import name_resolve
+    from areal_tpu.parallel import elastic, multihost
+
+    multihost.enable_cpu_collectives()
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+    name_resolve.reconfigure(
+        name_resolve.NameResolveConfig(type="file", root=args.nr_root)
+    )
+    mgr = elastic.WorldEpochManager(
+        elastic.ElasticConfig(
+            experiment_name="etimeout", trial_name="t0",
+            num_processes=2, process_id=args.rank,
+            collective_timeout_s=args.timeout_s,
+        )
+    )
+    mgr.join()
+
+    # one successful warm-up barrier proves the guarded path works at all
+    multihost.barrier("warmup")
+
+    if args.rank == 1:
+        time.sleep(600)  # wedged in "user code", never reaches the barrier
+
+    t0 = time.monotonic()
+    try:
+        multihost.barrier("dead_peer")
+        outcome = {"raised": None, "elapsed_s": time.monotonic() - t0}
+    except elastic.CollectiveTimeoutError as e:
+        outcome = {
+            "raised": "CollectiveTimeoutError",
+            "message": str(e)[:200],
+            "elapsed_s": time.monotonic() - t0,
+            "timeouts_counted": mgr.guard.timeouts,
+        }
+    except Exception as e:  # noqa: BLE001 — recorded for the test to judge
+        outcome = {
+            "raised": type(e).__name__,
+            "message": str(e)[:200],
+            "elapsed_s": time.monotonic() - t0,
+        }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(outcome, f)
+    mgr.stop()
+    elastic.hard_exit(0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,6 +49,16 @@ def main():
     from areal_tpu.parallel import multihost
 
     if args.num_processes > 1:
+        # cross-process CPU collectives need gloo (the jaxlib default of
+        # "none" fails every collective with "Multiprocess computations
+        # aren't implemented on the CPU backend") ...
+        multihost.enable_cpu_collectives()
+        # ... and serialized device dispatch: async-dispatched
+        # computations run their gloo collectives concurrently, and
+        # rank-dependent execution order can wedge the transport with
+        # mismatched-preamble aborts — the standalone flakes the PR-8 log
+        # attributed to "CPU contention"
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
         multihost.initialize(
             coordinator_address=args.coordinator,
             num_processes=args.num_processes,

@@ -1,0 +1,157 @@
+"""Elastic multihost: the REAL N-process worlds (slow).
+
+The acceptance proofs of docs/fault_tolerance.md "Elastic multihost",
+driven through the chaos harness (`tools/chaos.py`) on the same CPU
+fault world as tests/test_multihost.py:
+
+- kill one rank mid-step on the 4-process world -> detection,
+  surviving-rank rollback, relaunch, rejoin — the loss trajectory equals
+  an unfaulted single-process run from the same committed checkpoint,
+  the gen side keeps answering throughout, and ft/rank_restarts == 1;
+- a rank *hang* (not exit) is detected by the collective-timeout
+  watchdog and recovered the same way;
+- a rank that calls `multihost.barrier` with a dead/wedged peer raises
+  the bounded-timeout error within the configured deadline (2-process
+  world), instead of hanging;
+- the randomized-but-seeded multi-fault soak holds every end-state
+  invariant (`make chaos` runs the shorter CI flavor of the same
+  harness).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.conftest import multihost_world_lock
+from tools import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cfg: chaos.ChaosConfig) -> dict:
+    with multihost_world_lock():
+        return chaos.run_scenario(cfg)
+
+
+@pytest.mark.slow
+def test_kill_rank_mid_step_recovers_surgically(tmp_path):
+    report = _run(chaos.ChaosConfig(
+        seed=101,
+        schedule=[{"kind": "kill", "rank": 2, "epoch": 0, "step": 2}],
+        num_ranks=4, steps=8, ckpt_every=3,
+        collective_timeout_s=30.0,
+        with_gen=True,
+        root=str(tmp_path),
+    ))
+    assert report["ok"], report["violations"]
+    # exactly ONE rank relaunch and ONE world epoch for one kill
+    assert report["rank_restarts"] == 1
+    assert report["world_epochs"] == 1
+    assert report["counters"]["ft/rank_restarts"] == 1
+    # every rank rejoined and reached the final step (loss continuity vs
+    # the unfaulted baseline is asserted inside the harness invariants)
+    assert report["ranks_reported"] == [0, 1, 2, 3]
+    # the serving side never stopped answering and leaked nothing
+    gen = report["gen"]
+    assert gen["ok"] >= 1 and gen["failed"] == 0
+    assert gen["slots_running"] == 0 and gen["pages_leaked"] == 0
+    assert not gen["version_regressed"]
+
+
+@pytest.mark.slow
+def test_hang_rank_detected_by_collective_watchdog(tmp_path):
+    """A rank that wedges WITHOUT exiting is invisible to process-level
+    supervision; only the bounded-collective watchdog surfaces it."""
+    report = _run(chaos.ChaosConfig(
+        seed=102,
+        schedule=[{"kind": "hang", "rank": 1, "epoch": 0, "step": 3}],
+        num_ranks=4, steps=8, ckpt_every=3,
+        collective_timeout_s=25.0,
+        with_gen=False,
+        root=str(tmp_path),
+    ))
+    assert report["ok"], report["violations"]
+    assert report["rank_restarts"] == 1
+    assert report["world_epochs"] == 1
+    # detection cannot be faster than the collective timeout, and must be
+    # bounded well under the harness recovery bound
+    assert report["recovery_times_s"][0] < 240.0
+
+
+@pytest.mark.slow
+def test_collective_timeout_raises_within_deadline(tmp_path):
+    """Satellite contract: `multihost.barrier` with a wedged peer raises
+    CollectiveTimeoutError within the configured deadline on the
+    2-process world — not a hang, not a crash."""
+    from areal_tpu.base import name_resolve, network
+    from areal_tpu.parallel import elastic
+
+    nr_root = str(tmp_path / "nr")
+    timeout_s = 6.0
+    prev = name_resolve.default_repository()
+    name_resolve.set_repository(
+        name_resolve.make_repository(
+            name_resolve.NameResolveConfig(type="file", root=nr_root)
+        )
+    )
+    try:
+        port = network.find_free_port()
+        elastic.host_service(port, 2)
+        elastic.write_world(
+            "etimeout", "t0",
+            elastic.WorldState(0, f"127.0.0.1:{port}", 2),
+        )
+    finally:
+        name_resolve.set_repository(prev)
+
+    script = os.path.join(os.path.dirname(__file__),
+                          "elastic_timeout_script.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    out0 = str(tmp_path / "r0.json")
+    with multihost_world_lock():
+        procs = [
+            subprocess.Popen(
+                [sys.executable, script, "--rank", str(r),
+                 "--nr-root", nr_root, "--timeout-s", str(timeout_s),
+                 "--out", str(tmp_path / f"r{r}.json")],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+            for r in range(2)
+        ]
+        try:
+            log0 = procs[0].communicate(timeout=180)[0].decode()
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+    assert procs[0].returncode == 0, log0[-3000:]
+    with open(out0) as f:
+        outcome = json.load(f)
+    assert outcome["raised"] == "CollectiveTimeoutError", outcome
+    # raised near the deadline: after it, but not hanging far past it
+    assert timeout_s <= outcome["elapsed_s"] < timeout_s + 30.0, outcome
+    assert outcome["timeouts_counted"] >= 1
+
+
+@pytest.mark.slow
+def test_chaos_soak_seeded_multi_fault(tmp_path):
+    """The long(er) soak `make chaos` is the short flavor of: a seeded
+    hang + kill across consecutive world epochs, every end-state
+    invariant asserted."""
+    report = _run(chaos.ChaosConfig(
+        seed=8, n_faults=2,
+        num_ranks=4, steps=10, ckpt_every=3,
+        collective_timeout_s=25.0,
+        with_gen=True,
+        root=str(tmp_path),
+    ))
+    assert report["ok"], report["violations"]
+    assert report["rank_restarts"] == len(report["schedule"]) == 2
+    assert report["world_epochs"] == 2
