@@ -6,7 +6,15 @@ PYTHON ?= python
 # Diff base for lint-fast: any git ref (branch, SHA, HEAD~1, ...).
 SINCE ?= HEAD
 
-.PHONY: lint lint-fast lint-rules serve chaos
+.PHONY: lint lint-fast lint-rules serve chaos bench-spec
+
+# Speculative-decoding bench only (docs/performance.md "Speculative
+# decoding"): the three-arm vanilla / n-gram / draft-model A/B at the
+# 64-slot config. On CPU this smokes structure; the headline
+# accepted-tokens/s ratios are judged on chip (BENCH_SECTIONS gates the
+# other sections off, including the primary SFT probe).
+bench-spec:
+	BENCH_SECTIONS=gen_spec $(PYTHON) bench.py
 
 # Chaos soak, short seeded schedule (CI-sized): drive the 4-process
 # elastic CPU fault world through one seeded kill/hang + the serving-side

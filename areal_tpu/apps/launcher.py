@@ -92,7 +92,7 @@ def gen_server_main(cfg, server_idx: int):
     _setup_worker_env(cfg, cfg.gen.device)
     import jax
 
-    from areal_tpu.base import name_resolve, names, network
+    from areal_tpu.base import constants, name_resolve, names, network
     from areal_tpu.gen.engine import GenerationEngine
     from areal_tpu.gen.server import serve
     from areal_tpu.models import hf as hf_conv
@@ -119,6 +119,34 @@ def gen_server_main(cfg, server_idx: int):
         from areal_tpu.models import transformer as tfm
 
         host_params = tfm.init_params(mcfg, jax.random.key(0))
+    # draft MODEL for spec decode: config beats the env knob; None lets
+    # the engine fall through to AREAL_SPEC_DRAFT_MODEL (then the n-gram
+    # self-drafter). Same gate as the engine's env path: an explicit
+    # drafter is kept by the engine regardless of the spec flag, so
+    # loading one here for a spec-disabled fleet would make every engine
+    # pay draft-pool HBM + a per-vanilla-step maintenance sweep while
+    # never speculating.
+    drafter = None
+    draft_path = getattr(cfg.gen, "spec_draft_model", None)
+    spec_on = (
+        cfg.gen.spec_decode
+        if cfg.gen.spec_decode is not None
+        else constants.spec_decode_enabled()
+    )
+    if draft_path and spec_on:
+        from areal_tpu.gen.drafter import TransformerDrafter
+
+        drafter = TransformerDrafter.from_hf(
+            draft_path,
+            kv_dtype=getattr(cfg.gen, "spec_draft_kv_dtype", None),
+        )
+    elif draft_path:
+        logger.warning(
+            "gen.spec_draft_model is set but spec decode is disabled for "
+            "the gen fleet; not loading the draft model (set "
+            "gen.spec_decode=true or %s to serve it)",
+            constants.SPEC_DECODE_ENV,
+        )
     engine = GenerationEngine(
         mcfg,
         host_params,  # cast + TP-shard happen inside (prepare_params)
@@ -133,6 +161,7 @@ def gen_server_main(cfg, server_idx: int):
         mesh=mesh,
         spec_decode=cfg.gen.spec_decode,
         spec_k=cfg.gen.spec_k,
+        drafter=drafter,
     )
 
     async def main():
@@ -181,6 +210,11 @@ def gen_server_main(cfg, server_idx: int):
                     engine.kv_pool_demand_occupancy()
                 ),
                 "n_pages_free": float(engine.pool.n_free),
+                # draft-model spec decode: pool bytes (0 without a draft
+                # model; occupancy is shared with the target pool — the
+                # pages move in lockstep) and the draft weight generation
+                "draft_kv_pool_bytes": float(engine.draft_kv_pool_bytes()),
+                "draft_version": float(engine.draft_version),
             },
         ).maybe_start()
         while watch.alive():

@@ -37,6 +37,8 @@ TELEMETRY_EXPORT_ENV = "AREAL_TELEMETRY_EXPORT"
 # Speculative decoding (docs/performance.md "Speculative decoding").
 SPEC_DECODE_ENV = "AREAL_SPEC_DECODE"   # draft-and-verify decode chunks
 SPEC_K_ENV = "AREAL_SPEC_K"             # draft tokens per slot per spec step
+SPEC_DRAFT_MODEL_ENV = "AREAL_SPEC_DRAFT_MODEL"      # HF dir of draft model
+SPEC_DRAFT_KV_DTYPE_ENV = "AREAL_SPEC_DRAFT_KV_DTYPE"  # draft KV pool dtype
 # KV-pool quantization (docs/performance.md "KV quantization").
 KV_DTYPE_ENV = "AREAL_KV_DTYPE"         # paged KV pool storage dtype
 # Elastic multihost (docs/fault_tolerance.md "Elastic multihost").
@@ -207,6 +209,44 @@ def spec_k() -> int:
     speculative decode step; the verify pass scores K+1 positions in one
     forward. Floored at 1 (K=0 would be vanilla decode with extra steps)."""
     return max(1, env_int(SPEC_K_ENV, 4))
+
+
+def spec_draft_model() -> Optional[str]:
+    """``AREAL_SPEC_DRAFT_MODEL`` (default unset): HF checkpoint dir of a
+    small draft MODEL for speculative decoding. When set, generation
+    engines constructed without an explicit drafter AND with spec decode
+    enabled build a TP-sharded ``TransformerDrafter`` from it instead of
+    the self-drafting n-gram baseline (docs/performance.md "Speculative
+    decoding"); spec-disabled engines log and ignore it — a draft model
+    is real HBM and per-step work an engine that never speculates must
+    not pay for a fleet-wide env var. The draft's vocab must match the
+    serving model's. Empty/unset -> None."""
+    raw = env_str(SPEC_DRAFT_MODEL_ENV)
+    if raw is None or not raw.strip():
+        return None
+    return raw.strip()
+
+
+def spec_draft_kv_dtype() -> Optional[str]:
+    """``AREAL_SPEC_DRAFT_KV_DTYPE`` (default unset = the draft's serving
+    dtype): storage dtype of the draft model's paged KV pool — the same
+    contract as ``AREAL_KV_DTYPE`` for the target pool (``"int8"``
+    quantizes; unknown values fall back to unset, logged). The draft
+    pool shares the target pool's page indices, so this knob only sizes
+    the draft's parallel pages array."""
+    raw = env_str(SPEC_DRAFT_KV_DTYPE_ENV)
+    if raw is None or not raw.strip():
+        return None
+    v = raw.strip().lower()
+    if v == "int8":
+        return "int8"
+    if v in ("bf16", "bfloat16"):
+        return "bf16"
+    _logger.warning(
+        "ignoring unknown %s=%r (using the draft serving dtype)",
+        SPEC_DRAFT_KV_DTYPE_ENV, raw,
+    )
+    return None
 
 
 def kv_dtype() -> Optional[str]:
@@ -463,6 +503,8 @@ def get_env_vars(**extra) -> dict:
         "AREAL_DECODE_PIPELINE",
         SPEC_DECODE_ENV,
         SPEC_K_ENV,
+        SPEC_DRAFT_MODEL_ENV,
+        SPEC_DRAFT_KV_DTYPE_ENV,
         KV_DTYPE_ENV,
         "AREAL_DISABLE_NATIVE",
         "AREAL_ENABLE_FUNCTION_CALL",

@@ -404,6 +404,26 @@ GEN_SPEC_DRAFT_TOKENS = "gen/spec_draft_tokens"
 GEN_SPEC_ACCEPTED_TOKENS = "gen/spec_accepted_tokens"
 GEN_SPEC_ACCEPT_LEN = "gen/spec_accept_len"
 
+# Draft-MODEL speculative decoding: the per-position acceptance
+# probability min(1, p/q) the rejection sampler computes for sampled
+# (general-q) drafters — the draft-quality signal independent of where
+# the first rejection lands — plus the draft pool's occupancy histogram
+# (its pages move in lockstep with the target pool's, so this mirrors
+# gen/kv_pool_occupancy whenever a draft model is configured; bytes ride
+# the per-worker gauge channel and /metrics_json).
+GEN_SPEC_Q_ACCEPT_PROB = "gen/spec_q_accept_prob"
+GEN_DRAFT_KV_POOL_OCCUPANCY = "gen/draft_kv_pool_occupancy"
+
+# Chunk-boundary sync protocol (docs/performance.md "Speculative
+# decoding" / chunk pipelining): every decode chunk's harvest-flag fetch
+# is dispatch-ahead (the D2H copy is enqueued at dispatch, resolved one
+# chunk later under AREAL_DECODE_PIPELINE) — ``blocked`` counts resolves
+# that found the copy not yet landed (a fresh host<->device round trip,
+# the thing the protocol exists to eliminate). Steady-state pipelined
+# decode keeps blocked at zero; the overlap test pins it.
+GEN_CHUNK_FLAG_FETCHES = "gen/chunk_flag_fetches"
+GEN_CHUNK_FLAG_BLOCKED = "gen/chunk_flag_blocked"
+
 # KV-pool quantization (docs/performance.md "KV quantization"): pages
 # allocated into an int8 pool (their KV lands quantized at the post-scan
 # scatter) plus a pool-occupancy histogram — the HBM-headroom signal the
@@ -449,6 +469,14 @@ SPEC_ACCEPT_LEN_BOUNDARIES: List[float] = [
     0.5, 1.5, 2.5, 3.5, 4.5, 5.5, 6.5, 7.5, 8.5, 12.5, 16.5,
 ]
 
+# Probability edges for the general-q acceptance-probability histogram:
+# values live in [0, 1]; finer edges toward 1.0 because that is where a
+# good draft model lives (0.9 vs 0.99 mean accept is the difference
+# between spec paying and not at large K).
+SPEC_Q_ACCEPT_PROB_BOUNDARIES: List[float] = [
+    0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 0.95, 0.99,
+]
+
 
 # Per-key metric kinds; unknown keys default to KIND_SUM. The arealint
 # ``unregistered-counter`` rule keys off the UPPERCASE constants above;
@@ -461,7 +489,9 @@ METRIC_KINDS: Dict[str, str] = {
     TTFC_S: KIND_HISTOGRAM,
     REWARD_LAG_S: KIND_HISTOGRAM,
     GEN_SPEC_ACCEPT_LEN: KIND_HISTOGRAM,
+    GEN_SPEC_Q_ACCEPT_PROB: KIND_HISTOGRAM,
     GEN_KV_POOL_OCCUPANCY: KIND_HISTOGRAM,
+    GEN_DRAFT_KV_POOL_OCCUPANCY: KIND_HISTOGRAM,
     RECOVERY_TIME_S: KIND_HISTOGRAM,
     GW_QUEUE_WAIT_S: KIND_HISTOGRAM,
     GW_TTFT_S: KIND_HISTOGRAM,
@@ -472,7 +502,9 @@ METRIC_KINDS: Dict[str, str] = {
 HISTOGRAM_BOUNDARIES: Dict[str, List[float]] = {
     STALENESS_VERSIONS: VERSION_LAG_BOUNDARIES,
     GEN_SPEC_ACCEPT_LEN: SPEC_ACCEPT_LEN_BOUNDARIES,
+    GEN_SPEC_Q_ACCEPT_PROB: SPEC_Q_ACCEPT_PROB_BOUNDARIES,
     GEN_KV_POOL_OCCUPANCY: POOL_OCCUPANCY_BOUNDARIES,
+    GEN_DRAFT_KV_POOL_OCCUPANCY: POOL_OCCUPANCY_BOUNDARIES,
 }
 
 

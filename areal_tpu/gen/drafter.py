@@ -18,17 +18,21 @@ no second model, which makes it free to serve: repetitive/structured
 generations (math derivations, code, re-quoted context) are its sweet
 spot.
 
-A small TP-sharded draft MODEL slots in behind the same interface later:
-implement ``propose`` as the draft model's forward (its params/KV ride
-alongside the engine state; SNIPPETS.md's pjit/NamedSharding patterns
-cover sharding it onto the serving mesh) and set
-``deterministic = False`` + return per-position proposal logprobs through
-``q_logprobs`` once the engine threads them (the rejection sampler
-already supports the general form).
+:class:`TransformerDrafter` is the step past self-drafting: a small
+TP-sharded draft MODEL on the serving mesh, autoregressively proposing K
+tokens through ``decode_step_paged`` on its own params and its OWN paged
+KV pool (same page indices as the target pool, so pages allocate/free in
+lockstep — see ``gen/pages.py``). It declares ``deterministic = False``
+and ``provides_q_logprobs = True``: every proposal comes with the
+per-position proposal distribution, which feeds the general-q branch of
+``sampling.spec_rejection_sample`` — still exactly distribution-
+preserving, still PPO-safe.
 """
 
 import dataclasses
+from typing import Any, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 
@@ -36,11 +40,17 @@ class Drafter:
     """Interface: propose K draft tokens per slot from resident context.
 
     ``deterministic = True`` declares one-hot proposals (the rejection
-    sampler then needs no proposal distribution). ``propose`` executes
-    under ``jax.jit`` inside a ``lax.scan`` body.
+    sampler then needs no proposal distribution). A sampled drafter
+    (``deterministic = False``) MUST set ``provides_q_logprobs = True``
+    and return its proposal distribution alongside the tokens — the
+    engine refuses sampled drafters that don't, because accepting their
+    proposals without q would silently bias generation toward the
+    drafter (PPO corruption). ``propose`` executes under ``jax.jit``
+    inside a ``lax.scan`` body.
     """
 
     deterministic: bool = True
+    provides_q_logprobs: bool = False
 
     def propose(
         self,
@@ -92,3 +102,151 @@ class NGramDrafter(Drafter):
             ctx_tokens, jnp.clip(offs, 0, S - 1), axis=1
         )
         return jnp.where(in_ctx, cont, fallback[:, None]).astype(jnp.int32)
+
+
+class TransformerDrafter(Drafter):
+    """A small transformer draft MODEL proposing K tokens autoregressively
+    inside the jitted spec chunk.
+
+    The engine owns the heavy lifting: it prepares (casts + TP-shards)
+    ``params`` onto the serving mesh through the same
+    ``parallel/mesh.py`` logical-axis rules as the target, carries the
+    draft's OWN :class:`~areal_tpu.models.transformer.PagedKVCache` in
+    its state pytree (addressed by the SAME page table as the target
+    pool, so draft pages allocate/free in lockstep for free), and calls
+    :meth:`propose_model` from inside the spec chunk's scan body.
+
+    Each of the K proposal steps is one ``decode_step_paged`` on the
+    draft params: sample ``d_i ~ q_i`` (plain temperature-scaled draft
+    distribution; argmax for greedy slots), write its KV, feed it back.
+    The returned ``q_logprobs`` feed the general-q branch of
+    ``spec_rejection_sample`` — acceptance stays exactly distribution-
+    preserving for ANY proposal distribution, so a bad draft model can
+    only lower the accept rate, never perturb outputs.
+
+    ``cfg.vocab_size`` must equal the target's (tokens interchange);
+    the engine validates at construction. ``kv_dtype`` optionally
+    int8-quantizes the draft pool through the same ``kv_dtype`` path as
+    the target pool (``AREAL_SPEC_DRAFT_KV_DTYPE``).
+    """
+
+    deterministic = False
+    provides_q_logprobs = True
+
+    def __init__(self, cfg, params: Any, kv_dtype: Optional[str] = None):
+        self.cfg = cfg
+        self.params = params        # host pytree; engine prepares it
+        self.kv_dtype = kv_dtype
+
+    @classmethod
+    def from_hf(cls, path: str, kv_dtype: Optional[str] = None):
+        """Load a draft checkpoint (HF dir) via ``models/hf.py`` — the
+        ``AREAL_SPEC_DRAFT_MODEL`` deployment path."""
+        from areal_tpu.models import hf as hf_conv
+
+        cfg, params = hf_conv.load_hf_checkpoint(path)
+        return cls(cfg, params, kv_dtype=kv_dtype)
+
+    @classmethod
+    def shared_prefix(cls, cfg, params, n_layers: int,
+                      kv_dtype: Optional[str] = None):
+        """Smoke/bench constructor: the draft is the first ``n_layers``
+        of the target's stacked params (shared embeddings + head). A
+        stand-in for a distilled draft when no checkpoint exists —
+        predictive only when the target's later layers refine rather
+        than overturn the early layers' logits (true of trained models;
+        the random-init bench constructs its target that way). Real
+        deployments point ``AREAL_SPEC_DRAFT_MODEL`` at a distilled
+        checkpoint instead."""
+        if not 0 < n_layers <= cfg.n_layers:
+            raise ValueError(
+                f"shared-prefix draft needs 0 < n_layers <= {cfg.n_layers}, "
+                f"got {n_layers}"
+            )
+        dcfg = dataclasses.replace(cfg, n_layers=n_layers)
+        dparams = dict(params)
+        dparams["layers"] = jax.tree.map(
+            lambda x: x[:n_layers], params["layers"]
+        )
+        return cls(dcfg, dparams, kv_dtype=kv_dtype)
+
+    def propose(self, ctx_tokens, lens, fallback, k):  # pragma: no cover
+        raise NotImplementedError(
+            "TransformerDrafter proposes through propose_model (it needs "
+            "its params and paged KV cache, not just the token context)"
+        )
+
+    def propose_model(
+        self,
+        draft_params,
+        cache,                     # draft PagedKVCache
+        last_tokens: jnp.ndarray,  # [B] i32 pending token per slot
+        table: jnp.ndarray,        # [B, W] page table (shared with target)
+        lens: jnp.ndarray,         # [B] i32 resident tokens per slot
+        write_ok: jnp.ndarray,     # [B, K+1] bool: position i's KV may land
+        sp,                        # SamplingParams
+        rng: jax.Array,
+        k: int,
+        use_pallas: Optional[bool] = None,
+        mesh=None,
+        logits_sharding=None,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, Any]:
+        """K autoregressive draft steps. Returns ``(draft [B, K] i32,
+        q_logprobs [B, K, V] f32, cache)`` — the tokens, the proposal
+        distribution each was sampled from, and the draft cache with
+        positions ``lens..lens+K`` written where ``write_ok`` allows
+        (the engine's acceptance-agnostic residency bound: rejected
+        drafts' KV lands beyond the post-acceptance ``lens``, never
+        read, overwritten later — same contract as the target's
+        ``verify_step_paged`` scatter). All K+1 chunk positions are
+        written: the K steps write the tokens they CONSUME (``last``,
+        ``d_1..d_{K-1}``), and a final headless step writes ``d_K``'s —
+        on a fully-accepted step ``lens`` advances past ``d_K``, so
+        skipping it would leave a permanently resident garbage position
+        the next proposal's attention reads (partial accepts would
+        overwrite it; full accepts never do).
+
+        Pure and traceable: executes inside the engine's jitted spec
+        chunk, no host syncs. ``write_ok[:, i]`` is monotone per slot
+        (once False, stays False), so the per-step ``lens`` advance
+        tracks the written prefix exactly.
+        """
+        from areal_tpu.gen.sampling import _plain_temperature
+        from areal_tpu.models import transformer as tfm
+
+        greedy = sp.temperature <= 0.0
+        keys = jax.random.split(rng, k)
+        tok = last_tokens
+        d_lens = lens
+        drafts, qlps = [], []
+        for i in range(k):
+            logits, cache, d_lens = tfm.decode_step_paged(
+                draft_params, self.cfg, cache, tok, table, d_lens,
+                write_ok[:, i], use_pallas=use_pallas, mesh=mesh,
+            )
+            if logits_sharding is not None:
+                # TP serving: one explicit all-gather so the per-position
+                # sampling below runs replicated (the target chunk applies
+                # the same constraint to its verify logits)
+                logits = jax.lax.with_sharding_constraint(
+                    logits, logits_sharding
+                )
+            q_logits = _plain_temperature(logits, sp)      # [B, V] f32
+            q_lp = jax.nn.log_softmax(q_logits, axis=-1)
+            sampled = jax.random.categorical(keys[i], q_logits, axis=-1)
+            tok = jnp.where(
+                greedy, jnp.argmax(logits, axis=-1), sampled
+            ).astype(jnp.int32)
+            drafts.append(tok)
+            qlps.append(q_lp)
+        # d_K's own KV (see docstring): headless — no logits, no sample
+        _, cache, _ = tfm.decode_step_paged(
+            draft_params, self.cfg, cache, tok, table, d_lens,
+            write_ok[:, k], use_pallas=use_pallas, mesh=mesh,
+            with_head=False,
+        )
+        return (
+            jnp.stack(drafts, axis=1),
+            jnp.stack(qlps, axis=1),
+            cache,
+        )

@@ -46,6 +46,7 @@ Thread-safety: ``submit`` arrives on the server's asyncio thread while
 """
 
 import dataclasses
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -57,7 +58,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from areal_tpu.base import constants
 from areal_tpu.base import metrics as metrics_mod
-from areal_tpu.gen.drafter import Drafter, NGramDrafter
+from areal_tpu.gen.drafter import Drafter, NGramDrafter, TransformerDrafter
 from areal_tpu.gen.pages import OutOfPagesError, PagePool, PrefixRegistry
 from areal_tpu.gen.sampling import (
     SamplingParams,
@@ -66,6 +67,8 @@ from areal_tpu.gen.sampling import (
 )
 from areal_tpu.models import transformer as tfm
 from areal_tpu.models.config import ModelConfig
+
+logger = logging.getLogger("areal_tpu.gen.engine")
 
 # Serving-side sharding rules: tensor parallelism only. Params shard over
 # the ``model`` mesh axis exactly where the trainer's TP does (heads / mlp /
@@ -104,6 +107,14 @@ class GenState:
     fallback_token: jnp.ndarray  # [B] i32
     sp: SamplingParams
     rng: jax.Array
+    # draft MODEL's own paged KV pool (None without a TransformerDrafter):
+    # addressed by the SAME page tables and lens as the target pool, so
+    # draft pages allocate/free/share in lockstep with target pages, and
+    # BOTH decode paths keep it current (the spec chunk through the
+    # drafter's autoregressive proposal steps, the vanilla chunk through
+    # one headless draft decode step) — mixed spec/vanilla traffic stays
+    # correct on one state pytree.
+    draft_cache: Optional[tfm.PagedKVCache] = None
 
 
 @dataclasses.dataclass
@@ -192,6 +203,103 @@ class GenerationEngine:
         )
         self.kv_dtype = _resolve_kv_dtype(kd, cfg.dtype)
         self.kv_quantized = self.kv_dtype == "int8"
+        # Drafter resolution happens BEFORE device-state construction: a
+        # TransformerDrafter adds a draft param tree and a draft KV pool
+        # to everything below (shardings, state pytree, jitted programs).
+        # Explicit argument > AREAL_SPEC_DRAFT_MODEL checkpoint > the
+        # free self-drafting n-gram baseline. The env-knob checkpoint is
+        # only loaded when spec decode is actually on: a draft model is
+        # real HBM (pool + params) and a per-vanilla-step maintenance
+        # sweep, which an engine that never speculates must not pay just
+        # because a fleet-wide env var is set. An EXPLICIT drafter
+        # argument is kept regardless — that caller may toggle spec on
+        # later, and the pool must exist in the state pytree from
+        # construction.
+        spec_on = (
+            spec_decode
+            if spec_decode is not None
+            else constants.spec_decode_enabled()
+        )
+        if drafter is None:
+            draft_path = constants.spec_draft_model()
+            if draft_path and spec_on:
+                drafter = TransformerDrafter.from_hf(
+                    draft_path, kv_dtype=constants.spec_draft_kv_dtype()
+                )
+            elif draft_path:
+                logger.warning(
+                    "%s is set but spec decode is disabled on this engine; "
+                    "not loading the draft model (enable %s or pass "
+                    "spec_decode=True to serve it)",
+                    constants.SPEC_DRAFT_MODEL_ENV,
+                    constants.SPEC_DECODE_ENV,
+                )
+        self.drafter: Drafter = drafter if drafter is not None else NGramDrafter()
+        if not getattr(self.drafter, "deterministic", True) and not getattr(
+            self.drafter, "provides_q_logprobs", False
+        ):
+            # sampled proposals without a proposal distribution cannot be
+            # rejection-sampled correctly — accepting them would silently
+            # bias generation toward the drafter (PPO corruption). Sampled
+            # drafters must declare provides_q_logprobs and return their
+            # q; the general-q branch of spec_rejection_sample handles
+            # the rest.
+            raise NotImplementedError(
+                "non-deterministic drafters need their proposal logprobs "
+                "threaded into spec_rejection_sample (q_logprobs): set "
+                "provides_q_logprobs = True and return them, or use a "
+                "deterministic (one-hot) drafter"
+            )
+        self._draft: Optional[TransformerDrafter] = (
+            self.drafter if isinstance(self.drafter, TransformerDrafter)
+            else None
+        )
+        if (
+            not getattr(self.drafter, "deterministic", True)
+            and self._draft is None
+        ):
+            # the q_logprobs contract is wired through the model-drafter
+            # interface only: a sampled drafter outside it would take the
+            # one-hot propose() path and its q would silently never reach
+            # the rejection sampler
+            raise NotImplementedError(
+                "sampled drafters are wired through the TransformerDrafter "
+                "propose_model interface (draft params + paged KV inside "
+                "the jitted chunk); subclass TransformerDrafter to "
+                "customize proposals"
+            )
+        self.draft_cfg: Optional[ModelConfig] = None
+        self.draft_kv_dtype: Optional[str] = None
+        self.draft_kv_quantized = False
+        self.draft_version = 0
+        if self._draft is not None:
+            dcfg = self._draft.cfg
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft model vocab ({dcfg.vocab_size}) must match the "
+                    f"serving model's ({cfg.vocab_size}) — proposed tokens "
+                    "are scored by the target verbatim"
+                )
+            if dcfg.dtype != cfg.dtype:
+                # serve the draft in the target's activation dtype (a
+                # float32 CPU test config must not silently run a bf16
+                # draft next to a float32 target)
+                dcfg = dataclasses.replace(dcfg, dtype=cfg.dtype)
+            self.draft_cfg = dcfg
+            # write the coerced cfg back: propose_model's forward runs
+            # under the DRAFTER's cfg, and leaving the checkpoint dtype
+            # there would compute spec-chunk proposals in one dtype while
+            # the vanilla chunk's maintenance step (draft_cfg) writes KV
+            # in another — the silent mismatch the coercion exists to
+            # prevent
+            self._draft.cfg = dcfg
+            dkd = (
+                self._draft.kv_dtype
+                if self._draft.kv_dtype is not None
+                else constants.spec_draft_kv_dtype()
+            )
+            self.draft_kv_dtype = _resolve_kv_dtype(dkd, dcfg.dtype)
+            self.draft_kv_quantized = self.draft_kv_dtype == "int8"
         if mesh is not None:
             if "model" not in mesh.axis_names:
                 raise ValueError(
@@ -202,16 +310,11 @@ class GenerationEngine:
             # 'model' serving routes the decode kernel through shard_map
             # over the kv-head axis (ops/paged_attention.py) — r5, replaces
             # the r3 XLA-gather pin; _decode_use_pallas stays None (auto)
-            for dim, name in (
-                (cfg.n_kv_heads, "n_kv_heads"),
-                (cfg.n_q_heads, "n_q_heads"),
-                (cfg.vocab_size, "vocab_size"),
-            ):
-                if dim % tp != 0:
-                    raise ValueError(
-                        f"tensor-parallel generation needs {name} ({dim}) "
-                        f"divisible by the model-axis size {tp}"
-                    )
+            from areal_tpu.parallel.mesh import check_tp_divisibility
+
+            check_tp_divisibility(cfg, tp, role="generation")
+            if self.draft_cfg is not None:
+                check_tp_divisibility(self.draft_cfg, tp, role="draft model")
             self._repl = NamedSharding(mesh, P())
             # pool [L, P, 2, Hkv, page, D]: shard the kv-head dim; the
             # int8 pool's scales [L, P, 2, Hkv, page] extend the same
@@ -228,7 +331,22 @@ class GenerationEngine:
             self._param_sh = param_shardings(
                 mesh, tfm.param_logical_axes(cfg), GEN_RULES
             )
+            if self.draft_cfg is not None:
+                # the draft shards through the SAME logical-axis rules:
+                # heads/mlp/vocab split on `model`, embed replicated —
+                # its psums ride the same ICI the target's do
+                self._draft_param_sh = param_shardings(
+                    mesh, tfm.param_logical_axes(self.draft_cfg), GEN_RULES
+                )
         self.params = self.prepare_params(params)
+        self.draft_params = (
+            self._prepare_params_for(
+                self._draft.params, self.draft_cfg.dtype,
+                self._draft_param_sh if mesh is not None else None,
+            )
+            if self._draft is not None
+            else None
+        )
         self.B = max_slots
         self.page = page_size
         self.M = -(-max_seqlen // page_size)      # table width (pages/slot)
@@ -281,6 +399,16 @@ class GenerationEngine:
                 fallback_token=jnp.zeros((self.B,), jnp.int32),
                 sp=SamplingParams.filled(self.B),
                 rng=jax.random.key(seed),
+                # the draft pool mirrors the target pool's page count so
+                # one page index addresses both (lockstep alloc/free)
+                draft_cache=(
+                    tfm.PagedKVCache.empty(
+                        self.draft_cfg, self.n_pages, page_size,
+                        kv_dtype="int8" if self.draft_kv_quantized else None,
+                    )
+                    if self._draft is not None
+                    else None
+                ),
             )
 
         if mesh is None:
@@ -298,6 +426,19 @@ class GenerationEngine:
                 cache=tfm.PagedKVCache(
                     pages=self._pages_sh,
                     scales=self._scales_sh if self.kv_quantized else None,
+                ),
+                # the draft pool has the same [L, P, 2, Hkv, page, D]
+                # layout, so it takes the same kv-head-axis TP split
+                draft_cache=(
+                    tfm.PagedKVCache(
+                        pages=self._pages_sh,
+                        scales=(
+                            self._scales_sh
+                            if self.draft_kv_quantized else None
+                        ),
+                    )
+                    if self._draft is not None
+                    else None
                 ),
             )
             self._state_sh = sh
@@ -328,25 +469,10 @@ class GenerationEngine:
         # chunks amortize one params+pool sweep over K+1 candidate tokens;
         # exactly distribution-preserving, so togglable between chunks
         # (``spec`` is read once per step() under the engine lock)
-        self.spec = (
-            spec_decode
-            if spec_decode is not None
-            else constants.spec_decode_enabled()
-        )
+        self.spec = spec_on
         self.spec_k = max(
             1, spec_k if spec_k is not None else constants.spec_k()
         )
-        self.drafter: Drafter = drafter if drafter is not None else NGramDrafter()
-        if not getattr(self.drafter, "deterministic", True):
-            # the spec chunk calls spec_rejection_sample without proposal
-            # logprobs, which is only distribution-preserving for one-hot
-            # proposals — accepting a sampled drafter here would silently
-            # bias generation toward its proposals (PPO corruption)
-            raise NotImplementedError(
-                "non-deterministic drafters need their proposal logprobs "
-                "threaded into spec_rejection_sample (q_logprobs); the "
-                "engine only wires one-hot (deterministic) drafters today"
-            )
         self._prev_flags = None           # chunk k's undonated flag outputs
         self._prev_running: tuple = ()    # (slot, epoch) pairs at k's dispatch
         self._steps_ahead = 0   # token-advance bound of the in-flight chunk
@@ -425,13 +551,25 @@ class GenerationEngine:
         """Configured KV-pool HBM footprint (pages + quant scales),
         computed from shapes — no device pull. The serving gauge the
         fleet aggregator watches for HBM headroom."""
-        cfg = self.cfg
+        return self._pool_bytes_for(self.cfg, self.kv_quantized)
+
+    def _pool_bytes_for(self, cfg: ModelConfig, quantized: bool) -> int:
         elems = cfg.n_layers * self.n_pages * 2 * cfg.n_kv_heads * self.page
-        item = 1 if self.kv_quantized else jnp.dtype(cfg.dtype).itemsize
+        item = 1 if quantized else jnp.dtype(cfg.dtype).itemsize
         total = elems * cfg.head_dim * item
-        if self.kv_quantized:
+        if quantized:
             total += elems * 4  # one f32 scale per (token slot, head, K|V)
         return total
+
+    def draft_kv_pool_bytes(self) -> int:
+        """Configured HBM footprint of the draft model's KV pool (0 when
+        no draft model is configured): same page count as the target pool
+        — the pools share page indices — at the draft's layer/head shape
+        and its own (int8-quantizable) storage dtype. The sizing math the
+        freed int8 headroom argument rests on (docs/performance.md)."""
+        if self._draft is None:
+            return 0
+        return self._pool_bytes_for(self.draft_cfg, self.draft_kv_quantized)
 
     def kv_pool_occupancy(self) -> float:
         """Fraction of pool pages currently held (slots + prefix cache)."""
@@ -449,31 +587,88 @@ class GenerationEngine:
     def _observe_occupancy(self):
         """Fold the current pool occupancy into the telemetry histogram —
         host arithmetic riding a chunk dispatch the engine already pays."""
-        metrics_mod.counters.observe(
-            metrics_mod.GEN_KV_POOL_OCCUPANCY, self.kv_pool_occupancy()
-        )
+        occ = self.kv_pool_occupancy()
+        metrics_mod.counters.observe(metrics_mod.GEN_KV_POOL_OCCUPANCY, occ)
+        if self._draft is not None:
+            # lockstep pools: the draft pool's occupancy IS the target
+            # pool's, but it gets its own histogram so a fleet scraper
+            # can see draft HBM pressure without knowing the linkage
+            metrics_mod.counters.observe(
+                metrics_mod.GEN_DRAFT_KV_POOL_OCCUPANCY, occ
+            )
 
-    def prepare_params(self, params):
-        """Cast a (host or device) param pytree to the serving dtype and,
-        when TP-sharded, place each leaf on its mesh shard. Numpy leaves cast
-        on host so no full-size unsharded buffer ever lands on one device."""
-        dt = jnp.dtype(self.cfg.dtype)
+    def _prepare_params_for(self, params, dtype, shardings):
+        """Cast a (host or device) param pytree to ``dtype`` and, when
+        ``shardings`` is given (TP serving), place each leaf on its mesh
+        shard. Numpy leaves cast on host so no full-size unsharded buffer
+        ever lands on one device."""
+        dt = jnp.dtype(dtype)
         params = jax.tree.map(
             lambda x: x if x.dtype == dt else x.astype(dt), params
         )
-        if self.mesh is not None:
-            return jax.device_put(params, self._param_sh)
+        if shardings is not None:
+            return jax.device_put(params, shardings)
         return jax.tree.map(jnp.asarray, params)
 
-    def update_params(self, params, version: Optional[int] = None):
+    def prepare_params(self, params):
+        """Serving-dtype cast + (when TP-sharded) mesh placement for the
+        TARGET model's params."""
+        return self._prepare_params_for(
+            params, self.cfg.dtype,
+            self._param_sh if self.mesh is not None else None,
+        )
+
+    def prepare_draft_params(self, params):
+        """Same contract for the DRAFT model's params."""
+        if self._draft is None:
+            raise ValueError("engine has no draft model configured")
+        return self._prepare_params_for(
+            params, self.draft_cfg.dtype,
+            self._draft_param_sh if self.mesh is not None else None,
+        )
+
+    def update_params(
+        self,
+        params,
+        version: Optional[int] = None,
+        draft_params=None,
+    ):
         """Hot weight swap between decode chunks (≈ interrupt + reload).
         Invalidates the prefix cache: prompt KV computed under old weights
-        must not seed new generations."""
+        must not seed new generations.
+
+        ``draft_params`` optionally rides along: the weight-fanout channel
+        pushes refreshed draft weights NEXT TO the policy weights so the
+        draft keeps tracking the policy during RL (a drifting draft only
+        costs accept rate, never correctness — but accept rate IS the
+        speedup). Both swaps land under one lock acquisition / one prefix
+        invalidation."""
         if self.mesh is not None:
             params = jax.device_put(params, self._param_sh)
+        if draft_params is not None:
+            draft_params = self.prepare_draft_params(draft_params)
         with self._lock:
             self.params = params
+            if draft_params is not None:
+                self.draft_params = draft_params
+                self.draft_version += 1
             self.version = version if version is not None else self.version + 1
+            self.prefix.clear()
+
+    def update_draft_params(self, draft_params):
+        """Swap ONLY the draft model's weights between chunks. Does NOT
+        bump the policy ``version`` — spec decode is exactly distribution-
+        preserving, so outputs (and their staleness tags) are unaffected —
+        but bumps ``draft_version`` and clears the prefix cache: cached
+        pages hold draft KV computed under the old draft weights, and
+        while stale draft KV can only lower accept rate, a fresh draft
+        should not propose from it. In-flight slots keep their resident
+        draft context (the same partial-rollout staleness the target's
+        swap tolerates)."""
+        draft_params = self.prepare_draft_params(draft_params)
+        with self._lock:
+            self.draft_params = draft_params
+            self.draft_version += 1
             self.prefix.clear()
 
     def partial_outputs(
@@ -603,28 +798,74 @@ class GenerationEngine:
         if key in self._jit_extend:
             return self._jit_extend[key]
         cfg = self.cfg
+        dcfg = self.draft_cfg
 
-        def extend(params, state: GenState, tokens, table_rows, start, n_new):
-            cache = tfm.extend_paged(
-                params, cfg, state.cache, tokens, table_rows, start, n_new,
-                skip_pool=skip_pool,
-            )
-            return dataclasses.replace(state, cache=cache)
+        if self._draft is None:
 
-        jitted = jax.jit(extend, donate_argnums=(1,), **self._jit_sharding(4))
+            def extend(params, state: GenState, tokens, table_rows, start,
+                       n_new):
+                cache = tfm.extend_paged(
+                    params, cfg, state.cache, tokens, table_rows, start,
+                    n_new, skip_pool=skip_pool,
+                )
+                return dataclasses.replace(state, cache=cache)
+
+        else:
+            # draft-model serving: the prompt prefills BOTH pools in one
+            # program — the draft needs its own prompt KV before it can
+            # propose, and writing it here (same tokens, same tables,
+            # same waves) is what keeps the pools in lockstep through
+            # prefix sharing too (a borrowed page carries both models'
+            # KV, written once by the first prefill)
+            def extend(params, draft_params, state: GenState, tokens,
+                       table_rows, start, n_new):
+                cache = tfm.extend_paged(
+                    params, cfg, state.cache, tokens, table_rows, start,
+                    n_new, skip_pool=skip_pool,
+                )
+                dcache = tfm.extend_paged(
+                    draft_params, dcfg, state.draft_cache, tokens,
+                    table_rows, start, n_new, skip_pool=skip_pool,
+                )
+                return dataclasses.replace(
+                    state, cache=cache, draft_cache=dcache
+                )
+
+        jitted = jax.jit(
+            extend, donate_argnums=(self._state_argnum,),
+            **self._jit_sharding(4),
+        )
         self._jit_extend[key] = jitted
         return jitted
 
     def _jit_sharding(self, n_host_args: int, with_params: bool = True):
         """in/out sharding kwargs for the engine's jitted programs (empty
-        without a mesh): params on their TP shards, state on its (pool
-        sharded, rest replicated) shardings, host-side arrays replicated."""
+        without a mesh): params (target, then draft when a draft model is
+        configured) on their TP shards, state on its (pools sharded, rest
+        replicated) shardings, host-side arrays replicated."""
         if self.mesh is None:
             return {}
-        ins = ((self._param_sh,) if with_params else ()) + (
-            self._state_sh,
-        ) + (self._repl,) * n_host_args
+        ins = ()
+        if with_params:
+            ins += (self._param_sh,)
+            if self._draft is not None:
+                ins += (self._draft_param_sh,)
+        ins += (self._state_sh,) + (self._repl,) * n_host_args
         return {"in_shardings": ins, "out_shardings": self._state_sh}
+
+    def _model_args(self) -> tuple:
+        """Leading params arguments of every params-taking jitted program:
+        ``(params,)`` or ``(params, draft_params)`` — read per dispatch
+        under the engine lock, so hot swaps of either take effect at the
+        next chunk."""
+        if self._draft is not None:
+            return (self.params, self.draft_params)
+        return (self.params,)
+
+    @property
+    def _state_argnum(self) -> int:
+        """Donated-state position in the params-taking jitted programs."""
+        return 2 if self._draft is not None else 1
 
     def _commit_fn(self, n_rows: int):
         if n_rows in self._jit_commit:
@@ -707,7 +948,7 @@ class GenerationEngine:
                 skip_pool = c == 0 and not starts0.any()
                 extend = self._extend_fn(n, W, skip_pool)
                 self.state = extend(
-                    self.params, self.state,
+                    *self._model_args(), self.state,
                     jnp.asarray(all_tokens[:, c * C : (c + 1) * C]),
                     jnp.asarray(tables[:, :W]),
                     jnp.asarray(starts0 + c * C),
@@ -864,13 +1105,30 @@ class GenerationEngine:
             return self._jit_chunk[key]
         cfg = self.cfg
 
-        def one_step(state: GenState, params, table, warp_rows):
+        def one_step(state: GenState, params, draft_params, table, warp_rows):
             logits, cache, new_lens = tfm.decode_step_paged(
                 params, cfg, state.cache, state.last_tokens, table,
                 state.lens, state.active,
                 use_pallas=self._decode_use_pallas,
                 mesh=self.mesh,
             )
+            if self._draft is not None:
+                # keep the draft pool current: one HEADLESS draft decode
+                # step writes the draft model's KV of the token the
+                # target just consumed, at the same position with the
+                # same mask — so a spec chunk can take over mid-stream
+                # with a complete draft context (the draft-model
+                # counterpart of the ctx_tokens mirror below). Costs one
+                # small-model sweep per vanilla step, only on engines
+                # that configured a draft model.
+                _, draft_cache, _ = tfm.decode_step_paged(
+                    draft_params, self.draft_cfg, state.draft_cache,
+                    state.last_tokens, table, state.lens, state.active,
+                    use_pallas=self._decode_use_pallas, mesh=self.mesh,
+                    with_head=False,
+                )
+            else:
+                draft_cache = state.draft_cache
             if self.mesh is not None:
                 # one explicit all-gather of the [B, V] logits: sampling
                 # (sort-based top-k/top-p) runs replicated instead of
@@ -905,6 +1163,7 @@ class GenerationEngine:
             return dataclasses.replace(
                 state,
                 cache=cache,
+                draft_cache=draft_cache,
                 lens=new_lens,
                 last_tokens=tokens,
                 active=active,
@@ -915,16 +1174,30 @@ class GenerationEngine:
                 rng=rng,
             )
 
-        def chunk(params, state, table, warp_rows):
-            def body(s, _):
-                return one_step(s, params, table, warp_rows), None
+        if self._draft is None:
 
-            state, _ = jax.lax.scan(body, state, None, length=n_steps)
-            # harvest flags ride as UNDONATED aux outputs: the pipelined
-            # step pulls them AFTER dispatching the next chunk (whose
-            # donation consumes the state buffers themselves)
-            return state, (state.active, state.n_gen, state.max_gen,
-                           state.lens)
+            def chunk(params, state, table, warp_rows):
+                def body(s, _):
+                    return one_step(s, params, None, table, warp_rows), None
+
+                state, _ = jax.lax.scan(body, state, None, length=n_steps)
+                # harvest flags ride as UNDONATED aux outputs: the
+                # pipelined step pulls them AFTER dispatching the next
+                # chunk (whose donation consumes the state buffers)
+                return state, (state.active, state.n_gen, state.max_gen,
+                               state.lens)
+
+        else:
+
+            def chunk(params, draft_params, state, table, warp_rows):
+                def body(s, _):
+                    return one_step(
+                        s, params, draft_params, table, warp_rows
+                    ), None
+
+                state, _ = jax.lax.scan(body, state, None, length=n_steps)
+                return state, (state.active, state.n_gen, state.max_gen,
+                               state.lens)
 
         sharding_kw = self._jit_sharding(2)
         if sharding_kw:
@@ -935,7 +1208,9 @@ class GenerationEngine:
             sharding_kw["out_shardings"] = (
                 sharding_kw["out_shardings"], (self._repl,) * 4
             )
-        jitted = jax.jit(chunk, donate_argnums=(1,), **sharding_kw)
+        jitted = jax.jit(
+            chunk, donate_argnums=(self._state_argnum,), **sharding_kw
+        )
         self._jit_chunk[key] = jitted
         return jitted
 
@@ -960,13 +1235,10 @@ class GenerationEngine:
         C = K + 1
         B, G, S = self.B, self.G, self.S
 
-        def one_spec_step(state: GenState, params, table, warp_rows):
-            draft = self.drafter.propose(
-                state.ctx_tokens, state.lens, state.fallback_token, K
-            )                                             # [B, K]
-            chunk_toks = jnp.concatenate(
-                [state.last_tokens[:, None], draft], axis=1
-            )                                             # [B, C]
+        has_q = getattr(self.drafter, "provides_q_logprobs", False)
+
+        def one_spec_step(state: GenState, params, draft_params, table,
+                          warp_rows):
             pos_i = jnp.arange(C)[None, :]
             n_new = jnp.where(state.active, C, 0).astype(jnp.int32)
             # KV residency bound, acceptance-agnostic (see
@@ -976,6 +1248,35 @@ class GenerationEngine:
             write_mask = state.active[:, None] & (
                 state.n_gen[:, None] + pos_i < state.max_gen[:, None]
             )
+            if self._draft is not None:
+                # draft MODEL: K autoregressive small-model decode steps
+                # on the draft params + draft pool, sampling each token
+                # from its own (plain temperature-scaled) distribution
+                # and returning that distribution as q. The draft pool's
+                # writes take the same acceptance-agnostic bound as the
+                # verify scatter, over ALL C chunk positions — the final
+                # one is d_K's KV, which a fully-accepted step leaves
+                # resident (see propose_model's docstring).
+                rng0, r_draft = jax.random.split(state.rng)
+                draft, q_logprobs, draft_cache = self.drafter.propose_model(
+                    draft_params, state.draft_cache, state.last_tokens,
+                    table, state.lens, write_mask, state.sp,
+                    r_draft, K,
+                    use_pallas=self._decode_use_pallas, mesh=self.mesh,
+                    logits_sharding=(
+                        self._repl if self.mesh is not None else None
+                    ),
+                )
+            else:
+                rng0 = state.rng
+                draft = self.drafter.propose(
+                    state.ctx_tokens, state.lens, state.fallback_token, K
+                )                                         # [B, K]
+                q_logprobs = None
+                draft_cache = state.draft_cache
+            chunk_toks = jnp.concatenate(
+                [state.last_tokens[:, None], draft], axis=1
+            )                                             # [B, C]
             logits, cache = tfm.verify_step_paged(
                 params, cfg, state.cache, chunk_toks, table, state.lens,
                 n_new, write_mask,
@@ -986,13 +1287,18 @@ class GenerationEngine:
                 logits = jax.lax.with_sharding_constraint(
                     logits, self._repl
                 )
-            rng, sub = jax.random.split(state.rng)
+            rng, sub = jax.random.split(rng0)
             # same per-slot warp narrowing as the vanilla chunk: only the
-            # warping slots' K+1 verify rows pay the sort
-            a, cand, cand_lp, boundary_arg = spec_rejection_sample(
+            # warping slots' K+1 verify rows pay the sort. Sampled
+            # drafters feed the general-q branch; their per-position
+            # accept probability rides out as the draft-quality signal.
+            rej = spec_rejection_sample(
                 sub, logits, draft, state.sp, warp=warp_bucket > 0,
                 warp_rows=warp_rows if warp_bucket > 0 else None,
+                q_logprobs=q_logprobs, return_accept_prob=has_q,
             )
+            a, cand, cand_lp, boundary_arg = rej[:4]
+            q_acc_row = rej[4].mean(axis=1) if has_q else None  # [B]
             # masked variable-length advance: accepted drafts + one
             # residual token, capped at the remaining budget, truncated at
             # the first accepted stop token (stop included, like vanilla)
@@ -1040,42 +1346,62 @@ class GenerationEngine:
             drafted = jnp.where(state.active, K, 0).astype(jnp.int32)
             accepted = jnp.minimum(a, e).astype(jnp.int32)
             new_state = dataclasses.replace(
-                state, cache=cache, lens=new_lens, last_tokens=last_tokens,
-                active=active, n_gen=n_gen, out_tokens=out_tokens,
-                out_logprobs=out_logprobs, ctx_tokens=ctx_tokens,
-                fallback_token=fallback, rng=rng,
+                state, cache=cache, draft_cache=draft_cache, lens=new_lens,
+                last_tokens=last_tokens, active=active, n_gen=n_gen,
+                out_tokens=out_tokens, out_logprobs=out_logprobs,
+                ctx_tokens=ctx_tokens, fallback_token=fallback, rng=rng,
             )
-            return new_state, (drafted, accepted)
+            aux = (drafted, accepted)
+            if has_q:
+                aux += (jnp.where(state.active, q_acc_row, 0.0),)
+            return new_state, aux
 
-        def spec_chunk(params, state, table, warp_rows):
+        n_aux = 7 if has_q else 6
+
+        def spec_body(params, draft_params, state, table, warp_rows):
             def body(s, _):
-                return one_spec_step(s, params, table, warp_rows)
+                return one_spec_step(s, params, draft_params, table,
+                                     warp_rows)
 
-            state, (drafted, accepted) = jax.lax.scan(
-                body, state, None, length=n_steps
-            )
+            state, aux = jax.lax.scan(body, state, None, length=n_steps)
             # same 4-flag harvest protocol as the vanilla chunk, plus the
-            # per-step [n_steps, B] draft/accept grids the host folds into
-            # telemetry on the sync it already pays
+            # per-step [n_steps, B] draft/accept grids (and, for sampled
+            # drafters, the mean accept-probability grid) the host folds
+            # into telemetry on the sync it already pays
             return state, (state.active, state.n_gen, state.max_gen,
-                           state.lens, drafted, accepted)
+                           state.lens) + aux
+
+        if self._draft is None:
+
+            def spec_chunk(params, state, table, warp_rows):
+                return spec_body(params, None, state, table, warp_rows)
+
+        else:
+
+            def spec_chunk(params, draft_params, state, table, warp_rows):
+                return spec_body(params, draft_params, state, table,
+                                 warp_rows)
 
         sharding_kw = self._jit_sharding(2)
         if sharding_kw:
             sharding_kw = dict(sharding_kw)
             sharding_kw["out_shardings"] = (
-                sharding_kw["out_shardings"], (self._repl,) * 6
+                sharding_kw["out_shardings"], (self._repl,) * n_aux
             )
-        jitted = jax.jit(spec_chunk, donate_argnums=(1,), **sharding_kw)
+        jitted = jax.jit(
+            spec_chunk, donate_argnums=(self._state_argnum,), **sharding_kw
+        )
         self._jit_spec[key] = jitted
         return jitted
 
-    def _fold_spec_stats(self, drafted, accepted):
-        """Fold one spec chunk's ``[n_steps, B]`` drafted/accepted grids
-        into engine stats + telemetry counters — host bookkeeping riding
-        the per-chunk sync the engine already pays, no extra pulls."""
-        drafted = np.asarray(drafted)
-        accepted = np.asarray(accepted)
+    def _fold_spec_stats(self, aux):
+        """Fold one spec chunk's ``[n_steps, B]`` aux grids — drafted and
+        accepted counts, plus (for sampled/general-q drafters) the mean
+        per-position acceptance probability — into engine stats +
+        telemetry counters. Host bookkeeping riding the per-chunk sync
+        the engine already pays, no extra pulls."""
+        drafted = np.asarray(aux[0])
+        accepted = np.asarray(aux[1])
         d = int(drafted.sum())
         if d == 0:
             return
@@ -1089,6 +1415,25 @@ class GenerationEngine:
             metrics_mod.counters.observe(
                 metrics_mod.GEN_SPEC_ACCEPT_LEN, float(v), n=int(c)
             )
+        if len(aux) > 2:
+            # general-q drafter: per-(step, slot) mean accept probability.
+            # The grid is CONTINUOUS floats (np.unique would give no
+            # compression, i.e. one lock-guarded observe per slot-step),
+            # so pre-bucket against the histogram's own edges and observe
+            # each occupied bucket once at its in-bucket mean — exact
+            # bucket placement (digitize right=True == the histogram's
+            # bisect_left) and exact total sum, <= n_edges+1 observes.
+            q_acc = np.asarray(aux[2])[drafted > 0]
+            idx = np.digitize(
+                q_acc, metrics_mod.SPEC_Q_ACCEPT_PROB_BOUNDARIES,
+                right=True,
+            )
+            for i in np.unique(idx):
+                sel = q_acc[idx == i]
+                metrics_mod.counters.observe(
+                    metrics_mod.GEN_SPEC_Q_ACCEPT_PROB,
+                    float(sel.mean()), n=int(sel.size),
+                )
 
     def _warp_bucket(self, n: int) -> int:
         """Power-of-two capacity bucket for the warping-slot index operand
@@ -1121,6 +1466,36 @@ class GenerationEngine:
         warp_idx[: len(warp_slots)] = warp_slots
         make = self._spec_chunk_fn if self.spec else self._chunk_fn
         return make, tok_bound, wb, warp_idx
+
+    def _dispatch_chunk(self, chunk, W: int, warp_idx) -> tuple:
+        """Dispatch one decode chunk and START its harvest-flag D2H copy
+        in the same breath: ``copy_to_host_async`` enqueues the transfer
+        directly behind the chunk on the device stream, so by the time
+        anyone resolves the flags (immediately in unpipelined mode, one
+        chunk later in pipelined mode) the bytes are already on — or on
+        their way to — the host, and the resolve needs NO fresh
+        host->device round trip. This is the flags' version of the
+        ``_steps_ahead`` output protocol: start the copy at dispatch,
+        consume it later."""
+        self.state, flags = chunk(
+            *self._model_args(), self.state,
+            jnp.asarray(self._table_host[:, :W]), jnp.asarray(warp_idx),
+        )
+        for f in flags:
+            f.copy_to_host_async()
+        return flags
+
+    def _resolve_flags(self, flags: tuple) -> tuple:
+        """Materialize a dispatched chunk's flag tuple on host. The copy
+        was started at dispatch, so in pipelined steady state this is a
+        buffer read, not a device sync — the ``blocked`` counter records
+        every resolve that still had to wait (the event-log proof the
+        zero-blocking-sync test pins at 0)."""
+        metrics_mod.counters.add(metrics_mod.GEN_CHUNK_FLAG_FETCHES)
+        if not all(f.is_ready() for f in flags):
+            metrics_mod.counters.add(metrics_mod.GEN_CHUNK_FLAG_BLOCKED)
+        # arealint: ok(resolving the dispatch-ahead flag copy, not a pull)
+        return tuple(np.asarray(f) for f in flags)
 
     def _pull_outputs(self) -> dict:
         """ONE device pull of every slot's accumulated outputs + flags."""
@@ -1196,15 +1571,14 @@ class GenerationEngine:
             )
             self._observe_occupancy()
             chunk = make(decode_steps, W, wb)
-            self.state, flags = chunk(
-                self.params, self.state,
-                jnp.asarray(self._table_host[:, :W]), jnp.asarray(warp_idx),
+            # one host sync per chunk; the flag copy was enqueued at
+            # dispatch, so the resolve costs no extra round trip
+            flags = self._resolve_flags(
+                self._dispatch_chunk(chunk, W, warp_idx)
             )
-            # one host sync per chunk
-            flags = jax.device_get(flags)
             active, n_gen, max_gen, lens = flags[:4]
             if len(flags) > 4:
-                self._fold_spec_stats(flags[4], flags[5])
+                self._fold_spec_stats(flags[4:])
             self._lens_host[:] = lens
             finished = [
                 b for b, info in enumerate(self._slots)
@@ -1240,10 +1614,7 @@ class GenerationEngine:
             )
             self._observe_occupancy()
             chunk = make(decode_steps, W, wb)
-            self.state, new_flags = chunk(
-                self.params, self.state,
-                jnp.asarray(self._table_host[:, :W]), jnp.asarray(warp_idx),
-            )
+            new_flags = self._dispatch_chunk(chunk, W, warp_idx)
             new_running = tuple(
                 (b, int(self._slot_epoch[b])) for b in running
             )
@@ -1253,11 +1624,13 @@ class GenerationEngine:
         self._steps_ahead = new_ahead
         if prev_flags is None:
             return []
-        # chunk k's flags resolved while k+1 computes: one overlapped RTT
-        prev_flags = jax.device_get(prev_flags)
+        # chunk k's flags landed on host while k (and now k+1) computed:
+        # the dispatch-ahead copy makes this resolve a buffer read in
+        # steady state — zero blocking syncs at the chunk boundary
+        prev_flags = self._resolve_flags(prev_flags)
         active, n_gen, max_gen, lens = prev_flags[:4]
         if len(prev_flags) > 4:
-            self._fold_spec_stats(prev_flags[4], prev_flags[5])
+            self._fold_spec_stats(prev_flags[4:])
         # epoch check: a slot that turned over since chunk k's dispatch now
         # holds a DIFFERENT request — k's stale flags must not touch it
         same = [

@@ -139,7 +139,8 @@ def spec_rejection_sample(
     greedy: Optional[jnp.ndarray] = None,
     q_logprobs: Optional[jnp.ndarray] = None,  # [B, K, V] proposal logprobs
     warp_rows: Optional[jnp.ndarray] = None,   # [W] warping-slot indices
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    return_accept_prob: bool = False,
+) -> Tuple[jnp.ndarray, ...]:
     """Speculative-decoding acceptance: accept a prefix of the draft, then
     sample ONE residual token from the normalized difference distribution.
 
@@ -168,6 +169,13 @@ def spec_rejection_sample(
     ``sample_tokens`` reports, so PPO consumes spec and vanilla
     trajectories identically. ``boundary_argmax`` is the target argmax at
     the emission boundary (the engine's drafter-fallback hint).
+
+    ``return_accept_prob`` (STATIC) appends ``accept_prob [B, K] f32`` —
+    the per-position acceptance probability ``min(1, p(d_i)/q(d_i))``
+    (the 0/1 accept indicator for greedy slots): the draft-model quality
+    signal the engine folds into the ``gen/spec_q_accept_prob``
+    histogram, independent of where the first rejection happened to
+    land this step.
     """
     B, C, V = logits.shape
     K = C - 1
@@ -240,6 +248,13 @@ def spec_rejection_sample(
         pos < a[:, None], draft_pad, res_tok[:, None]
     ).astype(jnp.int32)
     lps = jnp.where(pos < a[:, None], dlp_pad, res_lp[:, None])
+    if return_accept_prob:
+        acc_p = jnp.where(
+            greedy[:, None],
+            accept.astype(jnp.float32),
+            jnp.minimum(jnp.exp(log_ratio), 1.0),
+        )
+        return a.astype(jnp.int32), tokens, lps, boundary_argmax, acc_p
     return a.astype(jnp.int32), tokens, lps, boundary_argmax
 
 

@@ -889,7 +889,8 @@ def decode_step_paged(
     active: jnp.ndarray,       # [B] bool
     use_pallas: Optional[bool] = None,
     mesh=None,
-) -> Tuple[jnp.ndarray, PagedKVCache, jnp.ndarray]:
+    with_head: bool = True,
+) -> Tuple[Optional[jnp.ndarray], PagedKVCache, jnp.ndarray]:
     """One decode step over the page pool. Returns (fp32 logits ``[B, V]``,
     cache, new lens — incremented where active). The pool is read-only in
     the layer scan; each layer's fresh K/V merges into attention as the
@@ -899,7 +900,13 @@ def decode_step_paged(
     (TP serving) routes the kernel through ``shard_map`` over the kv-head
     axis — each model shard runs Pallas on its local pool slice —
     because bare ``pallas_call`` has no GSPMD partitioning rule and would
-    otherwise force a full-pool all-gather."""
+    otherwise force a full-pool all-gather.
+
+    ``with_head=False`` (STATIC) skips the final norm + LM head and
+    returns ``None`` logits: the cache-maintenance step the engine's
+    vanilla chunk runs for a configured draft model only needs the KV
+    writes — the head matmul (the biggest single matmul of a small
+    model's step at a 152k vocab) would be dead weight."""
     from areal_tpu.ops import paged_attention as paged_ops
 
     positions = lens
@@ -939,5 +946,7 @@ def decode_step_paged(
         cache, ks[:, :, None], vs[:, :, None], table,
         positions[:, None], active[:, None],
     )
+    if not with_head:
+        return None, cache, new_lens
     x = _norm(cfg, _cast(cfg, params["final_ln"]), x)
     return _head(cfg, params, x), cache, new_lens

@@ -117,6 +117,24 @@ def make_mesh(
     return Mesh(devs, ("data", "fsdp", "ctx", "model"))
 
 
+def check_tp_divisibility(cfg, tp: int, role: str = "model"):
+    """Validate that a ``ModelConfig``'s TP-sharded dims divide by the
+    model-axis size — raised at construction, not deep inside a trace.
+    Shared by the generation engine's target AND draft models (the draft
+    shards through the same logical-axis rules, so it has the same
+    divisibility contract)."""
+    for dim, name in (
+        (cfg.n_kv_heads, "n_kv_heads"),
+        (cfg.n_q_heads, "n_q_heads"),
+        (cfg.vocab_size, "vocab_size"),
+    ):
+        if dim % tp != 0:
+            raise ValueError(
+                f"tensor-parallel {role} needs {name} ({dim}) divisible "
+                f"by the model-axis size {tp}"
+            )
+
+
 def logical_to_pspec(
     axes: Optional[Tuple[Optional[str], ...]],
     rules: Optional[Dict[str, Optional[str]]] = None,

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from areal_tpu.base import metrics as metrics_mod
-from areal_tpu.gen.drafter import NGramDrafter
+from areal_tpu.gen.drafter import NGramDrafter, TransformerDrafter
 from areal_tpu.gen.engine import GenerationEngine, GenRequest
 from areal_tpu.gen.sampling import SamplingParams, spec_rejection_sample
 from areal_tpu.models import transformer as tfm
@@ -385,9 +385,11 @@ class TestComposition:
 
 
 def test_nondeterministic_drafter_rejected_at_construction(params):
-    """The engine only wires one-hot drafters today: a sampled drafter
-    without threaded q_logprobs would silently bias generation (the
-    distribution-preservation guarantee) — it must fail loudly."""
+    """Sampled drafters must declare provides_q_logprobs (and route
+    through the model-drafter interface): one without q would silently
+    bias generation toward its proposals (the distribution-preservation
+    guarantee) — it must fail loudly, while drafters that DO supply q
+    (TransformerDrafter) construct fine."""
     from areal_tpu.gen.drafter import Drafter
 
     class SampledDrafter(Drafter):
@@ -403,6 +405,366 @@ def test_nondeterministic_drafter_rejected_at_construction(params):
             CFG, params, max_slots=2, max_seqlen=64,
             spec_decode=True, drafter=SampledDrafter(),
         )
+
+    # declaring q without the propose_model wiring is equally loud: the
+    # engine would otherwise call propose() and its q would never reach
+    # the rejection sampler
+    class LyingDrafter(Drafter):
+        deterministic = False
+        provides_q_logprobs = True
+
+        def propose(self, ctx_tokens, lens, fallback, k):  # pragma: no cover
+            raise AssertionError("never reached")
+
+    with pytest.raises(NotImplementedError, match="TransformerDrafter"):
+        GenerationEngine(
+            CFG, params, max_slots=2, max_seqlen=64,
+            spec_decode=True, drafter=LyingDrafter(),
+        )
+
+    # the relaxed guard's positive side: a sampled drafter that supplies
+    # q through the model interface constructs (and serves) fine
+    eng = GenerationEngine(
+        CFG, params, max_slots=2, max_seqlen=64, spec_decode=True,
+        drafter=TransformerDrafter.shared_prefix(CFG, params, 1),
+    )
+    assert eng._draft is not None
+
+    # vocab mismatch is a construction error, not a runtime surprise
+    bad_cfg = dataclasses.replace(CFG, vocab_size=64, n_layers=1)
+    with pytest.raises(ValueError, match="vocab"):
+        GenerationEngine(
+            CFG, params, max_slots=2, max_seqlen=64, spec_decode=True,
+            drafter=TransformerDrafter(
+                bad_cfg, tfm.init_params(bad_cfg, jax.random.key(0))
+            ),
+        )
+
+
+def test_env_draft_model_ignored_when_spec_disabled(params, monkeypatch):
+    """A fleet-wide AREAL_SPEC_DRAFT_MODEL must not make a spec-disabled
+    engine pay for a draft model (pool HBM + a per-vanilla-step
+    maintenance sweep): the env-knob checkpoint is only resolved when
+    spec decode is on, so construction with spec off never even touches
+    the path (a bogus one proves it)."""
+    from areal_tpu.base import constants
+
+    monkeypatch.setenv(constants.SPEC_DRAFT_MODEL_ENV, "/nonexistent/draft")
+    eng = GenerationEngine(
+        CFG, params, max_slots=2, max_seqlen=64, spec_decode=False,
+    )
+    assert eng._draft is None
+    assert isinstance(eng.drafter, NGramDrafter)
+    assert eng.state.draft_cache is None
+    assert eng.draft_kv_pool_bytes() == 0
+
+
+def test_draft_dtype_coerced_into_drafter_cfg(params):
+    """The engine coerces a draft checkpoint's dtype to the target's —
+    and must write it back into the drafter, because propose_model runs
+    the draft forward under the DRAFTER's cfg: leaving the checkpoint
+    dtype there would compute spec-chunk proposals in one dtype while
+    the vanilla chunk's maintenance step writes KV in another."""
+    dcfg = dataclasses.replace(CFG, n_layers=1, dtype="bfloat16")
+    drafter = TransformerDrafter(
+        dcfg, tfm.init_params(dcfg, jax.random.key(7), dtype="bfloat16")
+    )
+    eng = GenerationEngine(
+        CFG, params, max_slots=2, max_seqlen=64, spec_decode=True,
+        drafter=drafter,
+    )
+    assert eng.draft_cfg.dtype == CFG.dtype == "float32"
+    assert eng.drafter.cfg.dtype == "float32"
+    leaf = jax.tree.leaves(eng.draft_params)[0]
+    assert leaf.dtype == jnp.float32
+
+
+class TestTransformerDrafter:
+    """Draft-MODEL speculative decoding: a small transformer proposes K
+    tokens autoregressively inside the jitted chunk, with its own paged
+    KV pool riding the engine state in lockstep with the target's, and
+    its proposal distribution feeding the general-q rejection sampler."""
+
+    def _draft_engine(self, params, n_layers=1, drafter=None, **kw):
+        drafter = drafter or TransformerDrafter.shared_prefix(
+            CFG, params, n_layers
+        )
+        return _engine(params, True, drafter=drafter, **kw)
+
+    def test_greedy_token_exact_vs_vanilla_any_draft(self, params, rng):
+        """Greedy draft-model spec decode must be token-exact vs vanilla
+        — even when the draft is an INDEPENDENT random-init model whose
+        proposals are garbage (acceptance can only cost speed, never
+        correctness), and with the q_accept_prob telemetry folding."""
+        metrics_mod.counters.clear(metrics_mod.GEN_SPEC_Q_ACCEPT_PROB)
+        prompts = _prompts(rng)
+        dcfg = dataclasses.replace(CFG, n_layers=1)
+        garbage = TransformerDrafter(
+            dcfg, tfm.init_params(dcfg, jax.random.key(123))
+        )
+        runs = {}
+        for name, eng in (
+            ("vanilla", _engine(params, False, max_slots=4)),
+            ("garbage", self._draft_engine(
+                params, drafter=garbage, max_slots=4, spec_k=3)),
+            ("prefix", self._draft_engine(params, max_slots=4, spec_k=3)),
+        ):
+            for i, p in enumerate(prompts):
+                eng.submit(GenRequest(
+                    rid=f"r{i}", input_ids=p, max_new_tokens=10 + i,
+                    greedy=True,
+                ))
+            runs[name] = {
+                o.rid: o for o in eng.run_until_done(decode_steps=3)
+            }
+        for name in ("garbage", "prefix"):
+            assert set(runs["vanilla"]) == set(runs[name])
+            for rid, ref in runs["vanilla"].items():
+                got = runs[name][rid]
+                assert ref.output_ids == got.output_ids, (name, rid)
+                assert ref.finish_reason == got.finish_reason
+                np.testing.assert_allclose(
+                    ref.output_logprobs, got.output_logprobs, atol=1e-4
+                )
+        h = metrics_mod.counters.histogram(
+            metrics_mod.GEN_SPEC_Q_ACCEPT_PROB
+        )
+        assert h is not None and h.count > 0
+
+    def test_first_token_marginal_chi_square_engine_general_q(self):
+        """The full engine path — draft model proposes sampled tokens
+        from q, verify scores, general-q rejection accepts — must leave
+        the FIRST emitted token distributed exactly as the target
+        (chi-square on a 32-token vocab against the target's softmax)."""
+        V32 = ModelConfig(
+            n_layers=2, n_q_heads=2, n_kv_heads=2, head_dim=8,
+            hidden_dim=16, intermediate_dim=32, vocab_size=32,
+            dtype="float32",
+        )
+        tparams = tfm.init_params(V32, jax.random.key(3))
+        dcfg = dataclasses.replace(V32, n_layers=1)
+        drafter = TransformerDrafter(
+            dcfg, tfm.init_params(dcfg, jax.random.key(77))
+        )
+        eng = GenerationEngine(
+            V32, tparams, max_slots=16, max_seqlen=32, spec_decode=True,
+            spec_k=2, drafter=drafter, enable_prefix_cache=False,
+        )
+        prompt = [3, 9, 4, 1]
+        n = 2048
+        counts = np.zeros(32)
+        r = 0
+        while int(counts.sum()) < n:
+            for i in range(16):
+                eng.submit(GenRequest(
+                    rid=f"{r}_{i}", input_ids=prompt, max_new_tokens=1,
+                    temperature=1.0,
+                ))
+            for o in eng.run_until_done(decode_steps=1):
+                counts[o.output_ids[0]] += 1
+            r += 1
+        T = len(prompt)
+        logits = tfm.forward_packed(
+            tparams, V32, jnp.asarray(prompt, jnp.int32),
+            jnp.ones((T,), jnp.int32), jnp.arange(T, dtype=jnp.int32),
+            remat=False,
+        )[-1]
+        want = np.asarray(jax.nn.softmax(logits))
+        total = counts.sum()
+        emp = counts / total
+        chi2 = (total * (emp - want) ** 2 / np.maximum(want, 1e-9)).sum()
+        # df = 31; p=0.001 critical value ~61.1 — generous margin (the
+        # run is seeded, so this is a one-time calibration, not a flake)
+        assert chi2 < 75.0, (chi2, emp, want)
+
+    def test_tp2_draft_greedy_matches_single_device(self, params, rng):
+        """Draft-model spec decode on a 2-way `model` mesh (draft params
+        + draft pool sharded through the same rules as the target) must
+        match the unsharded engine token for token."""
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("model",))
+        prompts = _prompts(rng)
+        eng1 = self._draft_engine(params, max_slots=4, spec_k=3)
+        eng2 = GenerationEngine(
+            CFG, params, max_slots=4, max_seqlen=128, spec_decode=True,
+            spec_k=3, mesh=mesh,
+            drafter=TransformerDrafter.shared_prefix(CFG, params, 1),
+        )
+        for eng in (eng1, eng2):
+            for i, p in enumerate(prompts):
+                eng.submit(GenRequest(
+                    rid=f"r{i}", input_ids=p, max_new_tokens=8, greedy=True,
+                ))
+        o1 = {o.rid: o for o in eng1.run_until_done(decode_steps=2)}
+        o2 = {o.rid: o for o in eng2.run_until_done(decode_steps=2)}
+        assert set(o1) == set(o2)
+        for rid in o1:
+            assert o1[rid].output_ids == o2[rid].output_ids, rid
+
+    def test_draft_page_lockstep_under_pause_resume(self, params, rng):
+        """Draft pages are the TARGET's pages (one index, two pools), so
+        pause must release everything back to the pool, the interrupted
+        partial must be a valid greedy prefix, and the resubmission —
+        re-prefilling BOTH pools — must complete the chain exactly."""
+        prompt = [int(x) for x in rng.integers(1, 128, size=5)]
+        ref_eng = _engine(params, False)
+        ref_eng.submit(GenRequest(
+            rid="ref", input_ids=prompt, max_new_tokens=12, greedy=True,
+        ))
+        ref = ref_eng.run_until_done(decode_steps=4)[0].output_ids
+
+        eng = self._draft_engine(
+            params, spec_k=3, enable_prefix_cache=False,
+        )
+        free0 = eng.pool.n_free
+        eng.submit(GenRequest(
+            rid="a", input_ids=prompt, max_new_tokens=12, greedy=True,
+        ))
+        eng.step(decode_steps=1)
+        assert eng.pool.n_free < free0          # pages held (both pools)
+        parts = eng.pause()
+        assert eng.pool.n_free == free0         # all released in lockstep
+        got = parts[0].output_ids
+        assert parts[0].finish_reason == "interrupted"
+        assert 0 < len(got) < 12 and got == ref[: len(got)]
+        eng.resume()
+        eng.submit(GenRequest(
+            rid="a2", input_ids=prompt + got,
+            max_new_tokens=12 - len(got), greedy=True,
+        ))
+        outs = eng.run_until_done(decode_steps=4)
+        assert got + outs[0].output_ids == ref
+        assert eng.draft_kv_pool_bytes() > 0
+
+    def test_draft_weight_swap_version_bump(self, params):
+        """update_draft_params bumps draft_version WITHOUT touching the
+        policy version (spec decode is distribution-preserving, greedy
+        outputs are unchanged); update_params(draft_params=...) swaps
+        both under one lock and bumps both versions."""
+        eng = self._draft_engine(params, max_slots=1, spec_k=2)
+        eng.submit(GenRequest(
+            rid="a", input_ids=[1, 2, 3], max_new_tokens=4, greedy=True,
+        ))
+        o0 = eng.run_until_done(decode_steps=2)[0]
+        dcfg = dataclasses.replace(CFG, n_layers=1)
+        new_draft = tfm.init_params(dcfg, jax.random.key(9))
+        eng.update_draft_params(new_draft)
+        assert eng.draft_version == 1 and eng.version == 0
+        assert len(eng.prefix) == 0
+        eng.submit(GenRequest(
+            rid="b", input_ids=[1, 2, 3], max_new_tokens=4, greedy=True,
+        ))
+        o1 = eng.run_until_done(decode_steps=2)[0]
+        assert o1.output_ids == o0.output_ids   # outputs untouched
+        assert o1.version == 0
+        # policy + draft ride-along: one pause window, both versions move
+        eng.update_params(
+            tfm.init_params(CFG, jax.random.key(11)), version=3,
+            draft_params=new_draft,
+        )
+        assert eng.version == 3 and eng.draft_version == 2
+
+    def test_mixed_vanilla_spec_traffic_bounded_compiles(self, params, rng):
+        """Toggling spec on/off on a draft-model engine (the vanilla
+        chunk maintains the draft pool with a headless draft step, so
+        both chunk kinds share one state pytree) must not grow jit
+        specializations past the warm set."""
+        eng = self._draft_engine(
+            params, max_slots=4, max_seqlen=256, page_size=16, spec_k=3,
+        )
+        eng.spec = False
+
+        def burst(tag, plens):
+            for i, plen in enumerate(plens):
+                eng.submit(GenRequest(
+                    rid=f"{tag}{i}",
+                    input_ids=[int(x) for x in rng.integers(1, 128, plen)],
+                    max_new_tokens=6, greedy=True,
+                ))
+            eng.run_until_done(decode_steps=3)
+
+        burst("v", [3, 9, 17, 33])
+        eng.spec = True
+        burst("s", [3, 9, 17, 33])
+        eng.spec = False
+        burst("v2", [5, 21])
+        eng.spec = True
+        warmed = eng.n_compiles()
+        eng.spec = False
+        burst("v3", [11, 29, 60])
+        eng.spec = True
+        burst("s2", [7, 45, 80])
+        assert eng.n_compiles() == warmed
+
+
+class TestChunkBoundarySync:
+    """The dispatch-ahead flag fetch: the harvest-flag D2H copy starts at
+    chunk dispatch and resolves one chunk later (pipelined mode), so
+    steady-state decode issues ZERO blocking device_get calls at chunk
+    boundaries — proven by trace (a counting device_get shim) plus the
+    engine's own blocked-resolve counter, the same event-log proof style
+    as the fwd_pipe overlap test."""
+
+    def test_steady_state_zero_blocking_device_get(self, params, monkeypatch):
+        eng = _engine(
+            params, False, max_slots=2, max_seqlen=512,
+            pipeline_chunks=True,
+        )
+        eng.submit(GenRequest(
+            rid="a", input_ids=[1, 2, 3, 4, 5], max_new_tokens=400,
+            greedy=True,
+        ))
+        eng.step(decode_steps=4)    # admit + first dispatch
+        eng.step(decode_steps=4)    # warm both pipeline stages
+        metrics_mod.counters.clear(metrics_mod.GEN_CHUNK_FLAG_FETCHES)
+        metrics_mod.counters.clear(metrics_mod.GEN_CHUNK_FLAG_BLOCKED)
+        calls = []
+        orig = jax.device_get
+        monkeypatch.setattr(
+            jax, "device_get",
+            lambda *a, **kw: (calls.append(a), orig(*a, **kw))[1],
+        )
+        n_chunks = 10
+        for _ in range(n_chunks):
+            eng.step(decode_steps=4)
+            # harness pacing only: wait out the in-flight chunk so the
+            # next resolve measures the protocol, not CPU scheduling
+            jax.block_until_ready(eng.state.lens)
+        assert calls == []          # the trace assertion: zero device_get
+        assert metrics_mod.counters.get(
+            metrics_mod.GEN_CHUNK_FLAG_FETCHES
+        ) == n_chunks
+        assert metrics_mod.counters.get(
+            metrics_mod.GEN_CHUNK_FLAG_BLOCKED
+        ) == 0
+        # the engine still harvests correctly after the window
+        monkeypatch.setattr(jax, "device_get", orig)
+        outs = eng.run_until_done(decode_steps=64)
+        assert outs and outs[0].finish_reason == "length"
+
+    def test_spec_chunk_flags_prefetch_too(self, params, rng):
+        """The same protocol covers spec chunks (their longer aux tuple
+        rides the same dispatch-ahead copy)."""
+        eng = _engine(
+            params, True, max_slots=2, max_seqlen=512, spec_k=3,
+            pipeline_chunks=True,
+        )
+        eng.submit(GenRequest(
+            rid="a",
+            input_ids=[int(x) for x in rng.integers(1, 128, 6)],
+            max_new_tokens=200, greedy=True,
+        ))
+        eng.step(decode_steps=2)
+        eng.step(decode_steps=2)
+        metrics_mod.counters.clear(metrics_mod.GEN_CHUNK_FLAG_BLOCKED)
+        for _ in range(5):
+            eng.step(decode_steps=2)
+            jax.block_until_ready(eng.state.lens)
+        assert metrics_mod.counters.get(
+            metrics_mod.GEN_CHUNK_FLAG_BLOCKED
+        ) == 0
+        assert eng.stats["spec_draft_tokens"] > 0
 
 
 class TestNGramDrafter:
@@ -465,30 +827,67 @@ class TestServingSurface:
             assert m["spec_decode"] is True and m["spec_k"] == 2
             assert "spec_accept_rate" in m
             assert "engine_spec_draft_tokens" in m
+            # draft-model gauges (no draft configured on this engine)
+            assert m["spec_draft_model"] is False
+            assert m["draft_kv_pool_bytes"] == 0
+            assert m["draft_version"] == 0
         finally:
             await client.close()
 
 
-@pytest.mark.slow
-def test_bench_gen_spec_stanza_end_to_end():
-    """The ``gen_spec`` bench A/B runs end-to-end on the CPU harness and
-    reports accept rate + accepted-tokens/s. The headline ``vs_baseline >
-    1.0`` acceptance bar is judged on chip (HBM-roofline economics); on
-    CPU the ratio is dominated by per-step dispatch, so this only pins
-    structure and a loose floor against regressions."""
+def _run_gen_spec_stanza():
+    """Shared three-arm ``gen_spec`` run for the tier-1 smoke and the
+    slow throughput-ordering pin: an 8-layer micro target (so the
+    2-layer shared-prefix draft is meaningfully cheaper) at a shape
+    whose slots stay live through every measured chunk."""
     import bench as bench_mod
 
-    out = bench_mod._bench_gen_spec(
-        819e9, 197e12, cfg=CFG, B=8, PLEN=64, D_STEPS=8, N_CHUNKS=3,
+    cfg8 = dataclasses.replace(
+        CFG, n_layers=8, dtype="float32",
+    )
+    return bench_mod._bench_gen_spec(
+        819e9, 197e12, cfg=cfg8, B=8, PLEN=128, D_STEPS=4, N_CHUNKS=3,
         motif_len=8,
     )
+
+
+def test_bench_gen_spec_stanza_end_to_end():
+    """The three-arm ``gen_spec`` bench (vanilla / n-gram / draft-model)
+    runs end-to-end on the CPU harness and the DETERMINISTIC draft-arm
+    acceptance bars hold: its accept rate beats the n-gram drafter's
+    (including the chip-measured 0.29). Accept rates are seeded greedy
+    token counts, so they are exact; the wall-clock throughput ORDERING
+    (draft_vs_baseline > vs_baseline) is real but CI-load-sensitive, so
+    tier-1 only floors it against pathology and the strict ordering is
+    pinned by the slow variant below (run unmarked locally + on chip).
+    Absolute ratios are judged on chip (HBM-roofline economics)."""
+    out = _run_gen_spec_stanza()
     assert set(out) >= {
         "vanilla_tokens_per_s", "accepted_tokens_per_s", "accept_rate",
-        "vs_baseline", "spec_k",
+        "vs_baseline", "spec_k", "draft_tokens_per_s", "draft_accept_rate",
+        "draft_vs_baseline", "draft_layers",
     }
     assert out["accepted_tokens_per_s"] > 0
     assert 0.0 < out["accept_rate"] <= 1.0
     assert out["vs_baseline"] > 0.8
+    # the draft-model acceptance bar (ISSUE 14): beat the n-gram's
+    # accept rate and its chip-measured 0.29 — deterministic, so strict
+    assert out["draft_accept_rate"] > max(0.29, out["accept_rate"])
+    # throughput sanity floor only (see docstring): CPU-timer noise on a
+    # loaded CI box must not flake tier-1
+    assert out["draft_vs_baseline"] > 0.75 * out["vs_baseline"]
+
+
+@pytest.mark.slow
+def test_bench_gen_spec_draft_beats_ngram_throughput():
+    """The strict CPU-smoke speed ordering (ISSUE 14 acceptance): the
+    draft arm's accepted-tokens/s vs_baseline beats the n-gram arm at
+    the same settings. Wall-clock comparison — slow-marked so a loaded
+    tier-1 CI box can't flake it; verified per-PR by the spec verify
+    driver and on every local/chip bench run."""
+    out = _run_gen_spec_stanza()
+    assert out["draft_accept_rate"] > max(0.29, out["accept_rate"])
+    assert out["draft_vs_baseline"] > out["vs_baseline"]
 
 
 # --------------------------------------------------------------------- #
