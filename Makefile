@@ -31,8 +31,11 @@ chaos:
 serve:
 	$(PYTHON) -m areal_tpu.gateway $(ARGS)
 
-# Full whole-program scan: areal_tpu/ tools/ tests/, project rules on,
-# baseline applied. This is what tier-1's TestFullTreeGate enforces.
+# Full whole-program scan: areal_tpu/ tools/ tests/, project rules on
+# (incl. the v4 resource-lifecycle typestate family), baseline applied.
+# This is what tier-1's TestFullTreeGate enforces. `make lint-rules`
+# lists the full catalog, lifecycle rules included — rule modules
+# register themselves through tools/arealint/__init__.py.
 lint:
 	$(PYTHON) -m tools.arealint
 

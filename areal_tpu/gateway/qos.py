@@ -98,6 +98,13 @@ class TokenBucket:
         return self._tokens
 
 
+def _service_share(cost: float, weight: float) -> float:
+    """One queue entry's virtual-time share. The SINGLE definition:
+    ``push`` stamps it, ``rollback`` reverses it — deriving it twice
+    would let the rollback amount drift from what the stamp advanced."""
+    return max(cost, 1.0) / max(weight, 1e-9)
+
+
 class WeightedFairQueue:
     """Start-time fair queue: O(tenants) pop, FIFO within a tenant.
 
@@ -120,7 +127,7 @@ class WeightedFairQueue:
 
     def push(self, tenant: str, cost: float, weight: float, item) -> None:
         start = max(self._vtime, self._last_vft.get(tenant, 0.0))
-        share = max(cost, 1.0) / max(weight, 1e-9)
+        share = _service_share(cost, weight)
         vft = start + share
         self._last_vft[tenant] = vft
         self._queues.setdefault(tenant, collections.deque()).append(
@@ -143,6 +150,23 @@ class WeightedFairQueue:
         self._vtime = max(self._vtime, vft)
         self._len -= 1
         return item
+
+    def rollback(self, tenant: str, cost: float, weight: float) -> None:
+        """Roll the tenant's virtual clock back for ONE already-popped
+        entry that never ran — the popped-entry twin of ``drop_where``'s
+        rollback, for the dispatch-side cancel race (the scheduler pops a
+        request, then discovers it was cancelled). Later queued entries
+        of the tenant (and its ``_last_vft``) shift earlier by the same
+        service share, so the cancelled work does not count against the
+        tenant's fair share."""
+        share = _service_share(cost, weight)
+        if tenant in self._last_vft:
+            self._last_vft[tenant] -= share
+        q = self._queues.get(tenant)
+        if q:
+            self._queues[tenant] = collections.deque(
+                (vft - share, s, it) for vft, s, it in q
+            )
 
     def drop_where(self, pred) -> int:
         """Remove queued items matching ``pred`` (client disconnects while

@@ -242,6 +242,7 @@ class ContinuousBatchScheduler:
                 "can never be admitted; lower max_tokens",
                 permanent=True,
             )
+        # arealint: owns(gateway.token-bucket, settled out of line — _run_request's finally refunds cost-minus-consumption, cancel() refunds queued drops, _dispatch_loop refunds the cancel-race pops)
         if not bucket.try_acquire(req.cost):
             raise RateLimited(
                 f"tenant {req.tenant!r} over its token rate limit",
@@ -249,6 +250,7 @@ class ContinuousBatchScheduler:
             )
         spec = self._tenant_spec(req.tenant)
         req.enqueue_t = self._clock()
+        # arealint: owns(gateway.wfq, drained by _dispatch_loop's pop; cancel() drops queued entries with the clock rollback)
         self._wfq.push(req.tenant, req.cost, spec.weight, req)
         metrics_mod.counters.add(metrics_mod.GW_REQUESTS)
         self._wake.set()
@@ -341,7 +343,21 @@ class ContinuousBatchScheduler:
                 if srv is None:
                     break  # a completion or capacity poll re-wakes us
                 req = self._wfq.pop()
-                if req is None or req.cancelled:
+                if req is None:
+                    continue
+                if req.cancelled:
+                    # cancel() raced the pop: its drop_where missed the
+                    # request (no longer queued) and no _run_request will
+                    # ever settle the charge — refund the full budget
+                    # here or the tenant's bucket leaks one request cost
+                    # per cancel-while-dispatching race. The fair-queue
+                    # virtual clock rolls back too: pop() advanced the
+                    # tenant's stamp for work that never ran
+                    self._bucket(req.tenant).refund(req.cost)
+                    self._wfq.rollback(
+                        req.tenant, req.cost,
+                        self._tenant_spec(req.tenant).weight,
+                    )
                     continue
                 srv.inflight += 1
                 t = asyncio.get_event_loop().create_task(

@@ -315,6 +315,7 @@ class GenerationHTTPServer:
         fut = asyncio.get_event_loop().create_future()
         self._futures[req.rid] = fut
         try:
+            # arealint: owns(gen.engine-slot, the engine loop harvests and releases the slot at finish; /generate serves RL rollout clients whose disconnects don't cancel by design — the sample is still wanted)
             self.engine.submit(req)
         except ValueError as e:
             self._futures.pop(req.rid, None)
@@ -348,6 +349,7 @@ class GenerationHTTPServer:
         self._stream_subs[req.rid] = q
         self._stream_sent[req.rid] = 0
         try:
+            # arealint: owns(gen.engine-slot, released by the engine's own harvest when 'finished', by the finally's _cancel_rid on disconnect/cancellation otherwise — the conditional is the protocol, not a gap)
             self.engine.submit(req)
         except ValueError as e:
             self._stream_subs.pop(req.rid, None)

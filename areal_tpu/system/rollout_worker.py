@@ -126,28 +126,67 @@ class RolloutWorker:
                 raise
             except Exception as e:
                 self._handle_rollout_failure(qid, prompt, e)
-                trajs, accepted = [], False
+                trajs, accepted, round_failed = [], False, True
             else:
                 accepted = len(trajs) > 0
-                self._attempts.pop(qid, None)
-            if trajs:
-                try:
-                    # scripted push-path failure (nothing delivered yet, so
-                    # the requeue this triggers cannot duplicate samples)
-                    faults.maybe_fail("rollout.push", qid=qid)
-                except faults.FaultInjected as e:
+                round_failed = False
+            n_pushed = 0
+            try:
+                if trajs:
+                    try:
+                        # scripted push-path failure (nothing delivered
+                        # yet, so the requeue this triggers cannot
+                        # duplicate samples)
+                        faults.maybe_fail("rollout.push", qid=qid)
+                    except faults.FaultInjected as e:
+                        self._handle_rollout_failure(qid, prompt, e)
+                        trajs, accepted, round_failed = [], False, True
+                for t in trajs:
+                    # lifecycle stamp: entering the rollout -> trainer
+                    # stream; consumption turns (pop - enqueue) into
+                    # queue_wait_s
+                    t.metadata["enqueue_time"] = [time.time()] * len(t.ids)
+                    if self.pusher.push(t.as_json_compatible()):
+                        n_pushed += 1
+                        self.push_cnt += 1
+                        metrics_mod.counters.add(metrics_mod.ROLLOUT_PUSHED)
+                if accepted:
+                    self.accepted_cnt += 1
+                    metrics_mod.counters.add(metrics_mod.ROLLOUT_ACCEPTED)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # an unexpected push-path crash must NOT skip the
+                # finish_rollout below: the manager's capacity slot (and
+                # the sticky qid->server mapping) would leak and tighten
+                # the admission gate for every future allocation. Requeue
+                # only when NOTHING was delivered — after a partial push
+                # a retry would duplicate samples, so (like a finish
+                # failure) we log and move on.
+                if n_pushed == 0:
                     self._handle_rollout_failure(qid, prompt, e)
-                    trajs, accepted = [], False
-            for t in trajs:
-                # lifecycle stamp: entering the rollout -> trainer stream;
-                # consumption turns (pop - enqueue) into queue_wait_s
-                t.metadata["enqueue_time"] = [time.time()] * len(t.ids)
-                if self.pusher.push(t.as_json_compatible()):
-                    self.push_cnt += 1
-                    metrics_mod.counters.add(metrics_mod.ROLLOUT_PUSHED)
-            if accepted:
-                self.accepted_cnt += 1
-                metrics_mod.counters.add(metrics_mod.ROLLOUT_ACCEPTED)
+                    accepted = False
+                    round_failed = True
+                else:
+                    logger.warning(
+                        "rollout %s push path failed after %d trajectories "
+                        "were delivered; not requeueing", qid, n_pushed,
+                        exc_info=True,
+                    )
+                    if accepted:
+                        # the finish below still reports accepted=True to
+                        # the manager; count it here too or the worker's
+                        # acceptance telemetry drifts one below the
+                        # manager's on every partial-push crash
+                        self.accepted_cnt += 1
+                        metrics_mod.counters.add(metrics_mod.ROLLOUT_ACCEPTED)
+            if not round_failed:
+                # the retry counter resets only after the WHOLE round
+                # (collect + deliver) succeeded — resetting at collect
+                # success would make a deterministic push crash (e.g.
+                # unserializable metadata) requeue forever instead of
+                # exhausting max_rollout_attempts and dropping
+                self._attempts.pop(qid, None)
             try:
                 # release the manager's capacity slot (and the sticky qid →
                 # server mapping) in every outcome; a requeued sample
@@ -250,11 +289,16 @@ class RolloutWorker:
                                     self._requeue.append(prompt)
                                 # else: duplicate in flight; move on
                             elif await self.allocate_new_rollout(session, qid):
-                                self._used_qids.add(f"{qid}@{self._epoch}")
-                                self._route_queue(qid)
+                                # the manager slot is held from here on:
+                                # hand it to the rollout task (whose every
+                                # exit path reaches finish_rollout) FIRST —
+                                # bookkeeping between allocate and task
+                                # creation is a leak window on exceptions
                                 self._tasks[qid] = asyncio.get_event_loop().create_task(
                                     self._rollout_task(session, prompt)
                                 )
+                                self._used_qids.add(f"{qid}@{self._epoch}")
+                                self._route_queue(qid)
                             else:
                                 # gate closed (capacity/staleness): keep this
                                 # sample and back off instead of spinning

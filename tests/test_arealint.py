@@ -711,6 +711,51 @@ class TestSarif:
                 "save_recover_checkpoint()",
                 "error",
             ),
+            # one finding per v4 lifecycle rule (docs/static_analysis.md
+            # "Lifecycle rules")
+            Finding(
+                "areal_tpu/gen/demo.py", 12, "leak-on-exception-path",
+                "gen.kv-pages acquired by pool.alloc() is not released "
+                "on every path out of admit() — release it in a finally "
+                "/ context manager, or annotate the deliberate handoff "
+                "with '# arealint: owns(gen.kv-pages, <reason>)'",
+                "error",
+            ),
+            Finding(
+                "areal_tpu/gen/demo.py", 55, "leak-on-cancellation",
+                "this await can be cancelled while gen.kv-pages "
+                "(acquired line 52 by pool.alloc()) is held — a "
+                "CancelledError skips the release on line 57; wrap the "
+                "window in try/finally (note: 'except Exception' does "
+                "not catch CancelledError)",
+                "error",
+            ),
+            Finding(
+                "areal_tpu/gen/demo.py", 80, "double-release",
+                "gen.kv-pages ('pages') is released again here — "
+                "already released on line 78 with no re-acquire in "
+                "between; the second release underflows the refcount "
+                "(double free)",
+                "error",
+            ),
+            Finding(
+                "areal_tpu/gateway/demo.py", 31, "release-without-acquire",
+                "gateway.token-bucket is released here on every path, "
+                "but the matching acquire (line 24) happens only on "
+                "some — the no-acquire path releases a resource it "
+                "never held; guard the release with the same condition "
+                "(or the handle's truthiness)",
+                "error",
+            ),
+            Finding(
+                "areal_tpu/gateway/demo.py", 24, "charge-refund-asymmetry",
+                "gateway.token-bucket charged by bucket.try_acquire() "
+                "is not released on every path out of submit() — refund "
+                "it on every exit (try/finally), hand it to a callee "
+                "that settles it, or annotate the deliberate handoff "
+                "with '# arealint: owns(gateway.token-bucket, <reason>)'",
+                "error",
+            ),
         ]
         rendered = sarif.dumps(
             findings,
@@ -720,6 +765,9 @@ class TestSarif:
                 "jit-weak-type-drift", "unknown-mesh-axis",
                 "shard-map-spec-arity", "hot-path-reshard",
                 "host-divergence-collective",
+                "leak-on-exception-path", "leak-on-cancellation",
+                "double-release", "release-without-acquire",
+                "charge-refund-asymmetry",
             ],
         ) + "\n"
         with open(self.GOLDEN, encoding="utf-8") as f:

@@ -1,0 +1,1023 @@
+"""Fixture tests for the arealint v4 lifecycle rule family
+(``tools/arealint/rules_lifecycle.py`` + the resource catalog in
+``tools/arealint/resources.py``).
+
+Every rule gets positive + negative + suppression fixtures (the
+acceptance contract from docs/static_analysis.md), plus
+ownership-transfer-through-callgraph cases, cancellation-shape fixtures
+(await between acquire and release), and the catalog-drift test pinning
+the parsed resource pairs against the runtime modules (same loud-drift
+contract as the mesh model).
+"""
+
+import importlib
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.arealint import (  # noqa: E402
+    Config,
+    DEFAULT_RESOURCE_DEFS,
+    ResourceCatalog,
+    ResourceSpec,
+    parse_resources,
+    scan_sources,
+)
+from tools.arealint.resources import spec_pairs  # noqa: E402
+
+pytestmark = pytest.mark.arealint
+
+
+def dedent(s):
+    return textwrap.dedent(s).lstrip()
+
+
+CAT = ResourceCatalog([
+    ResourceSpec(
+        name="test.pages", kind="handle",
+        acquires=(("Pool", "alloc"), ("Pool", "ref")),
+        releases=(("Pool", "release"),),
+        handle_from_arg=("ref",),
+    ),
+    ResourceSpec(
+        name="test.bucket", kind="charge",
+        acquires=(("Bucket", "try_acquire"),),
+        releases=(("Bucket", "refund"),),
+    ),
+    ResourceSpec(
+        name="test.slot", kind="charge",
+        acquires=(("Mgr", "allocate"),),
+        releases=(("Mgr", "finish"),),
+    ),
+    ResourceSpec(
+        name="test.span", kind="context",
+        func_acquires=("pkg.tracing.span",),
+    ),
+    ResourceSpec(
+        name="test.lease", kind="handle",
+        acquires=(("Lease", "start"),),
+        release_on_handle=("stop",),
+        handle_is_receiver=("start",),
+    ),
+    ResourceSpec(
+        name="test.session", kind="handle", external=True,
+        func_acquires=("aiohttp.ClientSession",),
+        release_on_handle=("close",),
+    ),
+])
+CFG = Config(resources=CAT)
+
+POOL = dedent(
+    """
+    class Pool:
+        def alloc(self, n): ...
+        def ref(self, pages): ...
+        def release(self, pages): ...
+    """
+)
+BUCKET = dedent(
+    """
+    class Bucket:
+        def try_acquire(self, cost): ...
+        def refund(self, amount): ...
+    """
+)
+
+
+def rules_of(sources, config=CFG):
+    return [f.rule for f in scan_sources(sources, config=config)]
+
+
+def findings(sources, rule, config=CFG):
+    return [f for f in scan_sources(sources, config=config) if f.rule == rule]
+
+
+def one(sources, rule, config=CFG):
+    found = findings(sources, rule, config=config)
+    assert len(found) == 1, (rule, [str(f) for f in scan_sources(
+        sources, config=config
+    )])
+    return found[0]
+
+
+# ------------------------------------------------------------------ #
+# leak-on-cancellation: the PR-10 orphaned-slot shape
+# ------------------------------------------------------------------ #
+
+
+class TestLeakOnCancellation:
+    def test_fires_on_await_between_acquire_and_release(self):
+        src = POOL + dedent(
+            """
+            async def work(pool: Pool):
+                pages = pool.alloc(2)
+                await chunk()
+                pool.release(pages)
+            """
+        )
+        f = one({"m.py": src}, "leak-on-cancellation")
+        assert f.line == 7  # the await, not the acquire
+        assert "test.pages" in f.message
+        assert "CancelledError" in f.message
+
+    def test_quiet_with_try_finally(self):
+        src = POOL + dedent(
+            """
+            async def work(pool: Pool):
+                pages = pool.alloc(2)
+                try:
+                    await chunk()
+                finally:
+                    pool.release(pages)
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+    def test_except_exception_does_not_protect_await(self):
+        # CancelledError is a BaseException: an `except Exception`
+        # cleanup arm never runs on cancellation
+        src = POOL + dedent(
+            """
+            async def work(pool: Pool):
+                pages = pool.alloc(2)
+                try:
+                    await chunk()
+                except Exception:
+                    pool.release(pages)
+                    raise
+                pool.release(pages)
+            """
+        )
+        assert "leak-on-cancellation" in rules_of({"m.py": src})
+
+    def test_except_base_exception_protects_await(self):
+        src = POOL + dedent(
+            """
+            async def work(pool: Pool):
+                pages = pool.alloc(2)
+                try:
+                    await chunk()
+                except BaseException:
+                    pool.release(pages)
+                    raise
+                pool.release(pages)
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+    def test_handle_from_arg_ref_shape(self):
+        src = POOL + dedent(
+            """
+            async def borrow(pool: Pool, pages):
+                pool.ref(pages)
+                await chunk()
+                pool.release(pages)
+            """
+        )
+        assert "leak-on-cancellation" in rules_of({"m.py": src})
+
+    def test_suppression_on_acquire_line(self):
+        src = POOL + dedent(
+            """
+            async def work(pool: Pool):
+                pages = pool.alloc(2)  # arealint: ok(fixture reason)
+                await chunk()
+                pool.release(pages)
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+    def test_suppression_on_await_line(self):
+        src = POOL + dedent(
+            """
+            async def work(pool: Pool):
+                pages = pool.alloc(2)
+                await chunk()  # arealint: ok(pause point is lock-free)
+                pool.release(pages)
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+
+# ------------------------------------------------------------------ #
+# leak-on-exception-path
+# ------------------------------------------------------------------ #
+
+
+class TestLeakOnExceptionPath:
+    def test_fires_on_unprotected_call_between(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool):
+                pages = pool.alloc(2)
+                compute(1)
+                pool.release(pages)
+            """
+        )
+        f = one({"m.py": src}, "leak-on-exception-path")
+        assert f.line == 6  # the acquire
+        assert "finally" in f.message
+
+    def test_fires_when_never_released(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool):
+                pages = pool.alloc(2)
+                return None
+            """
+        )
+        f = one({"m.py": src}, "leak-on-exception-path")
+        assert "not released on every path" in f.message
+
+    def test_fires_on_discarded_result(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool):
+                pool.alloc(2)
+            """
+        )
+        f = one({"m.py": src}, "leak-on-exception-path")
+        assert "discarded" in f.message
+
+    def test_quiet_with_context_manager_acquire(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool):
+                with pool.alloc(2) as pages:
+                    compute(pages)
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+    def test_quiet_when_release_in_except_handler_covers_risk(self):
+        # the _admit_pending shape: risky alloc inside try, handler
+        # releases the earlier acquire
+        src = POOL + dedent(
+            """
+            def admit(pool: Pool):
+                shared = pool.ref
+                pages = pool.alloc(1)
+                try:
+                    more = pool.alloc(4)
+                except RuntimeError:
+                    pool.release(pages)
+                    raise
+                pool.release(pages)
+                return more
+            """
+        )
+        assert findings({"m.py": src}, "leak-on-exception-path") == []
+
+    def test_owns_annotation_discharges(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool):
+                pages = pool.alloc(2)  # arealint: owns(test.pages, slot table owns them until harvest)
+                compute(pages)
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+    def test_owns_wrong_resource_name_does_not_discharge(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool):
+                pages = pool.alloc(2)  # arealint: owns(test.other, reason)
+                compute(1)
+            """
+        )
+        f = one({"m.py": src}, "leak-on-exception-path")
+        assert "malformed" in f.message
+
+    def test_owns_without_reason_does_not_discharge(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool):
+                pages = pool.alloc(2)  # arealint: owns(test.pages)
+                compute(1)
+            """
+        )
+        f = one({"m.py": src}, "leak-on-exception-path")
+        assert "malformed" in f.message
+
+    def test_released_only_on_some_paths(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool, keep):
+                pages = pool.alloc(2)
+                if keep:
+                    pool.release(pages)
+            """
+        )
+        f = one({"m.py": src}, "leak-on-exception-path")
+        assert "some paths" in f.message
+
+
+# ------------------------------------------------------------------ #
+# ownership transfer through the call graph
+# ------------------------------------------------------------------ #
+
+
+class TestOwnershipTransfer:
+    def test_resolved_releasing_callee_discharges(self):
+        src = POOL + dedent(
+            """
+            def cleanup(pool: Pool, pages):
+                pool.release(pages)
+
+            def work(pool: Pool):
+                pages = pool.alloc(2)
+                compute(1)
+                cleanup(pool, pages)
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+    def test_cross_module_transfer_discharges(self):
+        helper = POOL + dedent(
+            """
+            def cleanup(pool: Pool, pages):
+                pool.release(pages)
+            """
+        )
+        main = dedent(
+            """
+            from pkg.helper import cleanup
+            from pkg.helper import Pool
+
+            def work(pool: Pool):
+                pages = pool.alloc(2)
+                compute(1)
+                cleanup(pool, pages)
+            """
+        )
+        assert rules_of(
+            {"pkg/__init__.py": "", "pkg/helper.py": helper,
+             "pkg/main.py": main}
+        ) == []
+
+    def test_transitive_transfer_discharges(self):
+        src = POOL + dedent(
+            """
+            def inner(pool: Pool, pages):
+                pool.release(pages)
+
+            def outer(pool: Pool, pages):
+                inner(pool, pages)
+
+            def work(pool: Pool):
+                pages = pool.alloc(2)
+                outer(pool, pages)
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+    def test_unresolvable_callee_degrades(self):
+        src = POOL + dedent(
+            """
+            import external
+
+            def work(pool: Pool):
+                pages = pool.alloc(2)
+                external.take(pages)
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+    def test_storing_callee_degrades(self):
+        src = POOL + dedent(
+            """
+            class Table:
+                def keep(self, pages):
+                    self.rows = pages
+
+            def work(pool: Pool, table: Table):
+                pages = pool.alloc(2)
+                table.keep(pages)
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+    def test_resolved_non_releasing_callee_is_plain_use(self):
+        src = POOL + dedent(
+            """
+            def log_pages(pages):
+                print(pages)
+
+            def work(pool: Pool):
+                pages = pool.alloc(2)
+                log_pages(pages)
+            """
+        )
+        f = one({"m.py": src}, "leak-on-exception-path")
+        assert "not released on every path" in f.message
+
+    def test_store_and_return_degrade(self):
+        src = POOL + dedent(
+            """
+            class Slots:
+                def __init__(self, pool: Pool):
+                    self.held = None
+                    self.pool = pool
+
+                def admit(self):
+                    pages = self.pool.alloc(2)
+                    self.held = pages
+
+                def lookup(self):
+                    pages = self.pool.alloc(2)
+                    return pages
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+    def test_charge_transfer_via_spawned_task(self):
+        # run_async's shape: create_task(self._task()) where the task
+        # body settles the charge — the UNRESOLVED spawn wrapper doesn't
+        # matter, the inner resolved call does
+        src = dedent(
+            """
+            class Mgr:
+                async def allocate(self): ...
+                async def finish(self): ...
+
+            class W:
+                def __init__(self, mgr: Mgr):
+                    self.mgr = mgr
+
+                async def _task(self):
+                    await self.mgr.finish()
+
+                async def run(self, spawn):
+                    if await self.mgr.allocate():
+                        spawn(self._task())
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+
+# ------------------------------------------------------------------ #
+# charge-refund-asymmetry
+# ------------------------------------------------------------------ #
+
+
+class TestChargeRefundAsymmetry:
+    def test_fires_on_charge_without_refund_path(self):
+        src = BUCKET + dedent(
+            """
+            def admit(bucket: Bucket, cost):
+                if not bucket.try_acquire(cost):
+                    raise RuntimeError("limited")
+                enqueue(cost)
+            """
+        )
+        f = one({"m.py": src}, "charge-refund-asymmetry")
+        assert "test.bucket" in f.message
+
+    def test_fires_on_risky_call_before_refund(self):
+        src = BUCKET + dedent(
+            """
+            def settle(bucket: Bucket, cost):
+                if not bucket.try_acquire(cost):
+                    return False
+                run(cost)
+                bucket.refund(cost)
+                return True
+            """
+        )
+        f = one({"m.py": src}, "charge-refund-asymmetry")
+        assert "finally" in f.message
+
+    def test_quiet_with_refund_in_finally(self):
+        src = BUCKET + dedent(
+            """
+            def settle(bucket: Bucket, cost):
+                if not bucket.try_acquire(cost):
+                    return False
+                try:
+                    run(cost)
+                finally:
+                    bucket.refund(cost)
+                return True
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+    def test_quiet_when_receiver_escapes(self):
+        src = BUCKET + dedent(
+            """
+            import external
+
+            def admit(bucket: Bucket, cost):
+                if not bucket.try_acquire(cost):
+                    return
+                external.settle_later(bucket, cost)
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+    def test_owns_annotation_discharges(self):
+        src = BUCKET + dedent(
+            """
+            def admit(bucket: Bucket, cost):
+                # arealint: owns(test.bucket, settled by the completion path)
+                if not bucket.try_acquire(cost):
+                    raise RuntimeError("limited")
+                enqueue(cost)
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+    def test_return_annotation_types_the_receiver(self):
+        # scheduler.submit's shape: the bucket comes from a helper with
+        # a return annotation, not a ctor assignment
+        src = BUCKET + dedent(
+            """
+            class Sched:
+                def _bucket(self, tenant) -> Bucket:
+                    return make()
+
+                def submit(self, tenant, cost):
+                    bucket = self._bucket(tenant)
+                    if not bucket.try_acquire(cost):
+                        raise RuntimeError("limited")
+                    enqueue(cost)
+            """
+        )
+        assert "charge-refund-asymmetry" in rules_of({"m.py": src})
+
+
+# ------------------------------------------------------------------ #
+# double-release
+# ------------------------------------------------------------------ #
+
+
+class TestDoubleRelease:
+    def test_fires_on_straight_line_double_free(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool):
+                pages = pool.alloc(2)
+                pool.release(pages)
+                pool.release(pages)
+            """
+        )
+        f = one({"m.py": src}, "double-release")
+        assert f.line == 8
+        assert "double free" in f.message
+
+    def test_fires_on_release_in_loop(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool, rounds):
+                pages = pool.alloc(2)
+                for _ in rounds:
+                    pool.release(pages)
+            """
+        )
+        f = one({"m.py": src}, "double-release")
+        assert "loop" in f.message
+
+    def test_quiet_on_exclusive_branches(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool, cond):
+                pages = pool.alloc(2)
+                if cond:
+                    pool.release(pages)
+                else:
+                    pool.release(pages)
+            """
+        )
+        assert findings({"m.py": src}, "double-release") == []
+
+    def test_quiet_on_try_except_arms(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool):
+                pages = pool.alloc(2)
+                try:
+                    commit(pages)
+                    pool.release(pages)
+                except RuntimeError:
+                    pool.release(pages)
+            """
+        )
+        assert findings({"m.py": src}, "double-release") == []
+
+    def test_quiet_on_reacquire_between(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool):
+                pages = pool.alloc(2)
+                pool.release(pages)
+                pages = pool.alloc(2)
+                pool.release(pages)
+            """
+        )
+        assert findings({"m.py": src}, "double-release") == []
+
+    def test_quiet_without_in_function_acquire(self):
+        # settle-elsewhere pattern (engine._harvest): releases of a
+        # handle this function never acquired are out of scope
+        src = POOL + dedent(
+            """
+            def harvest(pool: Pool, info):
+                pool.release(info)
+                pool.release(info)
+            """
+        )
+        assert findings({"m.py": src}, "double-release") == []
+
+    def test_suppression(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool):
+                pages = pool.alloc(2)
+                pool.release(pages)
+                pool.release(pages)  # arealint: ok(fixture double free)
+            """
+        )
+        assert findings({"m.py": src}, "double-release") == []
+
+
+# ------------------------------------------------------------------ #
+# release-without-acquire
+# ------------------------------------------------------------------ #
+
+
+class TestReleaseWithoutAcquire:
+    def test_fires_on_conditional_acquire_unconditional_release(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool, cond):
+                if cond:
+                    pages = pool.alloc(2)
+                finishup()
+                pool.release(pages)
+            """
+        )
+        f = one({"m.py": src}, "release-without-acquire")
+        assert "only on some" in f.message
+
+    def test_quiet_with_truthiness_guard(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool, cond):
+                pages = []
+                if cond:
+                    pages = pool.alloc(2)
+                try:
+                    finishup()
+                finally:
+                    if pages:
+                        pool.release(pages)
+            """
+        )
+        assert findings({"m.py": src}, "release-without-acquire") == []
+
+    def test_quiet_with_prior_binding(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool, cond):
+                pages = []
+                if cond:
+                    pages = pool.alloc(2)
+                pool.release(pages)
+            """
+        )
+        assert findings({"m.py": src}, "release-without-acquire") == []
+
+    def test_quiet_without_in_function_acquire(self):
+        src = POOL + dedent(
+            """
+            def refund_path(pool: Pool, pages):
+                pool.release(pages)
+            """
+        )
+        assert findings({"m.py": src}, "release-without-acquire") == []
+
+    def test_charge_kind_variant(self):
+        src = BUCKET + dedent(
+            """
+            def settle(bucket: Bucket, fast, cost):
+                if fast:
+                    ok = bucket.try_acquire(cost)
+                run(cost)
+                bucket.refund(cost)
+            """
+        )
+        assert "release-without-acquire" in rules_of({"m.py": src})
+
+    def test_suppression(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool, cond):
+                if cond:
+                    pages = pool.alloc(2)
+                finishup()
+                pool.release(pages)  # arealint: ok(cond is invariant here)
+            """
+        )
+        assert findings({"m.py": src}, "release-without-acquire") == []
+
+
+# ------------------------------------------------------------------ #
+# context kind (tracing.span) + handle-is-receiver + sessions
+# ------------------------------------------------------------------ #
+
+
+class TestContextAndSpecialShapes:
+    TRACING = "def span(name): ...\n"
+
+    def test_bare_span_call_fires(self):
+        main = dedent(
+            """
+            from pkg import tracing
+
+            def work():
+                tracing.span("step")
+            """
+        )
+        f = one(
+            {"pkg/__init__.py": "", "pkg/tracing.py": self.TRACING,
+             "pkg/main.py": main},
+            "leak-on-exception-path",
+        )
+        assert "with" in f.message
+
+    def test_span_in_with_is_quiet(self):
+        main = dedent(
+            """
+            from pkg import tracing
+
+            def work():
+                with tracing.span("step"):
+                    compute()
+            """
+        )
+        assert rules_of(
+            {"pkg/__init__.py": "", "pkg/tracing.py": self.TRACING,
+             "pkg/main.py": main}
+        ) == []
+
+    def test_span_bound_then_with_is_quiet(self):
+        main = dedent(
+            """
+            from pkg import tracing
+
+            def work():
+                cm = tracing.span("step")
+                with cm:
+                    compute()
+            """
+        )
+        assert rules_of(
+            {"pkg/__init__.py": "", "pkg/tracing.py": self.TRACING,
+             "pkg/main.py": main}
+        ) == []
+
+    def test_lease_receiver_handle(self):
+        src = dedent(
+            """
+            class Lease:
+                def start(self): ...
+                def stop(self): ...
+
+            async def run():
+                lease = Lease()
+                lease.start()
+                await step()
+                lease.stop()
+            """
+        )
+        assert "leak-on-cancellation" in rules_of({"m.py": src})
+
+    def test_lease_attribute_receiver_degrades(self):
+        # cross-method protocols (self.lease started in join, stopped in
+        # stop) hand ownership to the object: out of scope by contract
+        src = dedent(
+            """
+            class Lease:
+                def start(self): ...
+                def stop(self): ...
+
+            class Mgr:
+                def __init__(self):
+                    self.lease = Lease()
+
+                async def join(self):
+                    self.lease.start()
+                    await step()
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+    def test_session_non_cm_without_close_fires(self):
+        src = dedent(
+            """
+            import aiohttp
+
+            async def fetch():
+                s = aiohttp.ClientSession()
+                await s.get("http://x")
+                await s.close()
+            """
+        )
+        assert "leak-on-cancellation" in rules_of({"m.py": src})
+
+    def test_session_async_with_is_quiet(self):
+        src = dedent(
+            """
+            import aiohttp
+
+            async def fetch():
+                async with aiohttp.ClientSession() as s:
+                    await s.get("http://x")
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+    def test_session_close_in_finally_is_quiet(self):
+        src = dedent(
+            """
+            import aiohttp
+
+            async def fetch():
+                s = aiohttp.ClientSession()
+                try:
+                    await s.get("http://x")
+                finally:
+                    await s.close()
+            """
+        )
+        assert rules_of({"m.py": src}) == []
+
+
+# ------------------------------------------------------------------ #
+# typing conservatism: no resolution -> no obligation
+# ------------------------------------------------------------------ #
+
+
+class TestTypingDegradation:
+    def test_untyped_receiver_creates_no_obligation(self):
+        src = dedent(
+            """
+            def work(pool):
+                pages = pool.alloc(2)
+                compute(pages)
+            """
+        )
+        assert rules_of({"m.py": src}, config=CFG) == []
+
+    def test_name_collision_with_other_class_is_quiet(self):
+        src = dedent(
+            """
+            class Arena:
+                def alloc(self, n): ...
+
+            def work(arena: Arena):
+                block = arena.alloc(2)
+                compute(block)
+            """
+        )
+        assert rules_of({"m.py": src}, config=CFG) == []
+
+    def test_no_catalog_disables_family(self):
+        src = POOL + dedent(
+            """
+            def work(pool: Pool):
+                pages = pool.alloc(2)
+                compute(pages)
+            """
+        )
+        assert rules_of({"m.py": src}, config=Config(resources=None)) == []
+
+
+# ------------------------------------------------------------------ #
+# catalog provenance + drift (the loud-drift contract)
+# ------------------------------------------------------------------ #
+
+
+class TestCatalogDrift:
+    def test_every_declared_spec_verifies_against_the_tree(self):
+        catalog, dropped = parse_resources(REPO)
+        assert dropped == [], (
+            f"resource specs dropped at provenance: {dropped} — the "
+            "declared (class, method) pairs no longer exist; update "
+            "tools/arealint/resources.py"
+        )
+        assert sorted(s.name for s in catalog) == sorted(
+            s.name for s in DEFAULT_RESOURCE_DEFS
+        )
+
+    def test_parsed_pairs_match_runtime_modules(self):
+        """Import each catalog module and check every declared operation
+        exists at runtime — a rename in the runtime module must fail HERE,
+        not silently disable the rule family."""
+        catalog, _ = parse_resources(REPO)
+        for spec in catalog:
+            if spec.external:
+                continue
+            mod_name = spec.module[:-3].replace("/", ".")
+            mod = importlib.import_module(mod_name)
+            for cls, method in spec_pairs(spec):
+                owner = getattr(mod, cls) if cls else mod
+                assert callable(getattr(owner, method, None)), (
+                    f"{spec.name}: {spec.module} has no "
+                    f"{cls + '.' if cls else ''}{method}"
+                )
+            for m in spec.release_on_handle:
+                # release-on-handle ops live on the ACQUIRING class(es)
+                for cls in spec.acquire_classes():
+                    assert callable(getattr(getattr(mod, cls), m, None)), (
+                        f"{spec.name}: {cls} has no {m}()"
+                    )
+
+    def test_expected_resources_present(self):
+        catalog, _ = parse_resources(REPO)
+        names = {s.name for s in catalog}
+        assert {
+            "gen.kv-pages", "gen.engine-slot", "gateway.token-bucket",
+            "gateway.wfq", "gateway.request", "rollout.manager-slot",
+            "elastic.rank-lease", "tracing.span", "aiohttp.client-session",
+        } <= names
+
+    def test_missing_module_drops_spec(self, tmp_path):
+        cat, dropped = parse_resources(tmp_path)
+        assert "gen.kv-pages" in dropped
+        # external specs survive (declaration-only)
+        assert "aiohttp.client-session" in {s.name for s in cat}
+
+
+# ------------------------------------------------------------------ #
+# CLI integration: explicit-path scans (the --changed-only file set)
+# cover the lifecycle family
+# ------------------------------------------------------------------ #
+
+
+class TestCliScoping:
+    def test_explicit_path_scan_fires_lifecycle_rules(self, tmp_path):
+        bad = tmp_path / "leaky.py"
+        bad.write_text(dedent(
+            """
+            class PagePool:
+                def alloc(self, n): ...
+                def release(self, pages): ...
+
+            async def work(pool: PagePool):
+                pages = pool.alloc(2)
+                await chunk()
+                pool.release(pages)
+            """
+        ))
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.arealint", str(bad),
+             "--no-baseline"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 1
+        assert "leak-on-cancellation" in r.stdout
+
+    def test_changed_only_stdin_covers_lifecycle(self, tmp_path):
+        bad = tmp_path / "leaky.py"
+        bad.write_text(dedent(
+            """
+            class PagePool:
+                def alloc(self, n): ...
+                def release(self, pages): ...
+
+            def work(pool: PagePool):
+                pages = pool.alloc(2)
+                compute(1)
+                pool.release(pages)
+            """
+        ))
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.arealint", str(tmp_path),
+             "--no-baseline", "--changed-only"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+            input=f"{bad}\n",
+        )
+        assert r.returncode == 1
+        assert "leak-on-exception-path" in r.stdout
+
+    def test_rules_listed(self):
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.arealint", "--list-rules"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 0
+        for rid in (
+            "leak-on-exception-path", "leak-on-cancellation",
+            "double-release", "release-without-acquire",
+            "charge-refund-asymmetry",
+        ):
+            assert rid in r.stdout

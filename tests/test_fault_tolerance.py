@@ -294,6 +294,112 @@ async def test_push_fault_requeues_without_duplicates():
     await s0.stop()
 
 
+async def test_push_crash_still_releases_manager_slot():
+    """An UNEXPECTED pusher crash (not the scripted fault point) must not
+    skip finish_rollout: the manager's capacity slot is released, the
+    undelivered sample is requeued, and the retry goes through — the
+    lifecycle-rule triage fix for the allocate/finish pairing
+    (rollout.manager-slot in tools/arealint/resources.py)."""
+    s0 = ScriptableGenServer()
+    await s0.start()
+    manager = GserverManager(_mcfg(), server_urls=[s0.url])
+    mgr_port = network.find_free_port()
+    mgr_runner = await serve_manager(manager, "127.0.0.1", mgr_port)
+
+    class CrashOncePusher(ListPusher):
+        def __init__(self):
+            super().__init__()
+            self.crashes = 0
+
+        def push(self, data):
+            if self.crashes == 0:
+                self.crashes += 1
+                raise RuntimeError("zmq push exploded")
+            return super().push(data)
+
+    pusher = CrashOncePusher()
+    worker = RolloutWorker(
+        experiment_name=EXP, trial_name=TRIAL, worker_index=0, n_workers=1,
+        n_pullers=1, agent=EchoAgent(), env=NullEnv(),
+        dataset=ListDataset(2), max_concurrent_tasks=2,
+        pusher=pusher, manager_url=f"http://127.0.0.1:{mgr_port}",
+    )
+    orig_load = worker.load_next_data
+
+    def _load_single_epoch():
+        s = orig_load()
+        return None if worker._epoch > 0 else s
+
+    worker.load_next_data = _load_single_epoch
+    run = asyncio.get_event_loop().create_task(worker.run_async())
+    try:
+        for _ in range(500):
+            await asyncio.sleep(0.02)
+            if worker.accepted_cnt >= 2 and not worker._tasks:
+                break
+    finally:
+        run.cancel()
+        await asyncio.gather(run, return_exceptions=True)
+    assert pusher.crashes == 1
+    # nothing was delivered before the crash, so the sample requeued and
+    # retried (no duplicates), and every allocated slot was released
+    assert worker.requeued_cnt == 1 and worker.dropped_cnt == 0
+    assert worker.accepted_cnt >= 2
+    qids = sorted(d["ids"][0] for d in pusher.items)
+    assert qids == ["q0", "q1"]
+    assert manager.rollout_stat.running == 0, (
+        "a push-path crash leaked a manager capacity slot"
+    )
+    await mgr_runner.cleanup()
+    await s0.stop()
+
+
+async def test_deterministic_push_crash_exhausts_attempts():
+    """A sample whose push ALWAYS crashes (e.g. unserializable metadata)
+    must exhaust max_rollout_attempts and be dropped — the retry counter
+    resets only after a fully delivered round, so a deterministic
+    post-collect failure cannot requeue forever."""
+    s0 = ScriptableGenServer()
+    await s0.start()
+    manager = GserverManager(_mcfg(), server_urls=[s0.url])
+    mgr_port = network.find_free_port()
+    mgr_runner = await serve_manager(manager, "127.0.0.1", mgr_port)
+
+    class AlwaysCrashPusher(ListPusher):
+        def push(self, data):
+            raise RuntimeError("metadata not serializable")
+
+    worker = RolloutWorker(
+        experiment_name=EXP, trial_name=TRIAL, worker_index=0, n_workers=1,
+        n_pullers=1, agent=EchoAgent(), env=NullEnv(),
+        dataset=ListDataset(1), max_concurrent_tasks=1,
+        pusher=AlwaysCrashPusher(),
+        manager_url=f"http://127.0.0.1:{mgr_port}",
+        max_rollout_attempts=3,
+    )
+    orig_load = worker.load_next_data
+
+    def _load_single_epoch():
+        s = orig_load()
+        return None if worker._epoch > 0 else s
+
+    worker.load_next_data = _load_single_epoch
+    run = asyncio.get_event_loop().create_task(worker.run_async())
+    try:
+        for _ in range(500):
+            await asyncio.sleep(0.02)
+            if worker.dropped_cnt >= 1:
+                break
+    finally:
+        run.cancel()
+        await asyncio.gather(run, return_exceptions=True)
+    assert worker.dropped_cnt == 1
+    assert worker.requeued_cnt == 2  # attempts 1..2 requeued, 3rd dropped
+    assert manager.rollout_stat.running == 0
+    await mgr_runner.cleanup()
+    await s0.stop()
+
+
 # --------------------------------------------------------------------- #
 # (b) weight update with one dead server: survivors bump, corpse evicted
 # --------------------------------------------------------------------- #

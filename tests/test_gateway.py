@@ -325,6 +325,21 @@ def test_wfq_drop_rolls_back_virtual_clock():
     assert q.pop() == ("a", "fresh")
 
 
+def test_wfq_rollback_after_pop():
+    # the popped-entry twin of drop_where's rollback: a popped-then-
+    # cancelled request must not deprioritize the tenant's future traffic
+    q = WeightedFairQueue()
+    q.push("a", 100.0, 1.0, ("a", 0))
+    q.push("a", 100.0, 1.0, ("a", 1))
+    assert q.pop() == ("a", 0)
+    q.rollback("a", 100.0, 1.0)
+    # the tenant's clock holds only the SURVIVING entry's share, and that
+    # entry's stamp shifted down with it
+    assert q._last_vft["a"] == pytest.approx(100.0)
+    assert q._queues["a"][0][0] == pytest.approx(100.0)
+    assert q.pop() == ("a", 1)
+
+
 def test_demand_occupancy_excludes_evictable_cache(params):
     # a cache-warm idle server must not read as "full" to the admission
     # gate: raw occupancy counts prefix-cache pages the next admission
@@ -337,6 +352,64 @@ def test_demand_occupancy_excludes_evictable_cache(params):
     assert eng.n_running() == 0
     assert eng.kv_pool_occupancy() > 0.0          # cache holds pages
     assert eng.kv_pool_demand_occupancy() == 0.0  # all reclaimable
+
+
+class _StubGenClient:
+    """Capacity-poll-only stand-in: the dispatch path must never reach
+    generate_stream in the cancel-race test."""
+
+    def __init__(self):
+        self.streams = 0
+
+    async def metrics(self, url):
+        return {
+            "max_slots": 4,
+            "kv_pool_demand_occupancy": 0.0,
+            "slot_capacity": 4096,
+        }
+
+    async def generate_stream(self, url, rid, ids, sp):
+        self.streams += 1
+        yield {"token_ids": [], "logprobs": [], "finish_reason": "stop"}
+
+
+async def test_cancel_while_dispatching_refunds_charge():
+    """cancel() racing the dispatch pop: drop_where misses the popped
+    entry and no _run_request will ever settle it — the dispatch loop
+    must refund the full budget or the tenant bucket leaks one request
+    cost per race (lifecycle-rule triage fix)."""
+    stub = _StubGenClient()
+    sched = ContinuousBatchScheduler(
+        ["http://stub:1"],
+        tenants={"t": TenantSpec(
+            name="t", weight=1.0, rate_tokens_per_s=100.0,
+            burst_tokens=10_000.0,
+        )},
+        client=stub,
+    )
+    await sched.start()
+    try:
+        req = GatewayRequest.build("t", [1, 2, 3], {"max_new_tokens": 61})
+        bucket = sched._bucket("t")
+        before = bucket.available
+        # the race, made deterministic: the flag is set but the entry is
+        # (about to be) popped, so cancel()'s drop_where path misses it
+        req.cancelled = True
+        sched.submit(req)
+        assert bucket.available <= before - req.cost + 1.0
+        for _ in range(200):
+            await asyncio.sleep(0.01)
+            if sched.queue_depth() == 0 and sched.inflight() == 0:
+                break
+        assert sched.queue_depth() == 0
+        assert sched.inflight() == 0
+        assert stub.streams == 0  # never dispatched to a backend
+        assert bucket.available == pytest.approx(before, abs=2.0)
+        # the fair-queue virtual clock rolled back too: the popped entry
+        # never ran, so it must not count against the tenant's share
+        assert sched._wfq._last_vft.get("t", 0.0) == pytest.approx(0.0)
+    finally:
+        await sched.stop()
 
 
 def test_token_bucket_refill_and_refund():

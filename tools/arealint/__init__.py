@@ -46,6 +46,12 @@ from tools.arealint.meshmodel import (  # noqa: F401
     MeshModel,
     parse_mesh_module,
 )
+from tools.arealint.resources import (  # noqa: F401
+    DEFAULT_RESOURCE_DEFS,
+    ResourceCatalog,
+    ResourceSpec,
+    parse_resources,
+)
 from tools.arealint.project import Project  # noqa: F401
 from tools.arealint.callgraph import (  # noqa: F401
     CallGraph,
@@ -60,6 +66,7 @@ from tools.arealint import rules_hygiene  # noqa: E402,F401
 from tools.arealint import rules_concurrency  # noqa: E402,F401
 from tools.arealint import rules_dataflow  # noqa: E402,F401
 from tools.arealint import rules_spmd  # noqa: E402,F401
+from tools.arealint import rules_lifecycle  # noqa: E402,F401
 
 from tools.arealint.baseline import (  # noqa: F401
     DEFAULT_BASELINE,

@@ -1,0 +1,284 @@
+"""The resource catalog: paired acquire/release operations the lifecycle
+rules (``rules_lifecycle.py``) check typestate against, parsed from the
+runtime modules with ``ast`` — never imported.
+
+Same provenance contract as the counter/fault/mesh catalogs
+(docs/static_analysis.md "Lifecycle rules"): the linter runs in a bare CI
+container with no jax/aiohttp, so the single source of truth for each
+acquire/release pair is read statically from the module that defines it.
+A declared ``(class, method)`` pair the module no longer contains drops
+the whole spec (every rule over that resource degrades to no-finding
+rather than guessing), and ``tests/test_arealint_lifecycle.py`` pins the
+parsed pairs against the runtime modules so catalog drift fails loudly.
+
+Resource kinds:
+
+- ``handle`` — the acquire yields (or takes, for ``handle_from_arg``
+  ops like ``PagePool.ref``) a trackable value the release consumes:
+  KV pages, a rank lease, an aiohttp session. Obligations follow the
+  bound NAME; attribute-bound handles (``self._session = ...``) hand
+  ownership to the object and degrade (cross-method protocols are out
+  of scope — the ``owns`` annotation documents them instead).
+- ``charge`` — a counted grant keyed to the acquiring object, with no
+  handle: a ``TokenBucket`` charge, a WFQ queue entry, an engine slot
+  grant, a manager rollout slot. The release is any matching release
+  op (or a callee that transitively performs one).
+- ``context`` — must be entered via ``with``/``async with``
+  (``tracing.span``): a bare call opens nothing and leaks the close.
+"""
+
+import ast
+import dataclasses
+import pathlib
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceSpec:
+    """One acquire/release protocol the typestate pass tracks."""
+
+    name: str                 # "gen.kv-pages" — the owns()/finding label
+    kind: str                 # "handle" | "charge" | "context"
+    # repo-relative defining module ("" for external specs)
+    module: str = ""
+    # (ClassName, method) pairs; receiver must TYPE-resolve to ClassName
+    acquires: Tuple[Tuple[str, str], ...] = ()
+    releases: Tuple[Tuple[str, str], ...] = ()
+    # release methods called ON the handle itself (session.close())
+    release_on_handle: Tuple[str, ...] = ()
+    # dotted qualnames acquired by plain call (module functions /
+    # external ctors); matched by resolved-qualname suffix
+    func_acquires: Tuple[str, ...] = ()
+    # acquire methods whose handle is the FIRST ARGUMENT (PagePool.ref)
+    handle_from_arg: Tuple[str, ...] = ()
+    # acquire methods whose handle is the RECEIVER (RankLease.start)
+    handle_is_receiver: Tuple[str, ...] = ()
+    external: bool = False    # not tree-parsed (aiohttp) — no provenance
+    doc: str = ""
+
+    def acquire_methods(self) -> frozenset:
+        return frozenset(m for _, m in self.acquires)
+
+    def release_methods(self) -> frozenset:
+        return frozenset(m for _, m in self.releases) | frozenset(
+            self.release_on_handle
+        )
+
+    def acquire_classes(self) -> frozenset:
+        return frozenset(c for c, _ in self.acquires)
+
+    def release_classes(self) -> frozenset:
+        return frozenset(c for c, _ in self.releases)
+
+    def func_tails(self) -> frozenset:
+        return frozenset(q.rsplit(".", 1)[-1] for q in self.func_acquires)
+
+
+class ResourceCatalog:
+    """The enabled specs plus the lookup maps the rules scan with."""
+
+    def __init__(self, specs: Iterable[ResourceSpec]):
+        self.specs: Tuple[ResourceSpec, ...] = tuple(specs)
+        self.by_name: Dict[str, ResourceSpec] = {
+            s.name: s for s in self.specs
+        }
+        # method name -> [(class, spec)] for acquire ops
+        self.acquire_index: Dict[str, List[Tuple[str, ResourceSpec]]] = {}
+        self.release_index: Dict[str, List[Tuple[str, ResourceSpec]]] = {}
+        for s in self.specs:
+            for cls, m in s.acquires:
+                self.acquire_index.setdefault(m, []).append((cls, s))
+            for cls, m in s.releases:
+                self.release_index.setdefault(m, []).append((cls, s))
+        # every attr name that can START an obligation — the per-function
+        # pre-scan gate (pay typestate inference only where one appears,
+        # mirroring the v3 donation-rule pre-scan)
+        self.acquire_names: frozenset = frozenset(
+            self.acquire_index
+        ) | frozenset(
+            t for s in self.specs for t in s.func_tails()
+        )
+
+    def __iter__(self):
+        return iter(self.specs)
+
+    def __len__(self):
+        return len(self.specs)
+
+
+# --------------------------------------------------------------------- #
+# The declared catalog. Every non-external entry is verified against its
+# module before it is enabled (parse_resources); the tuple below is the
+# DECLARATION, the tree is the authority.
+# --------------------------------------------------------------------- #
+
+DEFAULT_RESOURCE_DEFS: Tuple[ResourceSpec, ...] = (
+    ResourceSpec(
+        name="gen.kv-pages",
+        kind="handle",
+        module="areal_tpu/gen/pages.py",
+        acquires=(
+            ("PagePool", "alloc"),
+            ("PagePool", "ref"),
+            ("PrefixRegistry", "lookup"),
+        ),
+        releases=(("PagePool", "release"),),
+        handle_from_arg=("ref",),
+        doc="paged-KV page refcounts: alloc/ref/prefix-lookup must be "
+        "balanced by PagePool.release (orphaned pages starve admission)",
+    ),
+    ResourceSpec(
+        name="gen.engine-slot",
+        kind="charge",
+        module="areal_tpu/gen/engine.py",
+        acquires=(("GenerationEngine", "submit"),),
+        releases=(
+            ("GenerationEngine", "cancel"),
+            ("GenerationEngine", "pause"),
+        ),
+        doc="engine slot grant: a submitted request must be harvested, "
+        "cancelled, or drained (the PR-10 orphaned-slot cancel race)",
+    ),
+    ResourceSpec(
+        name="gateway.token-bucket",
+        kind="charge",
+        module="areal_tpu/gateway/qos.py",
+        acquires=(("TokenBucket", "try_acquire"),),
+        releases=(("TokenBucket", "refund"),),
+        doc="QoS token charge: the budgeted cost charged at admission "
+        "must be refunded on every exit path or the tenant starves",
+    ),
+    ResourceSpec(
+        name="gateway.wfq",
+        kind="charge",
+        module="areal_tpu/gateway/qos.py",
+        acquires=(("WeightedFairQueue", "push"),),
+        releases=(
+            ("WeightedFairQueue", "pop"),
+            ("WeightedFairQueue", "drop_where"),
+        ),
+        doc="fair-queue entry: pushed work must be popped or dropped "
+        "(with the virtual-clock rollback drop_where performs)",
+    ),
+    ResourceSpec(
+        name="gateway.request",
+        kind="charge",
+        module="areal_tpu/gateway/scheduler.py",
+        acquires=(("ContinuousBatchScheduler", "submit"),),
+        releases=(("ContinuousBatchScheduler", "cancel"),),
+        doc="gateway request admission: a submitted request must be "
+        "consumed to completion or cancelled on disconnect",
+    ),
+    ResourceSpec(
+        name="rollout.manager-slot",
+        kind="charge",
+        module="areal_tpu/system/rollout_worker.py",
+        acquires=(("RolloutWorker", "allocate_new_rollout"),),
+        releases=(("RolloutWorker", "finish_rollout"),),
+        doc="gserver-manager capacity slot: every successful allocate "
+        "must reach finish_rollout or the staleness gate tightens "
+        "forever",
+    ),
+    ResourceSpec(
+        name="elastic.rank-lease",
+        kind="handle",
+        module="areal_tpu/parallel/elastic.py",
+        acquires=(("RankLease", "start"),),
+        releases=(),
+        release_on_handle=("stop",),
+        handle_is_receiver=("start",),
+        doc="liveness-lease refresh thread: started leases must be "
+        "stopped or the thread outlives the epoch",
+    ),
+    ResourceSpec(
+        name="tracing.span",
+        kind="context",
+        module="areal_tpu/base/tracing.py",
+        func_acquires=("areal_tpu.base.tracing.span",),
+        doc="data-plane span: must be entered via 'with' — a bare call "
+        "never opens (or closes) the span",
+    ),
+    ResourceSpec(
+        name="aiohttp.client-session",
+        kind="handle",
+        external=True,
+        func_acquires=("aiohttp.ClientSession",),
+        release_on_handle=("close",),
+        doc="HTTP session: use 'async with', or close() in a finally — "
+        "an abandoned session leaks its connector sockets",
+    ),
+)
+
+
+# --------------------------------------------------------------------- #
+# provenance: verify declared pairs against the tree
+# --------------------------------------------------------------------- #
+
+
+def _module_symbols(path: pathlib.Path) -> Optional[Dict[str, frozenset]]:
+    """``{"": module-level def names, ClassName: method names}`` for one
+    file, or None when it cannot be parsed."""
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except (OSError, SyntaxError):
+        return None
+    out: Dict[str, frozenset] = {}
+    funcs = set()
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.add(node.name)
+        elif isinstance(node, ast.ClassDef):
+            out[node.name] = frozenset(
+                n.name
+                for n in node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            )
+    out[""] = frozenset(funcs)
+    return out
+
+
+def spec_pairs(spec: ResourceSpec) -> List[Tuple[str, str]]:
+    """Every (class, method) pair the spec declares against its module
+    (the drift test pins these against the runtime classes)."""
+    pairs = list(spec.acquires) + list(spec.releases)
+    for q in spec.func_acquires:
+        if not spec.external:
+            pairs.append(("", q.rsplit(".", 1)[-1]))
+    return pairs
+
+
+def verify_spec(spec: ResourceSpec, root: pathlib.Path) -> bool:
+    """True when every declared operation exists in the spec's module.
+    External specs (aiohttp) are declaration-only and always pass."""
+    if spec.external:
+        return True
+    syms = _module_symbols(pathlib.Path(root) / spec.module)
+    if syms is None:
+        return False
+    for cls, method in spec_pairs(spec):
+        if method not in syms.get(cls, frozenset()):
+            return False
+    return True
+
+
+def parse_resources(
+    root, defs: Tuple[ResourceSpec, ...] = DEFAULT_RESOURCE_DEFS
+) -> Tuple[ResourceCatalog, List[str]]:
+    """The enabled catalog for a tree plus the names of DROPPED specs
+    (declared ops missing from the module — degrade, never guess)."""
+    root = pathlib.Path(root)
+    enabled: List[ResourceSpec] = []
+    dropped: List[str] = []
+    for spec in defs:
+        if verify_spec(spec, root):
+            enabled.append(spec)
+        else:
+            dropped.append(spec.name)
+    return ResourceCatalog(enabled), dropped
+
+
+def from_repo(root) -> Optional[ResourceCatalog]:
+    """Catalog for the repo at ``root``; None when NOTHING verified
+    (e.g. scanning a foreign tree) so the rule family disables whole."""
+    catalog, _dropped = parse_resources(root)
+    return catalog if len(catalog) else None

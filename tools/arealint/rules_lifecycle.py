@@ -1,0 +1,1246 @@
+"""Resource-lifecycle / cancellation-safety typestate rules.
+
+The review history's single largest class of hardening fixes (PRs 10-12)
+was acquire/release protocols broken on exception and cancellation
+paths: leaked engine slots on cancel races, unrefunded ``TokenBucket``
+charges, orphaned pages on disconnect. This family machine-checks that
+bug class against the resource catalog (``tools/arealint/resources.py``,
+parsed from the tree — never imported):
+
+- ``leak-on-exception-path`` — a ``handle``/``context`` acquire whose
+  release is not dominated by a ``finally`` / context manager: any
+  exception between acquire and release leaks the resource.
+- ``leak-on-cancellation`` — an ``await`` sits between acquire and
+  release with no enclosing ``try/finally`` (or a handler that catches
+  ``CancelledError``): the exact shape of PR-10's orphaned-slot cancel
+  race. ``except Exception`` does NOT protect this path — CancelledError
+  is a BaseException.
+- ``double-release`` — the same handle released twice on one
+  straight-line path (or once inside a loop): refcount underflow.
+- ``release-without-acquire`` — the matching acquire happens only on
+  SOME path (a conditional branch) while the release is unconditional.
+- ``charge-refund-asymmetry`` — a counted charge (``charge`` kind)
+  whose refund is unreachable on an error path.
+
+Ownership transfer resolves through the project call graph: a resolved
+callee that (transitively) performs a matching release DISCHARGES the
+obligation; a callee that stores the handle, an unresolvable callee, a
+return/yield, or a store into an attribute/container DEGRADES it to
+no-finding — the v2/v3 degradation contract. Deliberate cross-function
+handoffs the graph cannot see are annotated at the acquire site::
+
+    self.engine.submit(req)  # arealint: owns(gen.engine-slot, <reason>)
+
+The annotation names the RESOURCE (so a later refactor that changes what
+the line acquires invalidates it) and requires a reason, same as
+``# arealint: ok``. A malformed ``owns`` (missing reason, wrong resource
+name) does not discharge — the finding message says so.
+"""
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.arealint.core import (
+    ProjectContext, SEVERITY_ERROR, SUPPRESS_RE, project_rule,
+    walk_excluding_nested,
+)
+from tools.arealint.project import FunctionInfo, _dotted, collect_aliases
+from tools.arealint.rules_dataflow import _stored_param_positions
+
+OWNS_RE = re.compile(
+    r"#\s*arealint:\s*owns\(\s*(?P<res>[^,()]+?)\s*,\s*(?P<reason>[^)]+?)\s*\)"
+)
+OWNS_BARE_RE = re.compile(r"#\s*arealint:\s*owns\b")
+
+RULE_LEAK_EXC = "leak-on-exception-path"
+RULE_LEAK_CANCEL = "leak-on-cancellation"
+RULE_DOUBLE = "double-release"
+RULE_REL_NO_ACQ = "release-without-acquire"
+RULE_ASYM = "charge-refund-asymmetry"
+
+_MAX_TRANSFER_DEPTH = 6
+
+# builtins that READ a handle without capturing it: not an escape, still
+# a risky call like any other
+_PURE_BUILTINS = frozenset({
+    "len", "sorted", "sum", "min", "max", "enumerate", "reversed",
+    "int", "float", "str", "bool", "repr", "print", "zip", "isinstance",
+    "any", "all", "range",
+})
+
+
+def _pos(n) -> Tuple[int, int]:
+    return (n.lineno, n.col_offset)
+
+
+def _end(n) -> Tuple[int, int]:
+    return (
+        getattr(n, "end_lineno", n.lineno),
+        getattr(n, "end_col_offset", n.col_offset),
+    )
+
+
+# --------------------------------------------------------------------- #
+# receiver / handle typing (conservative: unresolvable -> no obligation)
+# --------------------------------------------------------------------- #
+
+
+def _class_name_of(pctx: ProjectContext, mod, dotted: str) -> Optional[str]:
+    """Bare class NAME when ``dotted`` (as seen in ``mod``) resolves to an
+    indexed class; None otherwise."""
+    if mod is None or not dotted:
+        return None
+    target = pctx.project.resolve_in_module(mod, dotted)
+    if target is None:
+        return None
+    ci = pctx.project.class_info(target)
+    return ci.name if ci is not None else None
+
+
+def _ctor_class(pctx: ProjectContext, mod, call: ast.Call) -> Optional[str]:
+    d = _dotted(call.func)
+    return _class_name_of(pctx, mod, d) if d else None
+
+
+def _return_class(pctx: ProjectContext, call: ast.Call, callees) -> Optional[str]:
+    """Class name from a resolved callee's return annotation
+    (``def _bucket(...) -> TokenBucket``)."""
+    q = callees.get(id(call))
+    if not q:
+        return None
+    cfi = pctx.graph.function(q)
+    if cfi is None or cfi.node.returns is None:
+        return None
+    d = _dotted(cfi.node.returns)
+    if not d:
+        return None
+    cmod = pctx.project.modules.get(cfi.module)
+    return _class_name_of(pctx, cmod, d)
+
+
+def _module_attr_types(pctx: ProjectContext, mod) -> Dict[str, str]:
+    """``"Class.attr" -> class NAME`` from ``self.attr = Ctor(...)`` and
+    ``self.attr = <annotated param>`` assignments anywhere in the class."""
+    out: Dict[str, str] = {}
+    for ci in mod.classes.values():
+        for fi in ci.methods.values():
+            ann = {}
+            args = fi.node.args
+            for a in (
+                list(getattr(args, "posonlyargs", []))
+                + list(args.args) + list(args.kwonlyargs)
+            ):
+                if a.annotation is not None:
+                    d = _dotted(a.annotation)
+                    cn = _class_name_of(pctx, mod, d) if d else None
+                    if cn:
+                        ann[a.arg] = cn
+            for node in ast.walk(fi.node):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and isinstance(node.targets[0].value, ast.Name)
+                    and node.targets[0].value.id == "self"
+                ):
+                    continue
+                key = f"{ci.name}.{node.targets[0].attr}"
+                cn = None
+                if isinstance(node.value, ast.Call):
+                    cn = _ctor_class(pctx, mod, node.value)
+                elif isinstance(node.value, ast.Name):
+                    cn = ann.get(node.value.id)
+                if cn:
+                    out[key] = cn
+                else:
+                    out.pop(key, None)
+    return out
+
+
+def _local_types(pctx: ProjectContext, mod, fi: FunctionInfo) -> Dict[str, str]:
+    """name -> class NAME: ctor assigns, annotated params, and
+    return-annotated resolved calls."""
+    types: Dict[str, str] = {}
+    args = fi.node.args
+    for a in (
+        list(getattr(args, "posonlyargs", []))
+        + list(args.args) + list(args.kwonlyargs)
+    ):
+        if a.annotation is not None:
+            d = _dotted(a.annotation)
+            cn = _class_name_of(pctx, mod, d) if d else None
+            if cn:
+                types[a.arg] = cn
+    callees = pctx.graph.callees_by_node(fi.qualname)
+    for node in walk_excluding_nested(fi.node):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Call)
+        ):
+            cn = _ctor_class(pctx, mod, node.value) or _return_class(
+                pctx, node.value, callees
+            )
+            if cn:
+                types[node.targets[0].id] = cn
+            else:
+                types.pop(node.targets[0].id, None)
+    return types
+
+
+def _receiver_class(
+    pctx, mod, fi, call: ast.Call, local_types, attr_types, callees,
+) -> Optional[str]:
+    """Resolved class NAME of a method call's receiver, or None."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    v = f.value
+    if isinstance(v, ast.Name):
+        if v.id == "self" and fi.class_name is not None:
+            return fi.class_name
+        return local_types.get(v.id)
+    if (
+        isinstance(v, ast.Attribute)
+        and isinstance(v.value, ast.Name)
+        and v.value.id == "self"
+        and fi.class_name is not None
+    ):
+        return attr_types.get(f"{fi.class_name}.{v.attr}")
+    if isinstance(v, ast.Call):
+        return _ctor_class(pctx, mod, v) or _return_class(pctx, v, callees)
+    return None
+
+
+# --------------------------------------------------------------------- #
+# spec matching
+# --------------------------------------------------------------------- #
+
+
+def _match_acquire(
+    pctx, mod, fi, call, catalog, local_types, attr_types, aliases, callees,
+):
+    """The ResourceSpec a call acquires, or None (degrade)."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        entries = catalog.acquire_index.get(f.attr)
+        if entries:
+            rc = _receiver_class(
+                pctx, mod, fi, call, local_types, attr_types, callees
+            )
+            if rc:
+                for cls, spec in entries:
+                    if cls == rc:
+                        return spec
+    d = _dotted(f)
+    if not d:
+        return None
+    tail = d.rsplit(".", 1)[-1]
+    candidates = [
+        s for s in catalog.specs if s.func_acquires and tail in s.func_tails()
+    ]
+    if not candidates:
+        return None
+    resolved = pctx.project.resolve_in_module(mod, d)
+    full = None
+    if resolved is None:
+        head, _, rest = d.partition(".")
+        base = aliases.get(head)
+        if base:
+            full = f"{base}.{rest}" if rest else base
+    for spec in candidates:
+        for q in spec.func_acquires:
+            tail2 = ".".join(q.split(".")[-2:])
+            for got in (resolved, full):
+                if got and (got == q or got.endswith("." + tail2)):
+                    return spec
+    return None
+
+
+def _match_release(
+    pctx, mod, fi, call, catalog, local_types, attr_types, callees,
+):
+    """(spec, handle-dotted-or-None) when the call is a typed release
+    (``pool.release(pages)`` / ``lease.stop()``); None otherwise."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    entries = catalog.release_index.get(f.attr)
+    if entries:
+        rc = _receiver_class(
+            pctx, mod, fi, call, local_types, attr_types, callees
+        )
+        if rc:
+            for cls, spec in entries:
+                if cls == rc:
+                    handle = _dotted(call.args[0]) if call.args else None
+                    return spec, handle
+    # release-on-handle: lease.stop() / session.close() — the receiver IS
+    # the handle; the spec is decided by matching an open obligation
+    for spec in catalog.specs:
+        if f.attr in spec.release_on_handle:
+            h = _dotted(f.value)
+            if h:
+                return spec, h
+    return None
+
+
+def _releases_transitively(pctx, qualname: str, spec, _depth=0, _seen=None):
+    """Permissive ownership-transfer classifier: does the callee (or
+    anything it resolves to, bounded depth) perform — or hold a reference
+    to — a release op of ``spec``? Name-based on the release side: this
+    only DISCHARGES obligations, so permissiveness is the conservative
+    direction."""
+    cache = getattr(pctx, "_lifecycle_transfer_cache", None)
+    if cache is None:
+        cache = {}
+        pctx._lifecycle_transfer_cache = cache
+    key = (qualname, spec.name)
+    if key in cache:
+        return cache[key]
+    if _seen is None:
+        _seen = set()
+    if qualname in _seen or _depth > _MAX_TRANSFER_DEPTH:
+        return False
+    _seen.add(qualname)
+    fi = pctx.graph.function(qualname)
+    if fi is None:
+        return False
+    rel = spec.release_methods()
+    hit = False
+    for n in walk_excluding_nested(fi.node):
+        if isinstance(n, ast.Attribute) and n.attr in rel:
+            hit = True
+            break
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) and (
+            n.func.id in rel
+        ):
+            hit = True
+            break
+    if not hit:
+        for nxt in sorted(pctx.graph.edges.get(qualname, ())):
+            if _releases_transitively(pctx, nxt, spec, _depth + 1, _seen):
+                hit = True
+                break
+    # True is depth-independent (a release found within the bound from a
+    # DEEPER start is also within it from depth 0); a False computed near
+    # the depth bound or inside a cycle's _seen set is weaker than a
+    # fresh depth-0 answer, so only root-level negatives are cached —
+    # caching truncated negatives would deny real ownership transfers
+    # and fire error findings on clean code
+    if hit or _depth == 0:
+        cache[key] = hit
+    return hit
+
+
+def _callee_stores(pctx, qualname: str) -> bool:
+    fi = pctx.graph.function(qualname)
+    if fi is None:
+        return True  # class ctor / unclassifiable: treat as capturing
+    cache = getattr(pctx, "_lifecycle_store_cache", None)
+    if cache is None:
+        cache = {}
+        pctx._lifecycle_store_cache = cache
+    got = cache.get(qualname)
+    if got is None:
+        got = bool(_stored_param_positions(fi))
+        cache[qualname] = got
+    return got
+
+
+# --------------------------------------------------------------------- #
+# structural helpers
+# --------------------------------------------------------------------- #
+
+
+def _chain(parents, node, stop) -> List[ast.AST]:
+    """Ancestors of ``node`` up to (excluding) ``stop``, innermost first."""
+    out = []
+    cur = parents.get(node)
+    while cur is not None and cur is not stop:
+        out.append(cur)
+        cur = parents.get(cur)
+    return out
+
+
+def _in_subtree(parents, node, roots: Sequence[ast.AST], stop) -> bool:
+    cur = node
+    while cur is not None and cur is not stop:
+        if any(cur is r for r in roots):
+            return True
+        cur = parents.get(cur)
+    return False
+
+
+def _stmt_of(parents, node, stop) -> ast.AST:
+    cur = node
+    while cur is not None and cur is not stop:
+        if isinstance(cur, ast.stmt):
+            return cur
+        cur = parents.get(cur)
+    return node
+
+
+def _branch_arms(parents, node, fdef) -> frozenset:
+    """Conditional arms enclosing ``node``: (id(ctrl), arm) pairs for If
+    body/orelse, Try body/handlers, and loop bodies. Try orelse/finalbody
+    are transparent (they execute on the fall-through path). A try-BODY
+    release and a HANDLER release are mutually exclusive paths — the
+    body arm keeps double-release honest there; the obligation pass only
+    treats If arms ("body"/"orelse") as conditional discharge."""
+    arms: Set[Tuple[int, str]] = set()
+    cur, child = parents.get(node), node
+    while cur is not None and child is not fdef:
+        if isinstance(cur, ast.If):
+            if _contains(cur.body, child):
+                arms.add((id(cur), "body"))
+            elif _contains(cur.orelse, child):
+                arms.add((id(cur), "orelse"))
+        elif isinstance(cur, ast.Try):
+            if any(_contains([h], child) for h in cur.handlers):
+                arms.add((id(cur), "handler"))
+            elif _contains(cur.body, child):
+                arms.add((id(cur), "trybody"))
+        elif isinstance(cur, (ast.While, ast.For, ast.AsyncFor)):
+            if _contains(cur.body, child):
+                arms.add((id(cur), "loop"))
+        child, cur = cur, parents.get(cur)
+    return frozenset(arms)
+
+
+def _contains(body, node) -> bool:
+    return any(n is node for n in body)
+
+
+def _enclosing_tries(parents, node, fdef) -> List[Tuple[ast.Try, str]]:
+    """(Try, arm) for every Try enclosing ``node``, innermost first; arm
+    in body/handler/orelse/finalbody."""
+    out = []
+    cur, child = parents.get(node), node
+    while cur is not None and child is not fdef:
+        if isinstance(cur, ast.Try):
+            if _contains(cur.body, child):
+                out.append((cur, "body"))
+            elif any(_contains([h], child) for h in cur.handlers):
+                out.append((cur, "handler"))
+            elif _contains(cur.orelse, child):
+                out.append((cur, "orelse"))
+            elif _contains(cur.finalbody, child):
+                out.append((cur, "finalbody"))
+        child, cur = cur, parents.get(cur)
+    return out
+
+
+def _handler_cancel_safe(handler: ast.ExceptHandler) -> bool:
+    """Does the handler catch CancelledError? (bare except /
+    BaseException / CancelledError)."""
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    for e in t.elts if isinstance(t, ast.Tuple) else [t]:
+        d = _dotted(e)
+        if d:
+            names.append(d.rsplit(".", 1)[-1])
+    return any(n in ("BaseException", "CancelledError") for n in names)
+
+
+def _owns_match(ctx, spec, lineno: int) -> Tuple[bool, bool]:
+    """(discharged, malformed-annotation-present) for the acquire line
+    and the comment line above. A reasoned ``# arealint: ok(...)`` on the
+    ACQUIRE line also discharges: the leak-on-cancellation finding lands
+    on the await line, but the natural place to annotate is the acquire."""
+    malformed = False
+    for ln in (lineno, lineno - 1):
+        text = ctx.line_text(ln)
+        if ln != lineno and not text.strip().startswith("#"):
+            continue
+        m = OWNS_RE.search(text)
+        if m:
+            if m.group("res").strip() == spec.name:
+                return True, False
+            malformed = True
+        elif OWNS_BARE_RE.search(text):
+            malformed = True
+        m = SUPPRESS_RE.search(text)
+        if m and m.group("reason").strip():
+            return True, False
+    return False, malformed
+
+
+# --------------------------------------------------------------------- #
+# the per-function typestate pass
+# --------------------------------------------------------------------- #
+
+
+class _Obligation:
+    def __init__(self, call, spec, handle, stmt):
+        self.call = call
+        self.spec = spec
+        self.handle = handle      # dotted name or None (charge kind)
+        self.stmt = stmt
+
+
+def _maximal_loads(parents, fdef, dotted: str) -> List[ast.AST]:
+    """Load occurrences of ``dotted`` that are not a prefix of a longer
+    attribute chain and not a method-call receiver."""
+    out = []
+    for n in walk_excluding_nested(fdef):
+        if not isinstance(n, (ast.Name, ast.Attribute)):
+            continue
+        if _dotted(n) != dotted:
+            continue
+        ctx_ = getattr(n, "ctx", None)
+        if not isinstance(ctx_, ast.Load):
+            continue
+        par = parents.get(n)
+        if isinstance(par, ast.Attribute) and par.value is n:
+            continue  # base of a longer chain (self.engine.cfg)
+        if isinstance(par, ast.Call) and par.func is n:
+            continue
+        out.append(n)
+    return out
+
+
+def _load_role(pctx, parents, fdef, load, callees) -> Tuple[str, Optional[ast.AST]]:
+    """Classify one maximal load of a tracked handle/receiver:
+
+    - ``("with", node)``     — a with-item: the CM closes it
+    - ``("escape", node)``   — capture the analysis cannot follow
+    - ``("transfer", call)`` — arg of a resolved transitively-releasing
+                               callee (decided by the caller per spec)
+    - ``("use", None)``      — plain read
+    """
+    cur, child = parents.get(load), load
+    while cur is not None and child is not fdef:
+        if isinstance(cur, ast.Call) and child is not cur.func:
+            q = callees.get(id(cur))
+            if q is None:
+                f = cur.func
+                if isinstance(f, ast.Name) and f.id in _PURE_BUILTINS:
+                    child, cur = cur, parents.get(cur)
+                    continue
+                return "escape", cur
+            return "call", cur
+        if isinstance(cur, (ast.Return, ast.Yield, ast.YieldFrom)):
+            return "escape", cur
+        if isinstance(cur, ast.withitem) and cur.context_expr is child:
+            return "with", cur
+        if isinstance(cur, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = cur.value
+            if value is child or _in_expr(value, load):
+                return "escape", cur  # aliased / stored
+        child, cur = cur, parents.get(cur)
+    return "use", None
+
+
+def _in_expr(expr, node) -> bool:
+    if expr is None:
+        return False
+    return any(n is node for n in ast.walk(expr))
+
+
+def _acquire_if_test(parents, call, fdef):
+    """(If, negated) when the acquire sits in an If test (``elif await
+    self.allocate_new_rollout(...)`` / ``if not bucket.try_acquire(c)``),
+    else (None, False)."""
+    negated = False
+    cur, child = parents.get(call), call
+    while cur is not None and child is not fdef:
+        if isinstance(cur, ast.UnaryOp) and isinstance(cur.op, ast.Not):
+            negated = not negated
+        if isinstance(cur, ast.If) and cur.test is child:
+            return cur, negated
+        if isinstance(cur, ast.stmt):
+            return None, False
+        child, cur = cur, parents.get(cur)
+    return None, False
+
+
+def _unsupported_shape(parents, call, fdef) -> bool:
+    """Acquires inside comprehensions, lambdas, IfExps, or nested as an
+    argument of another call degrade — the binding cannot be tracked."""
+    cur, child = parents.get(call), call
+    while cur is not None and child is not fdef:
+        if isinstance(
+            cur,
+            (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp,
+             ast.Lambda, ast.IfExp),
+        ):
+            return True
+        if isinstance(cur, ast.Call) and child is not cur.func:
+            return True  # f(pool.alloc(n)): handed off at birth
+        if isinstance(cur, ast.BoolOp):
+            # only the `x = acquire(...) or default` shape is tracked
+            par = parents.get(cur)
+            if not (
+                isinstance(par, ast.Assign) and cur.values[0] is child
+            ):
+                return True
+        if isinstance(cur, ast.stmt):
+            return False
+        child, cur = cur, parents.get(cur)
+    return False
+
+
+def _bound_handle(parents, call, fdef) -> Tuple[Optional[str], bool]:
+    """(handle dotted, bound) for a normal acquire: the single Name an
+    enclosing Assign binds. ``bound`` False means the result is
+    discarded (an Expr statement)."""
+    cur, child = parents.get(call), call
+    while cur is not None and child is not fdef:
+        if isinstance(cur, ast.Await):
+            child, cur = cur, parents.get(cur)
+            continue
+        if isinstance(cur, ast.BoolOp):
+            child, cur = cur, parents.get(cur)
+            continue
+        if isinstance(cur, ast.Assign):
+            if len(cur.targets) == 1 and isinstance(cur.targets[0], ast.Name):
+                return cur.targets[0].id, True
+            return None, True  # tuple/attribute target: untrackable
+        if isinstance(cur, ast.Expr):
+            return None, False
+        return None, True  # any other statement context: untrackable
+    return None, True
+
+
+def _analyze_function(pctx, mod, fi, catalog, attr_types, aliases):
+    ctx = pctx.file_ctx(fi.path)
+    if ctx is None:
+        return
+    parents = ctx.parents()
+    callees = pctx.graph.callees_by_node(fi.qualname)
+    local_types = _local_types(pctx, mod, fi)
+    nodes = list(walk_excluding_nested(fi.node))
+    calls = [n for n in nodes if isinstance(n, ast.Call)]
+
+    # one pass: classify every call once
+    acquire_sites: List[Tuple[ast.Call, object]] = []
+    release_sites: List[Tuple[ast.Call, object, Optional[str]]] = []
+    for c in calls:
+        spec = _match_acquire(
+            pctx, mod, fi, c, catalog, local_types, attr_types, aliases,
+            callees,
+        )
+        if spec is not None:
+            acquire_sites.append((c, spec))
+        rel = _match_release(
+            pctx, mod, fi, c, catalog, local_types, attr_types, callees
+        )
+        if rel is not None:
+            release_sites.append((c, rel[0], rel[1]))
+
+    yield from _check_obligations(
+        pctx, mod, fi, ctx, parents, callees, nodes,
+        acquire_sites, release_sites,
+    )
+    yield from _check_double_release(
+        fi, parents, nodes, acquire_sites, release_sites
+    )
+    yield from _check_release_without_acquire(
+        fi, parents, nodes, acquire_sites, release_sites
+    )
+
+
+def _handle_stores(nodes, handle: str) -> List[Tuple[int, int]]:
+    out = []
+    for n in nodes:
+        if isinstance(n, ast.Name) and n.id == handle and isinstance(
+            n.ctx, (ast.Store, ast.Del)
+        ):
+            out.append(_pos(n))
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            for t in ast.walk(n.target):
+                if isinstance(t, ast.Name) and t.id == handle:
+                    out.append(_pos(t))
+    return out
+
+
+def _check_obligations(
+    pctx, mod, fi, ctx, parents, callees, nodes, acquire_sites, release_sites,
+):
+    fdef = fi.node
+    release_by_id = {id(c): (spec, h) for c, spec, h in release_sites}
+    for call, spec in acquire_sites:
+        # context managers discharge every kind at the acquire site
+        par = parents.get(call)
+        if isinstance(par, ast.Await):
+            par = parents.get(par)
+        if isinstance(par, ast.withitem) and (
+            par.context_expr is call
+            or (
+                isinstance(par.context_expr, ast.Await)
+                and par.context_expr.value is call
+            )
+        ):
+            continue
+        discharged, malformed = _owns_match(ctx, spec, call.lineno)
+        if discharged:
+            continue
+        owns_hint = (
+            " (a malformed '# arealint: owns(...)' annotation on this "
+            "line was ignored — the grammar is owns(<resource>, <reason>) "
+            "with the exact catalog name)"
+            if malformed else ""
+        )
+        if _unsupported_shape(parents, call, fdef):
+            continue
+
+        acq_if, negated = _acquire_if_test(parents, call, fdef)
+        excluded: List[ast.AST] = []
+        if acq_if is not None:
+            excluded = (
+                list(acq_if.body) + list(acq_if.orelse)
+                if negated else list(acq_if.orelse)
+            )
+        acq_tries = _enclosing_tries(parents, call, fdef)
+        for t, arm in acq_tries:
+            if arm == "body":
+                # an exception inside the handlers of the acquiring try
+                # means the acquire itself raised (or the obligation is
+                # being settled there) — skip risky accounting in them
+                excluded.extend(t.handlers)
+
+        handle: Optional[str] = None
+        if spec.kind in ("handle", "context"):
+            m = call.func.attr if isinstance(call.func, ast.Attribute) else ""
+            if m in spec.handle_from_arg:
+                handle = _dotted(call.args[0]) if call.args else None
+                if handle is None or "." in handle:
+                    continue  # untrackable (literal / attribute chain)
+            elif m in spec.handle_is_receiver:
+                handle = _dotted(call.func.value)
+                if handle is None or "." in handle:
+                    continue  # attribute handle: object owns it (degrade)
+            else:
+                handle, bound = _bound_handle(parents, call, fdef)
+                if handle is None and bound:
+                    continue  # untrackable binding: degrade
+                if handle is None and not bound:
+                    if spec.kind == "context":
+                        yield (
+                            RULE_LEAK_EXC, fi.path, call.lineno,
+                            f"{spec.name} acquired here is never entered: "
+                            "a bare call opens nothing and the close never "
+                            "runs — use 'with'/'async with'" + owns_hint,
+                        )
+                    else:
+                        yield (
+                            RULE_LEAK_EXC, fi.path, call.lineno,
+                            f"{spec.name} acquired here is discarded — the "
+                            "handle is never bound, so no path can release "
+                            "it" + owns_hint,
+                        )
+                    continue
+
+        acq_end = _end(call)
+        stores = (
+            [p for p in _handle_stores(nodes, handle) if p > acq_end]
+            if handle else []
+        )
+        first_store = min(stores) if stores else None
+
+        # ---- collect events after the acquire ------------------------ #
+        events: List[Tuple[Tuple[int, int], str, ast.AST]] = []
+        # releases (direct)
+        for c, rspec, rh in release_sites:
+            if rspec is not spec or _pos(c) <= acq_end:
+                continue
+            if _in_subtree(parents, c, excluded, fdef):
+                continue
+            if spec.kind in ("handle", "context"):
+                if rh != handle:
+                    continue
+            events.append((_pos(c), "release", c))
+        # loads of the handle / charge receiver
+        track = handle
+        if spec.kind == "charge":
+            f = call.func
+            track = _dotted(f.value) if isinstance(f, ast.Attribute) else None
+            if track is not None and track.startswith("self"):
+                track = None  # attribute receivers don't escape locally
+        if track:
+            for load in _maximal_loads(parents, fdef, track):
+                if _pos(load) <= acq_end:
+                    continue
+                if _in_subtree(parents, load, excluded, fdef):
+                    continue
+                role, where = _load_role(pctx, parents, fdef, load, callees)
+                if role == "use":
+                    continue
+                if role == "with":
+                    events.append((_pos(load), "release", load))
+                elif role == "escape":
+                    events.append((_pos(load), "escape", load))
+                elif role == "call":
+                    if id(where) in release_by_id:
+                        continue  # already recorded as a release
+                    q = callees.get(id(where))
+                    cfi = pctx.graph.function(q) if q else None
+                    if cfi is None:
+                        events.append((_pos(load), "escape", load))
+                    elif _releases_transitively(pctx, q, spec):
+                        events.append((_pos(where), "transfer", where))
+                    elif _callee_stores(pctx, q):
+                        events.append((_pos(load), "escape", load))
+                    # else: plain use of the handle — no event
+        # charge kind: ANY later call to a transitively-releasing callee
+        # settles the charge (the receiver is shared state the callee can
+        # reach — e.g. create_task(self._rollout_task(...)))
+        if spec.kind == "charge":
+            for c in nodes:
+                if not isinstance(c, ast.Call) or _pos(c) <= acq_end:
+                    continue
+                if _in_subtree(parents, c, excluded, fdef):
+                    continue
+                if id(c) in release_by_id:
+                    continue
+                q = callees.get(id(c))
+                if q and _releases_transitively(pctx, q, spec):
+                    events.append((_pos(c), "transfer", c))
+        if first_store is not None:
+            events.append((first_store, "stop", call))
+        events.sort(key=lambda e: (e[0], e[1]))
+
+        # ---- first decisive event ------------------------------------ #
+        discharge = None
+        discharge_kind = None
+        partial: List[ast.AST] = []
+        degraded = False
+        acq_arms = _branch_arms(parents, call, fdef)
+        if acq_if is not None and not negated:
+            # the true branch IS the obligation path: releases there are
+            # unconditional relative to the acquire
+            acq_arms = acq_arms | {(id(acq_if), "body")}
+        for pos_, kind, node in events:
+            if kind in ("escape", "stop"):
+                degraded = True
+                break
+            # release: handler-arm releases protect the exception path
+            # but do not close the fall-through obligation; extra If arms
+            # (unless guarded by the handle's own truthiness) are partial
+            arms = _branch_arms(parents, node, fdef)
+            extra = arms - acq_arms
+            in_handler = any(a[1] == "handler" for a in extra)
+            cond = [a for a in extra if a[1] in ("body", "orelse")]
+            if in_handler:
+                partial.append(node)
+                continue
+            if cond and not _truthiness_guarded(
+                parents, node, fdef, handle
+            ):
+                partial.append(node)
+                continue
+            discharge = node
+            discharge_kind = kind
+            break
+        if degraded:
+            continue
+        if discharge_kind == "transfer":
+            # ownership handed to a callee that (transitively) releases:
+            # the obligation is discharged and the window degrades with
+            # it — the release lives in another function, so "wrap it in
+            # a finally here" would be wrong advice (the v2/v3 call-graph
+            # contract: resolution discharges, it never accuses)
+            continue
+
+        acq_desc = ast.unparse(call.func) + "()"
+        if discharge is None:
+            if spec.kind == "context":
+                yield (
+                    RULE_LEAK_EXC, fi.path, call.lineno,
+                    f"{spec.name} acquired by {acq_desc} is never entered "
+                    "via 'with' — the span never opens and never closes"
+                    + owns_hint,
+                )
+                continue
+            where_txt = (
+                f" (released only on some paths: line "
+                f"{partial[0].lineno})" if partial else ""
+            )
+            rule = RULE_ASYM if spec.kind == "charge" else RULE_LEAK_EXC
+            verb = "charged" if spec.kind == "charge" else "acquired"
+            fix = (
+                "refund it on every exit (try/finally), hand it to a "
+                "callee that settles it, or annotate the deliberate "
+                "handoff with "
+                f"'# arealint: owns({spec.name}, <reason>)'"
+                if spec.kind == "charge" else
+                "release it in a finally / context manager, or annotate "
+                "the deliberate handoff with "
+                f"'# arealint: owns({spec.name}, <reason>)'"
+            )
+            yield (
+                rule, fi.path, call.lineno,
+                f"{spec.name} {verb} by {acq_desc} is not released on "
+                f"every path out of {fi.name}(){where_txt} — {fix}"
+                + owns_hint,
+            )
+            continue
+
+        # ---- risky window between acquire and discharge -------------- #
+        d_start = _pos(discharge)
+        d_stmt = _stmt_of(parents, discharge, fdef)
+        protectors = [
+            n for _, k, n in events if k in ("release", "transfer")
+        ]
+        first_await = None
+        has_sync_risk = False
+        for n in nodes:
+            if not isinstance(n, (ast.Await, ast.Call, ast.Raise)):
+                continue
+            if not (acq_end < _pos(n) < d_start):
+                continue
+            if _in_subtree(parents, n, excluded, fdef):
+                continue
+            if _stmt_of(parents, n, fdef) is d_stmt:
+                continue
+            if isinstance(n, ast.Call) and any(n is p for p in protectors):
+                continue
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Name)
+                and n.func.id in _PURE_BUILTINS
+            ):
+                continue  # len()/range()/... don't realistically raise
+            if _after_release_in_same_handler(
+                parents, n, fdef, protectors
+            ):
+                # release-then-reraise cleanup arm: the obligation is
+                # already settled by the time this node runs
+                continue
+            if isinstance(n, ast.Await) and (
+                n.value is call or any(n.value is p for p in protectors)
+            ):
+                continue
+            if _is_protected(
+                parents, n, fdef, protectors,
+                cancel=isinstance(n, ast.Await),
+            ):
+                continue
+            if isinstance(n, ast.Await):
+                if first_await is None:
+                    first_await = n
+            else:
+                has_sync_risk = True
+        if first_await is not None:
+            yield (
+                RULE_LEAK_CANCEL, fi.path, first_await.lineno,
+                f"this await can be cancelled while {spec.name} (acquired "
+                f"line {call.lineno} by {acq_desc}) is held — a "
+                "CancelledError skips the release on line "
+                f"{discharge.lineno}; wrap the window in try/finally "
+                "(note: 'except Exception' does not catch CancelledError)"
+                + owns_hint,
+            )
+        elif has_sync_risk:
+            rule = RULE_ASYM if spec.kind == "charge" else RULE_LEAK_EXC
+            what = "the refund" if spec.kind == "charge" else "the release"
+            yield (
+                rule, fi.path, call.lineno,
+                f"{spec.name} acquired by {acq_desc} reaches {what} on "
+                f"line {discharge.lineno} only if nothing in between "
+                "raises — move the release into a finally / context "
+                "manager (or annotate "
+                f"'# arealint: owns({spec.name}, <reason>)')" + owns_hint,
+            )
+
+
+def _truthiness_guarded(parents, node, fdef, handle: Optional[str]) -> bool:
+    """Is ``node`` under ``if <handle>:`` / ``if <handle> is not None:``?
+    (The release is guarded by whether the acquire happened — standard
+    conditional-acquire cleanup, not a partial release.)"""
+    if handle is None:
+        return False
+    cur, child = parents.get(node), node
+    while cur is not None and child is not fdef:
+        if isinstance(cur, ast.If) and _contains(cur.body, child):
+            t = cur.test
+            if isinstance(t, (ast.Name, ast.Attribute)) and (
+                _dotted(t) == handle
+            ):
+                return True
+            if (
+                isinstance(t, ast.Compare)
+                and _dotted(t.left) == handle
+                and len(t.ops) == 1
+                and isinstance(t.ops[0], ast.IsNot)
+            ):
+                return True
+        child, cur = cur, parents.get(cur)
+    return False
+
+
+def _after_release_in_same_handler(parents, node, fdef, protectors) -> bool:
+    """True when ``node`` sits in an except handler that already released
+    the obligation earlier in the handler body (release-then-reraise)."""
+    cur, child = parents.get(node), node
+    while cur is not None and child is not fdef:
+        if isinstance(cur, ast.ExceptHandler):
+            for p in protectors:
+                if _in_expr(cur, p) and _pos(p) <= _pos(node):
+                    return True
+        child, cur = cur, parents.get(cur)
+    return False
+
+
+def _is_protected(parents, risky, fdef, protectors, cancel: bool) -> bool:
+    """A risky node is protected when a release event sits in the
+    finalbody of an enclosing try — or in a handler, except that only
+    handlers catching CancelledError protect an ``await``."""
+    for t, arm in _enclosing_tries(parents, risky, fdef):
+        if arm not in ("body", "orelse"):
+            continue
+        for p in protectors:
+            for fb in t.finalbody:
+                if _in_expr(fb, p) or fb is p:
+                    return True
+        for h in t.handlers:
+            if cancel and not _handler_cancel_safe(h):
+                continue
+            for p in protectors:
+                if _in_expr(h, p):
+                    return True
+    return False
+
+
+# --------------------------------------------------------------------- #
+# double-release
+# --------------------------------------------------------------------- #
+
+
+def _acquire_handle(parents, call, spec, fdef) -> Optional[str]:
+    """The local Name an acquire binds/targets (None: untrackable)."""
+    if isinstance(call.func, ast.Attribute):
+        m = call.func.attr
+        if m in spec.handle_from_arg:
+            h = _dotted(call.args[0]) if call.args else None
+            return h if h and "." not in h else None
+        if m in spec.handle_is_receiver:
+            h = _dotted(call.func.value)
+            return h if h and "." not in h else None
+    h, _bound = _bound_handle(parents, call, fdef)
+    return h if h and "." not in h else None
+
+
+def _check_double_release(fi, parents, nodes, acquire_sites, release_sites):
+    fdef = fi.node
+    by_handle: Dict[Tuple[str, str], List[ast.Call]] = {}
+    for c, spec, h in release_sites:
+        if spec.kind != "handle" or not h or "." in h:
+            continue
+        by_handle.setdefault((spec.name, h), []).append(c)
+    acquired_handles = set()
+    acquire_pos: Dict[str, Tuple[int, int]] = {}
+    for c, spec in acquire_sites:
+        h = _acquire_handle(parents, c, spec, fdef)
+        if h:
+            acquired_handles.add((spec.name, h))
+            acquire_pos[h] = _pos(c)
+    for (sname, h), rels in sorted(by_handle.items()):
+        if (sname, h) not in acquired_handles:
+            continue  # settle-elsewhere pattern: out of scope
+        stores = _handle_stores(nodes, h)
+        rels.sort(key=_pos)
+        # (a) two releases on one straight-line path
+        flagged = set()
+        for i, r1 in enumerate(rels):
+            for r2 in rels[i + 1:]:
+                if id(r2) in flagged:
+                    continue
+                if any(_pos(r1) < s < _pos(r2) for s in stores):
+                    continue
+                a1 = _branch_arms(parents, r1, fdef)
+                a2 = _branch_arms(parents, r2, fdef)
+                if a1 <= a2:
+                    flagged.add(id(r2))
+                    yield (
+                        RULE_DOUBLE, fi.path, r2.lineno,
+                        f"{sname} ({h!r}) is released again here — already "
+                        f"released on line {r1.lineno} with no re-acquire "
+                        "in between; the second release underflows the "
+                        "refcount (double free)",
+                    )
+        # (b) one release inside a loop, handle acquired outside it
+        for r in rels:
+            if id(r) in flagged:
+                continue
+            loop = next(
+                (
+                    a for a in _chain(parents, r, fdef)
+                    if isinstance(a, (ast.While, ast.For, ast.AsyncFor))
+                ),
+                None,
+            )
+            if loop is None:
+                continue
+            apos = acquire_pos.get(h)
+            if apos is None or _pos(loop) <= apos:
+                continue  # acquired inside the loop: rebound per iteration
+            if any(_pos(loop) < s for s in stores):
+                continue
+            yield (
+                RULE_DOUBLE, fi.path, r.lineno,
+                f"{sname} ({h!r}) is released inside a loop but acquired "
+                f"once outside it (line {apos[0]}) — the second iteration "
+                "double-frees it",
+            )
+
+
+# --------------------------------------------------------------------- #
+# release-without-acquire
+# --------------------------------------------------------------------- #
+
+
+def _check_release_without_acquire(
+    fi, parents, nodes, acquire_sites, release_sites
+):
+    fdef = fi.node
+    for r, spec, h in release_sites:
+        if spec.kind == "handle" and (not h or "." in h):
+            continue
+        matching = []
+        for c, aspec in acquire_sites:
+            if aspec is not spec:
+                continue
+            if spec.kind == "handle" and (
+                _acquire_handle(parents, c, spec, fdef) != h
+            ):
+                continue
+            matching.append(c)
+        if not matching:
+            continue  # settle-elsewhere refund: out of scope
+        def _cond_arms(n):
+            # only If arms and except handlers make an acquire
+            # conditional here — try bodies and loop bodies execute on
+            # the fall-through path
+            return frozenset(
+                a for a in _branch_arms(parents, n, fdef)
+                if a[1] in ("body", "orelse", "handler")
+            )
+
+        r_arms = _cond_arms(r)
+        if any(
+            _cond_arms(a) <= r_arms and _pos(a) < _pos(r)
+            for a in matching
+        ):
+            continue  # some acquire dominates the release
+        if spec.kind == "handle":
+            if _truthiness_guarded(parents, r, fdef, h):
+                continue
+            # a binding before the conditional acquire (``h = []``) makes
+            # the unconditional release well-defined — the acquire's own
+            # assignment target does not count (compare statement starts)
+            first_stmt = min(
+                _pos(_stmt_of(parents, a, fdef)) for a in matching
+            )
+            if any(s < first_stmt for s in _handle_stores(nodes, h)):
+                continue
+        acq_lines = ", ".join(str(a.lineno) for a in matching)
+        yield (
+            RULE_REL_NO_ACQ, fi.path, r.lineno,
+            f"{spec.name} is released here on every path, but the "
+            f"matching acquire (line {acq_lines}) happens only on some — "
+            "the no-acquire path releases a resource it never held; "
+            "guard the release with the same condition (or the handle's "
+            "truthiness)",
+        )
+
+
+# --------------------------------------------------------------------- #
+# driver + rule registration
+# --------------------------------------------------------------------- #
+
+
+def _functions_of(mod) -> Iterator[FunctionInfo]:
+    yield from mod.functions.values()
+    for ci in mod.classes.values():
+        yield from ci.methods.values()
+
+
+def _compute(pctx: ProjectContext) -> List[Tuple[str, str, int, str]]:
+    catalog = getattr(pctx.config, "resources", None)
+    if not catalog or not len(catalog):
+        return []
+    out: List[Tuple[str, str, int, str]] = []
+    acquire_names = catalog.acquire_names
+    for mname in sorted(pctx.project.modules):
+        mod = pctx.project.modules[mname]
+        attr_types = None
+        aliases = None
+        for fi in sorted(
+            _functions_of(mod), key=lambda f: _pos(f.node)
+        ):
+            # pre-scan: pay typestate inference only where an acquire
+            # name appears (the v3 donation-rule pattern)
+            present = set()
+            for n in walk_excluding_nested(fi.node):
+                if isinstance(n, ast.Call):
+                    f = n.func
+                    if isinstance(f, ast.Attribute):
+                        present.add(f.attr)
+                    elif isinstance(f, ast.Name):
+                        present.add(f.id)
+            if not (present & acquire_names):
+                continue
+            if attr_types is None:
+                attr_types = _module_attr_types(pctx, mod)
+                aliases = collect_aliases(mod.tree)
+            out.extend(
+                _analyze_function(pctx, mod, fi, catalog, attr_types, aliases)
+            )
+    out.sort(key=lambda t: (t[1], t[2], t[0]))
+    return out
+
+
+def _findings(pctx: ProjectContext):
+    cached = getattr(pctx, "_lifecycle_findings", None)
+    if cached is None:
+        cached = _compute(pctx)
+        pctx._lifecycle_findings = cached
+    return cached
+
+
+def _family(rule_id: str):
+    def check(pctx: ProjectContext):
+        for rid, path, line, msg in _findings(pctx):
+            if rid == rule_id:
+                yield path, line, msg
+    return check
+
+
+project_rule(
+    RULE_LEAK_EXC, SEVERITY_ERROR,
+    "a cataloged resource acquire whose release is not dominated by a "
+    "finally/context manager — an exception in between leaks it "
+    "(pages, leases, sessions, spans)",
+)(_family(RULE_LEAK_EXC))
+
+project_rule(
+    RULE_LEAK_CANCEL, SEVERITY_ERROR,
+    "an await between a resource acquire and its release with no "
+    "try/finally — task cancellation leaks the resource (the PR-10 "
+    "orphaned-slot cancel-race shape)",
+)(_family(RULE_LEAK_CANCEL))
+
+project_rule(
+    RULE_DOUBLE, SEVERITY_ERROR,
+    "the same handle released twice on one straight-line path (or once "
+    "inside a loop it was acquired outside of) — refcount underflow",
+)(_family(RULE_DOUBLE))
+
+project_rule(
+    RULE_REL_NO_ACQ, SEVERITY_ERROR,
+    "a release whose matching acquire happens only on some paths — the "
+    "no-acquire path releases a resource it never held",
+)(_family(RULE_REL_NO_ACQ))
+
+project_rule(
+    RULE_ASYM, SEVERITY_ERROR,
+    "a counted charge (token bucket, queue entry, slot grant) whose "
+    "refund is unreachable on an error path — the budget drifts until "
+    "the tenant/fleet starves",
+)(_family(RULE_ASYM))
