@@ -36,6 +36,7 @@ logger = logging.getLogger("metrics")
 KIND_SUM = "sum"
 KIND_PEAK = "peak"
 KIND_HISTOGRAM = "histogram"
+KIND_GAUGE = "gauge"
 
 
 def _log_spaced(lo: float, hi: float, per_decade: int) -> List[float]:
@@ -203,7 +204,7 @@ class CounterRegistry:
         return k
 
     def register_kind(self, name: str, kind: str) -> None:
-        assert kind in (KIND_SUM, KIND_PEAK, KIND_HISTOGRAM), kind
+        assert kind in (KIND_SUM, KIND_PEAK, KIND_HISTOGRAM, KIND_GAUGE), kind
         with self._lock:
             self._kinds[name] = kind
 
@@ -216,6 +217,15 @@ class CounterRegistry:
             self._kinds.setdefault(name, KIND_PEAK)
             if float(value) > self._vals.get(name, float("-inf")):
                 self._vals[name] = float(value)
+
+    def gauge(self, name: str, value: float) -> None:
+        """Last-value-wins gauge (a live setting, not an accumulation —
+        e.g. the adaptive speculative-K currently in force). Reported
+        as-is in ``delta`` views; the fleet aggregator takes the max
+        across workers."""
+        with self._lock:
+            self._kinds.setdefault(name, KIND_GAUGE)
+            self._vals[name] = float(value)
 
     def observe(self, name: str, value: float, n: int = 1) -> None:
         """Record ``n`` observations of ``value`` into the histogram
@@ -254,12 +264,17 @@ class CounterRegistry:
 
     def delta(self, before: Dict[str, float]) -> Dict[str, float]:
         """Per-interval scalar view: current snapshot minus ``before`` for
-        sum-kind keys; peak-kind keys report as-is (a maximum has no
-        meaningful difference). Histograms are not part of the scalar
-        delta — read them via ``histogram``/``histogram_summaries``."""
+        sum-kind keys; peak-kind and gauge-kind keys report as-is (a
+        maximum or a live setting has no meaningful difference).
+        Histograms are not part of the scalar delta — read them via
+        ``histogram``/``histogram_summaries``."""
         now = self.snapshot()
         return {
-            k: (v if self.kind(k) == KIND_PEAK else v - before.get(k, 0.0))
+            k: (
+                v
+                if self.kind(k) in (KIND_PEAK, KIND_GAUGE)
+                else v - before.get(k, 0.0)
+            )
             for k, v in now.items()
         }
 
@@ -414,6 +429,16 @@ GEN_SPEC_ACCEPT_LEN = "gen/spec_accept_len"
 GEN_SPEC_Q_ACCEPT_PROB = "gen/spec_q_accept_prob"
 GEN_DRAFT_KV_POOL_OCCUPANCY = "gen/draft_kv_pool_occupancy"
 
+# Fused sampling epilogue (docs/performance.md "Fused sampling
+# epilogue"): decode steps sampled through the streamed LM-head epilogue
+# vs rows that fell back to the sorted reference path (top-p / oversize
+# top-k slots) — their ratio is the fused coverage of live traffic —
+# plus the adaptive speculative-K currently in force (a gauge: last value
+# wins locally, fleet aggregation takes the max across workers).
+GEN_FUSED_SAMPLE_STEPS = "gen/fused_sample_steps"
+GEN_SAMPLER_FALLBACK_ROWS = "gen/sampler_fallback_rows"
+GEN_SPEC_K_CURRENT = "gen/spec_k_current"
+
 # Chunk-boundary sync protocol (docs/performance.md "Speculative
 # decoding" / chunk pipelining): every decode chunk's harvest-flag fetch
 # is dispatch-ahead (the D2H copy is enqueued at dispatch, resolved one
@@ -489,6 +514,7 @@ METRIC_KINDS: Dict[str, str] = {
     TTFC_S: KIND_HISTOGRAM,
     REWARD_LAG_S: KIND_HISTOGRAM,
     GEN_SPEC_ACCEPT_LEN: KIND_HISTOGRAM,
+    GEN_SPEC_K_CURRENT: KIND_GAUGE,
     GEN_SPEC_Q_ACCEPT_PROB: KIND_HISTOGRAM,
     GEN_KV_POOL_OCCUPANCY: KIND_HISTOGRAM,
     GEN_DRAFT_KV_POOL_OCCUPANCY: KIND_HISTOGRAM,

@@ -47,6 +47,13 @@ class Drafter:
     proposals without q would silently bias generation toward the
     drafter (PPO corruption). ``propose`` executes under ``jax.jit``
     inside a ``lax.scan`` body.
+
+    ``k`` is a STATIC argument the engine may change between chunks:
+    adaptive spec-K (``AREAL_SPEC_K_ADAPT``) retunes the draft length
+    from the live accept-length histogram, so ``propose`` /
+    ``propose_model`` must be pure in ``k`` (no k-dependent Python state)
+    — each K gets its own jitted spec-chunk specialization, bounded by
+    the engine's fixed choice set, never by traffic.
     """
 
     deterministic: bool = True

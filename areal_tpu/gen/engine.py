@@ -67,6 +67,7 @@ from areal_tpu.gen.sampling import (
 )
 from areal_tpu.models import transformer as tfm
 from areal_tpu.models.config import ModelConfig
+from areal_tpu.ops import fused_sample as fused_ops
 
 logger = logging.getLogger("areal_tpu.gen.engine")
 
@@ -172,6 +173,15 @@ class _SlotInfo:
 
 
 class GenerationEngine:
+    # Adaptive spec-K policy (AREAL_SPEC_K_ADAPT): retune after WINDOW
+    # accept-length observations; step K up when the windowed mean accept
+    # length clears UP * K (drafts are nearly free), down when it falls
+    # under DOWN * K (verify sweeps are mostly wasted). The UP/DOWN gap is
+    # the hysteresis band that keeps K from oscillating at a boundary.
+    SPEC_K_ADAPT_WINDOW = 128
+    SPEC_K_ADAPT_UP = 0.75
+    SPEC_K_ADAPT_DOWN = 0.25
+
     def __init__(
         self,
         cfg: ModelConfig,
@@ -192,6 +202,8 @@ class GenerationEngine:
         spec_decode: Optional[bool] = None,
         spec_k: Optional[int] = None,
         drafter: Optional[Drafter] = None,
+        fused_sample: Optional[bool] = None,
+        spec_k_adapt: Optional[bool] = None,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -456,6 +468,13 @@ class GenerationEngine:
         # no resident slot warps, the decode chunk skips the [B, V] sort —
         # the most expensive op of a step at a 152k vocab
         self._warp_host = np.zeros((self.B,), bool)
+        # fused-epilogue routing mirrors: under the fused sampler a slot
+        # only needs the sorted fallback for machinery the online pass
+        # lacks — top-p, or top-k wider than the online buffer
+        # (_fused_warp_host); plain top-k slots up to TOPK_MAX stay fused
+        # through the online top-k buffer (_fused_topk_host)
+        self._fused_warp_host = np.zeros((self.B,), bool)
+        self._fused_topk_host = np.zeros((self.B,), bool)
         self._pending: List[GenRequest] = []
         self._req_meta: Dict[str, GenRequest] = {}
         # chunk pipelining (step() docstring): harvest one chunk late so
@@ -473,6 +492,32 @@ class GenerationEngine:
         self.spec_k = max(
             1, spec_k if spec_k is not None else constants.spec_k()
         )
+        # fused sampling epilogue (docs/performance.md "Fused sampling
+        # epilogue"): decode/verify chunks return final-norm hidden states
+        # and the sampler streams the LM head over vocab blocks — the
+        # [B, V] logits (and their sort) leave the per-token path. Exact
+        # for greedy, distribution-exact otherwise; top-p (and top-k >
+        # TOPK_MAX) slots keep the sorted path via the warp-row bucket.
+        self.fused = (
+            fused_sample
+            if fused_sample is not None
+            else constants.fused_sample_enabled()
+        )
+        # adaptive spec-K: retune the draft length from the live accept-len
+        # histogram the engine already folds per chunk. K only moves within
+        # a small fixed choice set so jitted spec-chunk specializations
+        # stay bounded (one per (chunk key, K) pair, K in _spec_k_choices).
+        self.spec_k_adapt = (
+            spec_k_adapt
+            if spec_k_adapt is not None
+            else constants.spec_k_adapt_enabled()
+        )
+        self._spec_k_choices = sorted({1, 2, 4, 8} | {self.spec_k})
+        self._accept_window: List[float] = []
+        if self.spec:
+            metrics_mod.counters.gauge(
+                metrics_mod.GEN_SPEC_K_CURRENT, float(self.spec_k)
+            )
         self._prev_flags = None           # chunk k's undonated flag outputs
         self._prev_running: tuple = ()    # (slot, epoch) pairs at k's dispatch
         self._steps_ahead = 0   # token-advance bound of the in-flight chunk
@@ -723,6 +768,8 @@ class GenerationEngine:
                     self._table_host[b] = 0
                     self._lens_host[b] = 0
                     self._warp_host[b] = False
+                    self._fused_warp_host[b] = False
+                    self._fused_topk_host[b] = False
                     with self._pending_lock:
                         self._req_meta.pop(rid, None)
                     # deactivate on device so later chunks stop feeding the
@@ -1071,6 +1118,16 @@ class GenerationEngine:
                 self._warp_host[slot] = (
                     r.top_p < 1.0 or r.top_k < self.cfg.vocab_size
                 ) and not r.greedy and r.temperature > 0.0
+                sampled = not r.greedy and r.temperature > 0.0
+                topk_on = r.top_k < self.cfg.vocab_size
+                self._fused_warp_host[slot] = sampled and (
+                    r.top_p < 1.0
+                    or (topk_on and r.top_k > fused_ops.TOPK_MAX)
+                )
+                self._fused_topk_host[slot] = (
+                    sampled and r.top_p >= 1.0
+                    and topk_on and r.top_k <= fused_ops.TOPK_MAX
+                )
                 temp[j] = 0.0 if r.greedy else r.temperature
                 top_p[j] = r.top_p
                 top_k[j] = min(r.top_k, 1 << 30)
@@ -1092,25 +1149,36 @@ class GenerationEngine:
     # Decode
     # ------------------------------------------------------------------ #
 
-    def _chunk_fn(self, n_steps: int, width: int, warp_bucket: int):
+    def _chunk_fn(self, n_steps: int, width: int, warp_bucket: int,
+                  fused: bool = False, with_topk: bool = False):
         """``warp_bucket`` (STATIC jit key): power-of-two capacity of the
         per-slot warping-index operand, 0 = no resident slot warps. The
         top-p/top-k sort — the most expensive op of a decode step at a
         152k vocab — runs over the warping slots ONLY
         (``warp_logits_rows``); one top-p request no longer drags the
         whole batch through a ``[B, V]`` sort, and greedy-only traffic
-        skips it entirely. Specializations stay bounded by log2 buckets."""
-        key = (n_steps, width, warp_bucket)
+        skips it entirely. Specializations stay bounded by log2 buckets.
+
+        ``fused`` (STATIC, AREAL_FUSED_SAMPLE): the decode step returns
+        final-norm hidden states and ``ops/fused_sample.py`` streams the
+        LM head over vocab blocks — the ``[B, V]`` logits never
+        materialize. Under fused routing the warp bucket holds only the
+        slots the online pass cannot serve (top-p, top-k > TOPK_MAX);
+        those rows materialize their OWN logits rows and keep the sorted
+        reference sampler. ``with_topk`` (STATIC) carries the online
+        top-k buffer for resident plain-top-k slots."""
+        key = (n_steps, width, warp_bucket, fused, with_topk)
         if key in self._jit_chunk:
             return self._jit_chunk[key]
         cfg = self.cfg
 
         def one_step(state: GenState, params, draft_params, table, warp_rows):
-            logits, cache, new_lens = tfm.decode_step_paged(
+            head_out, cache, new_lens = tfm.decode_step_paged(
                 params, cfg, state.cache, state.last_tokens, table,
                 state.lens, state.active,
                 use_pallas=self._decode_use_pallas,
                 mesh=self.mesh,
+                return_hidden=fused,
             )
             if self._draft is not None:
                 # keep the draft pool current: one HEADLESS draft decode
@@ -1130,16 +1198,59 @@ class GenerationEngine:
             else:
                 draft_cache = state.draft_cache
             if self.mesh is not None:
-                # one explicit all-gather of the [B, V] logits: sampling
-                # (sort-based top-k/top-p) runs replicated instead of
-                # through compiler-chosen per-op resharding
-                logits = jax.lax.with_sharding_constraint(logits, self._repl)
+                # one explicit all-gather of the [B, V] logits (fused: the
+                # much smaller [B, E] hidden states): sampling (sort-based
+                # top-k/top-p) runs replicated instead of through
+                # compiler-chosen per-op resharding
+                head_out = jax.lax.with_sharding_constraint(
+                    head_out, self._repl
+                )
             rng, sub = jax.random.split(state.rng)
-            if warp_bucket == 0:
-                tokens, lp = sample_tokens(sub, logits, state.sp, warp=False)
+            if fused:
+                sp = state.sp
+                greedy_rows = sp.temperature <= 0.0
+                topk_arg = None
+                if with_topk:
+                    # inactive rows (and rows past the buffer) carry a
+                    # sentinel > TOPK_MAX so fused_sample ignores them
+                    topk_arg = jnp.where(
+                        (sp.top_k <= fused_ops.TOPK_MAX) & ~greedy_rows,
+                        sp.top_k, jnp.int32(1 << 30),
+                    )
+                out = fused_ops.fused_sample(
+                    sub, head_out, tfm.head_weight(cfg, params),
+                    sp.temperature, greedy_rows,
+                    soft_cap=cfg.final_logits_soft_cap,
+                    topk=topk_arg, mesh=self.mesh,
+                )
+                tokens, lp = out["tokens"], out["logprobs"]
+                if warp_bucket > 0:
+                    # sorted fallback for the warp-bucket rows: materialize
+                    # ONLY their logits rows through the head and run the
+                    # reference sampler on them; padding indices (== B)
+                    # clip on the gather and drop on the scatter
+                    rng, sub2 = jax.random.split(rng)
+                    safe = jnp.clip(warp_rows, 0, self.B - 1)
+                    row_logits = tfm.apply_head(
+                        cfg, params, head_out[safe]
+                    )
+                    sub_sp = SamplingParams(
+                        temperature=sp.temperature[safe],
+                        top_p=sp.top_p[safe],
+                        top_k=sp.top_k[safe],
+                    )
+                    w_tok, w_lp = sample_tokens(
+                        sub2, row_logits, sub_sp, warp=True
+                    )
+                    tokens = tokens.at[warp_rows].set(w_tok, mode="drop")
+                    lp = lp.at[warp_rows].set(w_lp, mode="drop")
+            elif warp_bucket == 0:
+                tokens, lp = sample_tokens(
+                    sub, head_out, state.sp, warp=False
+                )
             else:
                 tokens, lp = sample_tokens(
-                    sub, logits, state.sp, warp=True, warp_rows=warp_rows
+                    sub, head_out, state.sp, warp=True, warp_rows=warp_rows
                 )
             tokens = jnp.where(state.active, tokens, state.last_tokens)
             rows = jnp.arange(tokens.shape[0])
@@ -1226,8 +1337,15 @@ class GenerationEngine:
     # harvest protocol (pipelining, pause, weight swap untouched).
     # ------------------------------------------------------------------ #
 
-    def _spec_chunk_fn(self, n_steps: int, width: int, warp_bucket: int):
-        key = (n_steps, width, warp_bucket, self.spec_k)
+    def _spec_chunk_fn(self, n_steps: int, width: int, warp_bucket: int,
+                       fused: bool = False):
+        """``fused`` (STATIC): verify returns final-norm hidden states and
+        ``ops/fused_sample.fused_spec_rejection`` runs acceptance from the
+        streamed head — one-hot (deterministic) drafters only; the engine
+        routes draft-model (general-q) spec through the materialized
+        verify path regardless of the flag. Warp-bucket rows keep the
+        sorted reference rejection sampler over their own logits rows."""
+        key = (n_steps, width, warp_bucket, self.spec_k, fused)
         if key in self._jit_spec:
             return self._jit_spec[key]
         cfg = self.cfg
@@ -1277,28 +1395,69 @@ class GenerationEngine:
             chunk_toks = jnp.concatenate(
                 [state.last_tokens[:, None], draft], axis=1
             )                                             # [B, C]
-            logits, cache = tfm.verify_step_paged(
+            verify_out, cache = tfm.verify_step_paged(
                 params, cfg, state.cache, chunk_toks, table, state.lens,
-                n_new, write_mask,
+                n_new, write_mask, return_hidden=fused,
             )
             if self.mesh is not None:
                 # sampling runs replicated after one logits all-gather
-                # (same constraint as the vanilla chunk)
-                logits = jax.lax.with_sharding_constraint(
-                    logits, self._repl
+                # (fused: the [B, C, E] hidden states — same constraint
+                # as the vanilla chunk)
+                verify_out = jax.lax.with_sharding_constraint(
+                    verify_out, self._repl
                 )
             rng, sub = jax.random.split(rng0)
-            # same per-slot warp narrowing as the vanilla chunk: only the
-            # warping slots' K+1 verify rows pay the sort. Sampled
-            # drafters feed the general-q branch; their per-position
-            # accept probability rides out as the draft-quality signal.
-            rej = spec_rejection_sample(
-                sub, logits, draft, state.sp, warp=warp_bucket > 0,
-                warp_rows=warp_rows if warp_bucket > 0 else None,
-                q_logprobs=q_logprobs, return_accept_prob=has_q,
-            )
-            a, cand, cand_lp, boundary_arg = rej[:4]
-            q_acc_row = rej[4].mean(axis=1) if has_q else None  # [B]
+            if fused:
+                # one-hot drafter guaranteed by the dispatch routing:
+                # acceptance runs from the streamed head, [B, C, V] verify
+                # logits never materialize
+                sp = state.sp
+                a, cand, cand_lp, boundary_arg = (
+                    fused_ops.fused_spec_rejection(
+                        sub, verify_out, tfm.head_weight(cfg, params),
+                        draft, sp, soft_cap=cfg.final_logits_soft_cap,
+                        mesh=self.mesh,
+                    )
+                )
+                if warp_bucket > 0:
+                    # warping slots (top-p / top-k) keep the sorted
+                    # reference rejection sampler over their OWN
+                    # [W, C, V] logits rows; padding indices (== B) clip
+                    # on the gather and drop on the scatter
+                    rng, sub2 = jax.random.split(rng)
+                    safe = jnp.clip(warp_rows, 0, B - 1)
+                    row_logits = tfm.apply_head(
+                        cfg, params, verify_out[safe]
+                    )
+                    sub_sp = SamplingParams(
+                        temperature=sp.temperature[safe],
+                        top_p=sp.top_p[safe],
+                        top_k=sp.top_k[safe],
+                    )
+                    a_w, tok_w, lp_w, barg_w = spec_rejection_sample(
+                        sub2, row_logits, draft[safe], sub_sp, warp=True
+                    )
+                    a = a.at[warp_rows].set(a_w, mode="drop")
+                    cand = cand.at[warp_rows].set(tok_w, mode="drop")
+                    cand_lp = cand_lp.at[warp_rows].set(lp_w, mode="drop")
+                    boundary_arg = boundary_arg.at[warp_rows].set(
+                        barg_w, mode="drop"
+                    )
+                q_acc_row = None
+            else:
+                # same per-slot warp narrowing as the vanilla chunk: only
+                # the warping slots' K+1 verify rows pay the sort. Sampled
+                # drafters feed the general-q branch; their per-position
+                # accept probability rides out as the draft-quality
+                # signal.
+                rej = spec_rejection_sample(
+                    sub, verify_out, draft, state.sp,
+                    warp=warp_bucket > 0,
+                    warp_rows=warp_rows if warp_bucket > 0 else None,
+                    q_logprobs=q_logprobs, return_accept_prob=has_q,
+                )
+                a, cand, cand_lp, boundary_arg = rej[:4]
+                q_acc_row = rej[4].mean(axis=1) if has_q else None  # [B]
             # masked variable-length advance: accepted drafts + one
             # residual token, capped at the remaining budget, truncated at
             # the first accepted stop token (stop included, like vanilla)
@@ -1415,6 +1574,13 @@ class GenerationEngine:
             metrics_mod.counters.observe(
                 metrics_mod.GEN_SPEC_ACCEPT_LEN, float(v), n=int(c)
             )
+        if self.spec_k_adapt:
+            # adaptive spec-K rides the same per-chunk fold: the window
+            # sees every (step, slot) accept length the histogram does
+            self._accept_window.extend(
+                accepted[drafted > 0].astype(np.float64).tolist()
+            )
+            self._maybe_adapt_spec_k()
         if len(aux) > 2:
             # general-q drafter: per-(step, slot) mean accept probability.
             # The grid is CONTINUOUS floats (np.unique would give no
@@ -1434,6 +1600,43 @@ class GenerationEngine:
                     metrics_mod.GEN_SPEC_Q_ACCEPT_PROB,
                     float(sel.mean()), n=int(sel.size),
                 )
+
+    def _maybe_adapt_spec_k(self):
+        """Retune ``spec_k`` from the windowed mean accept length (called
+        under the engine lock on the per-chunk stats fold, so the next
+        ``_decode_chunk_fn`` — same lock — sees the new K). K moves ONE
+        step within ``_spec_k_choices``, keeping jitted spec-chunk
+        specializations bounded by the fixed choice set; the UP/DOWN
+        hysteresis band (class constants) keeps a workload sitting at a
+        boundary from thrashing between two K programs. The window
+        resets on every retune so the new K is judged on its own
+        evidence, not the old K's accept lengths."""
+        if len(self._accept_window) < self.SPEC_K_ADAPT_WINDOW:
+            return
+        window = self._accept_window[-self.SPEC_K_ADAPT_WINDOW:]
+        mean_acc = sum(window) / len(window)
+        i = self._spec_k_choices.index(self.spec_k)
+        new_k = self.spec_k
+        if (
+            mean_acc >= self.SPEC_K_ADAPT_UP * self.spec_k
+            and i + 1 < len(self._spec_k_choices)
+        ):
+            new_k = self._spec_k_choices[i + 1]
+        elif mean_acc <= self.SPEC_K_ADAPT_DOWN * self.spec_k and i > 0:
+            new_k = self._spec_k_choices[i - 1]
+        if new_k != self.spec_k:
+            logger.info(
+                "adaptive spec-K: %d -> %d (windowed mean accept %.2f)",
+                self.spec_k, new_k, mean_acc,
+            )
+            self.spec_k = new_k
+            self._accept_window.clear()
+            metrics_mod.counters.gauge(
+                metrics_mod.GEN_SPEC_K_CURRENT, float(new_k)
+            )
+        else:
+            # bound the host-side window without numpy churn
+            del self._accept_window[: -self.SPEC_K_ADAPT_WINDOW]
 
     def _warp_bucket(self, n: int) -> int:
         """Power-of-two capacity bucket for the warping-slot index operand
@@ -1460,11 +1663,47 @@ class GenerationEngine:
         ``[B, V]`` sort (the old static ``warp=True`` key did exactly
         that)."""
         tok_bound = decode_steps * ((self.spec_k + 1) if self.spec else 1)
-        warp_slots = [b for b in running if self._warp_host[b]]
+        # fused routing (AREAL_FUSED_SAMPLE): the vanilla chunk narrows
+        # the fallback bucket to the slots the online pass cannot serve
+        # (_fused_warp_host — top-p, top-k > TOPK_MAX); plain top-k slots
+        # ride the online buffer instead of the sort. The spec chunk's
+        # fused acceptance has no top-k buffer, so it keeps the full
+        # _warp_host bucket; draft-model (general-q) spec stays on the
+        # materialized verify path entirely.
+        fused_spec = self.fused and self._draft is None
+        fused_vanilla = self.fused
+        if not self.spec and fused_vanilla:
+            mirror = self._fused_warp_host
+        else:
+            mirror = self._warp_host
+        warp_slots = [b for b in running if mirror[b]]
         wb = self._warp_bucket(len(warp_slots))
         warp_idx = np.full((wb,), self.B, np.int32)  # padding => scatter-drop
         warp_idx[: len(warp_slots)] = warp_slots
-        make = self._spec_chunk_fn if self.spec else self._chunk_fn
+        if self.spec:
+            fused_on = fused_spec
+
+            def make(n, w, b, _f=fused_spec):
+                return self._spec_chunk_fn(n, w, b, fused=_f)
+
+        else:
+            fused_on = fused_vanilla
+            tk = fused_vanilla and any(
+                self._fused_topk_host[b] for b in running
+            )
+
+            def make(n, w, b, _f=fused_vanilla, _tk=tk):
+                return self._chunk_fn(n, w, b, fused=_f, with_topk=_tk)
+
+        if fused_on:
+            metrics_mod.counters.add(
+                metrics_mod.GEN_FUSED_SAMPLE_STEPS, decode_steps
+            )
+            if warp_slots:
+                metrics_mod.counters.add(
+                    metrics_mod.GEN_SAMPLER_FALLBACK_ROWS,
+                    len(warp_slots) * decode_steps,
+                )
         return make, tok_bound, wb, warp_idx
 
     def _dispatch_chunk(self, chunk, W: int, warp_idx) -> tuple:
@@ -1531,6 +1770,8 @@ class GenerationEngine:
         self._table_host[b] = 0
         self._lens_host[b] = 0
         self._warp_host[b] = False
+        self._fused_warp_host[b] = False
+        self._fused_topk_host[b] = False
         with self._pending_lock:
             self._req_meta.pop(info.rid, None)
         return GenOutput(

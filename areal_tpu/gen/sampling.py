@@ -280,8 +280,25 @@ def sample_tokens(
     requests, not for the batch. The result is EXACT in every mode.
     """
     if not warp:
+        # no-warp fast path: only the SAMPLED token's logprob is reported,
+        # so gather-then-normalize (logp[t] = warped[t] - logsumexp) skips
+        # the full [B, V] log_softmax materialization — same math as
+        # jax.nn.log_softmax at the gathered index, exactness pinned by
+        # tests/test_fused_sample.py
         warped = _plain_temperature(logits, sp)
-    elif warp_rows is not None:
+        sampled = jax.random.categorical(rng, warped, axis=-1)
+        arg = jnp.argmax(logits, axis=-1)
+        if greedy is None:
+            greedy = sp.temperature <= 0.0
+        tokens = jnp.where(greedy, arg, sampled).astype(jnp.int32)
+        gathered = jnp.take_along_axis(warped, tokens[:, None], axis=-1)
+        lp = (
+            gathered - jax.scipy.special.logsumexp(
+                warped, axis=-1, keepdims=True
+            )
+        )[:, 0]
+        return tokens, lp
+    if warp_rows is not None:
         warped = warp_logits_rows(logits, sp, warp_rows)
     else:
         warped = warp_logits(logits, sp)

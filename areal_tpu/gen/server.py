@@ -608,6 +608,14 @@ class GenerationHTTPServer:
             # breakeven signal a manager would act on via /spec_decode)
             "spec_decode": self.engine.spec,
             "spec_k": self.engine.spec_k,
+            # adaptive spec-K: whether retuning is on and the CURRENT K
+            # (spec_k_current == spec_k; kept as its own field so scrapers
+            # tracking the gen/spec_k_current gauge read one name)
+            "spec_k_adapt": self.engine.spec_k_adapt,
+            "spec_k_current": self.engine.spec_k,
+            # fused sampling epilogue (docs/performance.md): streamed
+            # LM-head sampling on the decode chunk
+            "fused_sample": self.engine.fused,
             "spec_accept_rate": round(
                 self.engine.stats["spec_accepted_tokens"]
                 / max(self.engine.stats["spec_draft_tokens"], 1), 4

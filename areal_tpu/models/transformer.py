@@ -295,18 +295,32 @@ def _embed(cfg: ModelConfig, params: Params, input_ids, positions):
     return x
 
 
+def head_weight(cfg: ModelConfig, params: Params):
+    """The LM-head weight ``[E, V]`` in serving dtype (tied embeddings
+    transpose on the fly — a lazy view XLA fuses into the consumer). The
+    fused sampling epilogue streams this over vocab blocks instead of
+    calling :func:`_head`; the soft cap, when configured, must be applied
+    by the consumer (``ops/fused_sample.py`` takes it as an argument)."""
+    if cfg.tied_embedding:
+        return _cast(cfg, params["embed"]["weight"]).T
+    return _cast(cfg, params["head"]["weight"])
+
+
 def _head(cfg: ModelConfig, params: Params, x):
     if cfg.is_critic:
         return (x @ _cast(cfg, params["head"]["weight"])).astype(jnp.float32)
-    if cfg.tied_embedding:
-        w = _cast(cfg, params["embed"]["weight"]).T
-    else:
-        w = _cast(cfg, params["head"]["weight"])
-    logits = (x @ w).astype(jnp.float32)
+    logits = (x @ head_weight(cfg, params)).astype(jnp.float32)
     if cfg.final_logits_soft_cap is not None:
         c = cfg.final_logits_soft_cap
         logits = c * jnp.tanh(logits / c)
     return logits
+
+
+def apply_head(cfg: ModelConfig, params: Params, x):
+    """Public :func:`_head`: full logits from final-norm hidden states —
+    the engine's sorted-fallback rows (top-p slots under the fused
+    epilogue) materialize ONLY their own rows' logits through this."""
+    return _head(cfg, params, x)
 
 
 def forward_packed(
@@ -854,6 +868,7 @@ def verify_step_paged(
     lens: jnp.ndarray,         # [B] resident tokens (chunk starts here)
     n_new: jnp.ndarray,        # [B] C where the slot is active, 0 otherwise
     write_mask: jnp.ndarray,   # [B, C] which chunk positions' KV may land
+    return_hidden: bool = False,
 ) -> Tuple[jnp.ndarray, PagedKVCache]:
     """Speculative-decode VERIFY: ``decode_step_paged`` generalized to C =
     K+1 query tokens per slot in ONE pass — one params read and one pool
@@ -861,6 +876,11 @@ def verify_step_paged(
     Returns fp32 logits ``[B, C, V]`` (position ``i`` is the distribution
     for the token following ``tokens[:, i]``) and the cache with the
     chunk's KV scattered where ``write_mask`` allows.
+
+    ``return_hidden=True`` (STATIC) returns the final-NORM hidden states
+    ``[B, C, E]`` instead of logits: the fused sampling epilogue
+    (``ops/fused_sample.py``) streams the head over vocab blocks itself,
+    so the ``[B, C, V]`` logits never materialize.
 
     ``write_mask`` is the acceptance-agnostic residency bound the engine
     computes (``active & (n_gen + i < max_gen)``): rejected drafts' KV
@@ -876,6 +896,8 @@ def verify_step_paged(
     )
     cache = _scatter_chunk_kv(cache, ks, vs, table, positions, write_mask)
     x = _norm(cfg, _cast(cfg, params["final_ln"]), x)
+    if return_hidden:
+        return x, cache
     return _head(cfg, params, x), cache
 
 
@@ -890,6 +912,7 @@ def decode_step_paged(
     use_pallas: Optional[bool] = None,
     mesh=None,
     with_head: bool = True,
+    return_hidden: bool = False,
 ) -> Tuple[Optional[jnp.ndarray], PagedKVCache, jnp.ndarray]:
     """One decode step over the page pool. Returns (fp32 logits ``[B, V]``,
     cache, new lens — incremented where active). The pool is read-only in
@@ -906,7 +929,12 @@ def decode_step_paged(
     returns ``None`` logits: the cache-maintenance step the engine's
     vanilla chunk runs for a configured draft model only needs the KV
     writes — the head matmul (the biggest single matmul of a small
-    model's step at a 152k vocab) would be dead weight."""
+    model's step at a 152k vocab) would be dead weight.
+
+    ``return_hidden=True`` (STATIC) returns the final-norm HIDDEN states
+    ``[B, E]`` in place of logits for the fused sampling epilogue
+    (``ops/fused_sample.py``), which streams the head itself — the
+    ``[B, V]`` logits never materialize."""
     from areal_tpu.ops import paged_attention as paged_ops
 
     positions = lens
@@ -949,4 +977,6 @@ def decode_step_paged(
     if not with_head:
         return None, cache, new_lens
     x = _norm(cfg, _cast(cfg, params["final_ln"]), x)
+    if return_hidden:
+        return x, cache, new_lens
     return _head(cfg, params, x), cache, new_lens

@@ -110,7 +110,12 @@ def paged_decode_attention(
     attention is per-head independent and the head groups align with the
     pool's kv-head sharding, so each model shard runs the kernel on its
     LOCAL pool slice — no all-gather, no XLA-gather fallback on the TP
-    serving hot path. The scales array shards on the same kv-head axis."""
+    serving hot path. The scales array shards on the same kv-head axis.
+
+    This is the attention half of the decode-step roofline; the OTHER
+    half — the LM head + sampling epilogue — streams through
+    ``ops/fused_sample.py`` under ``AREAL_FUSED_SAMPLE`` (same
+    auto-detect-then-fallback dispatch shape as ``use_pallas`` here)."""
     B, H, D = q.shape
     Hkv = pages.shape[3]
     n_rep = H // Hkv

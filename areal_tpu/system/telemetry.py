@@ -122,7 +122,11 @@ class FleetAggregate:
             if kind is None:
                 kind = metrics_mod.KIND_SUM
             self.kinds[k] = kind
-            if kind == metrics_mod.KIND_PEAK:
+            if kind in (metrics_mod.KIND_PEAK, metrics_mod.KIND_GAUGE):
+                # peaks: fleet max by definition; gauges: a fleet of
+                # identical-config workers reports one live setting, and
+                # max is the conservative merge when they briefly differ
+                # (e.g. adaptive spec-K retuning at different times)
                 self.counters[k] = max(self.counters.get(k, float("-inf")), v)
             else:
                 self.counters[k] = self.counters.get(k, 0.0) + v

@@ -20,6 +20,9 @@ counterpart of the reference's "Generation throughput: X tokens/s" log,
 - ``gen_kvq``: bf16 vs int8-quantized KV pool A/B at the 64-slot config
   plus a doubled-slot int8 run at equal pool HBM — tokens/s, vs_baseline,
   max decode logit delta (docs/performance.md "KV quantization")
+- ``gen_sample_fused``: materialized-logits vs fused LM-head + sampling
+  epilogue A/B at the 64-slot config — tokens/s, vs_baseline, max
+  sampled-logprob delta (docs/performance.md "Fused sampling epilogue")
 - ``ppo``: a complete in-process async-PPO round (generate a GRPO group
   per prompt -> verify -> decoupled-PPO train step -> weight swap into
   the engine) — reward-samples/sec/chip, the north-star unit
@@ -495,6 +498,124 @@ def _bench_gen_spec(
         "draft_vs_baseline": round(draft["tokens_per_s"] / base, 4),
         "draft_layers": draft_layers,
         "draft_gamma": draft_gamma,
+    }
+
+
+def _fused_lp_delta(cfg, params, prompt) -> float:
+    """Max abs sampled-logprob delta between the fused epilogue and the
+    materialize-then-sample reference on one greedy decode step — the
+    exactness probe the gen_sample_fused stanza reports next to its
+    throughput numbers (greedy logprobs must agree to float-associativity
+    noise). Pure model-layer probe, no engine state involved."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.gen.sampling import SamplingParams, sample_tokens
+    from areal_tpu.models import transformer as tfm
+    from areal_tpu.ops import fused_sample as fused_ops
+
+    plen = len(prompt) - 1
+    page = 8 if plen < 128 else 128
+    M = -(-(plen + 1) // page)
+    table = jnp.arange(M, dtype=jnp.int32)[None]
+    toks = jnp.asarray(prompt[:plen], jnp.int32)[None]
+    last = jnp.asarray([prompt[plen]], jnp.int32)
+    cache = tfm.PagedKVCache.empty(cfg, M, page)
+    cache = tfm.extend_paged(
+        params, cfg, cache, toks, table,
+        jnp.zeros((1,), jnp.int32), jnp.asarray([plen], jnp.int32),
+    )
+    args = (params, cfg, cache, last, table,
+            jnp.asarray([plen], jnp.int32), jnp.ones((1,), bool))
+    logits, _, _ = tfm.decode_step_paged(*args, use_pallas=False)
+    hidden, _, _ = tfm.decode_step_paged(
+        *args, use_pallas=False, return_hidden=True
+    )
+    sp = SamplingParams.filled(1, temperature=0.0)
+    key = jax.random.key(0)
+    _, ref_lp = sample_tokens(key, logits, sp, warp=False)
+    out = fused_ops.fused_sample(
+        key, hidden, tfm.head_weight(cfg, params), sp.temperature,
+        sp.temperature <= 0.0, soft_cap=cfg.final_logits_soft_cap,
+        use_pallas=False,
+    )
+    return float(np.abs(
+        np.asarray(jax.device_get(out["logprobs"]))
+        - np.asarray(jax.device_get(ref_lp))
+    ).max())
+
+
+def _bench_gen_sample_fused(
+    peak_bw: float,
+    peak: float,
+    cfg=None,
+    B: int = 64,
+    PLEN: int = 1024,
+    D_STEPS: int = 32,
+    N_CHUNKS: int = 4,
+):
+    """A/B the fused LM-head + sampling epilogue (docs/performance.md
+    "Fused sampling epilogue") at the standard 64-slot/1024-prompt
+    generation config: the baseline arm materializes ``[B, V]`` logits
+    every decode step and samples over them; the fused arm streams the
+    head over vocab blocks (``AREAL_FUSED_SAMPLE=1``) so the logits
+    tensor — and the per-token sort it feeds — never exist.
+
+    Greedy sampling: the fused epilogue is token-exact there, so both
+    arms decode the SAME tokens and ``vs_baseline`` is pure speed. Also
+    reports the max sampled-logprob delta from a teacher-forced
+    one-step probe (the exactness contract, float-associativity noise
+    only). The small ``cfg``/shape overrides exist so tests can smoke
+    the stanza on CPU."""
+    import jax
+
+    from areal_tpu.base import constants as const
+    from areal_tpu.gen.engine import GenerationEngine, GenRequest
+    from areal_tpu.models import transformer as tfm
+
+    cfg = cfg or _gen_model_cfg()
+    rng = np.random.default_rng(0)
+    prompts = [
+        [int(x) for x in rng.integers(1, cfg.vocab_size - 1, PLEN)]
+        for _ in range(B)
+    ]
+    params = tfm.init_params(cfg, jax.random.key(0))
+
+    def run_arm(fused: bool):
+        with _env(const.FUSED_SAMPLE_ENV, "1" if fused else "0"):
+            eng = GenerationEngine(
+                cfg, params, max_slots=B, max_seqlen=2 * PLEN,
+                max_new_tokens_cap=PLEN, page_size=min(128, PLEN // 4),
+                enable_prefix_cache=False,
+                admit_chunk_tokens=min(1024, PLEN),
+            )
+        for i, p in enumerate(prompts):
+            eng.submit(GenRequest(
+                rid=f"{'f' if fused else 'b'}{i}", input_ids=p,
+                max_new_tokens=PLEN, greedy=True,
+            ))
+        eng.step(decode_steps=1)           # admission + first decode
+        eng.step(decode_steps=D_STEPS)     # warm the chunk program
+        n0 = int(np.asarray(jax.device_get(eng.state.n_gen)).sum())
+        t0 = time.perf_counter()
+        for _ in range(N_CHUNKS):
+            eng.step(decode_steps=D_STEPS)
+        n1 = int(np.asarray(jax.device_get(eng.state.n_gen)).sum())  # drain
+        dt = time.perf_counter() - t0
+        eng.pause()
+        _free_engine(eng)
+        return (n1 - n0) / dt
+
+    base = run_arm(False)
+    fused = run_arm(True)
+    return {
+        "tokens_per_s": round(fused, 1),
+        "baseline_tokens_per_s": round(base, 1),
+        "vs_baseline": round(fused / max(base, 1e-9), 4),
+        "slots": B, "prompt_len": PLEN,
+        "max_logprob_delta": _fused_lp_delta(
+            cfg, params, prompts[0][: min(PLEN, 33)]
+        ),
     }
 
 
@@ -1433,6 +1554,8 @@ def main():
         ("fwd_pipe", lambda: _bench_fwd_pipe(peak), True),
         ("gen_pipe", lambda: _bench_gen(peak_bw, peak, pipelined=True), True),
         ("gen_spec", lambda: _bench_gen_spec(peak_bw, peak), True),
+        ("gen_sample_fused",
+         lambda: _bench_gen_sample_fused(peak_bw, peak), True),
         ("gateway", lambda: _bench_gateway(), True),
         ("gen_kvq", lambda: _bench_gen_kvq(peak_bw, peak), True),
         ("bwd_pipe",

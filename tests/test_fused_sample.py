@@ -1,0 +1,591 @@
+"""Fused LM-head + sampling epilogue: exactness, distribution, and engine
+composition (docs/performance.md "Fused sampling epilogue").
+
+The load-bearing contracts:
+- greedy slots are TOKEN-exact and logprob-exact (up to float
+  associativity) vs the materialize-then-sample reference, at the op level
+  across block sizes and through the full engine;
+- temperature / top-k / exclusion sampling is distribution-exact
+  (chi-square on a toy vocab) — same marginal, different RNG stream;
+- the fused spec acceptance (``fused_spec_rejection``) preserves the
+  reference rejection-sampling semantics: greedy spec-over-fused equals
+  vanilla decode token for token;
+- composition: warp-bucket fallback rows (top-p), pause/resume, tp2
+  serving, bounded compiles, adaptive spec-K, telemetry counters;
+- the ``sample_tokens(warp=False)`` gather-then-normalize logprob fast
+  path equals the full ``log_softmax`` formulation exactly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.base import metrics as metrics_mod
+from areal_tpu.gen.engine import GenerationEngine, GenRequest
+from areal_tpu.gen.sampling import (
+    SamplingParams,
+    _plain_temperature,
+    sample_tokens,
+    spec_rejection_sample,
+)
+from areal_tpu.models import transformer as tfm
+from areal_tpu.models.config import ModelConfig
+from areal_tpu.ops import fused_sample as fs
+
+CFG = ModelConfig(
+    n_layers=2, n_q_heads=4, n_kv_heads=2, head_dim=8, hidden_dim=32,
+    intermediate_dim=64, vocab_size=128, dtype="float32",
+)
+
+# chi-square threshold: df = 15 (16-token toy vocab), p ~ 1e-4
+CHI2_CRIT = 45.0
+N_DRAWS = 20000
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.key(5))
+
+
+def _engine(params, spec=False, fused=None, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_seqlen", 128)
+    return GenerationEngine(
+        CFG, params, spec_decode=spec, fused_sample=fused, **kw
+    )
+
+
+def _prompts(rng, sizes=(5, 9, 3)):
+    return [[int(x) for x in rng.integers(1, 128, size=n)] for n in sizes]
+
+
+def _head_problem(R=6, E=32, V=500, seed=0):
+    kx, kw = jax.random.split(jax.random.key(seed))
+    x = jax.random.normal(kx, (R, E), jnp.float32)
+    w = jax.random.normal(kw, (E, V), jnp.float32) * 0.3
+    return x, w, (x @ w).astype(jnp.float32)
+
+
+class TestOpParity:
+    """fused_sample vs sample_tokens over materialized logits."""
+
+    @pytest.mark.parametrize(
+        "block", [100, pytest.param(64, marks=pytest.mark.slow),
+                  pytest.param(500, marks=pytest.mark.slow),
+                  pytest.param(512, marks=pytest.mark.slow),
+                  pytest.param(7, marks=pytest.mark.slow)],
+    )
+    def test_greedy_exact_and_lp_formula(self, block):
+        x, w, logits = _head_problem()
+        temp = jnp.array([0.0, 1.0, 0.7, 0.0, 1.3, 1.0], jnp.float32)
+        greedy = temp <= 0.0
+        R = x.shape[0]
+        sp = SamplingParams(
+            temperature=temp, top_p=jnp.ones((R,), jnp.float32),
+            top_k=jnp.full((R,), 1 << 30, jnp.int32),
+        )
+        key = jax.random.key(3)
+        ref_tok, ref_lp = sample_tokens(key, logits, sp, warp=False)
+        out = fs.fused_sample(
+            key, x, w, temp, greedy, block_size=block, use_pallas=False
+        )
+        g = np.asarray(greedy)
+        # greedy rows: token- and logprob-exact
+        assert np.array_equal(np.asarray(out["tokens"])[g],
+                              np.asarray(ref_tok)[g])
+        np.testing.assert_allclose(
+            np.asarray(out["logprobs"])[g], np.asarray(ref_lp)[g], atol=1e-4
+        )
+        # every row: raw argmax exact; returned lp == log_softmax at the
+        # sampled token w.r.t. the warped distribution
+        assert np.array_equal(
+            np.asarray(out["argmax"]), np.asarray(jnp.argmax(logits, -1))
+        )
+        warped = np.asarray(logits) / np.maximum(
+            np.asarray(temp)[:, None], 1e-6
+        )
+        lse = np.asarray(
+            jax.scipy.special.logsumexp(jnp.asarray(warped), axis=-1)
+        )
+        tok = np.asarray(out["tokens"])
+        np.testing.assert_allclose(
+            np.asarray(out["logprobs"]),
+            warped[np.arange(R), tok] - lse, atol=1e-4,
+        )
+
+    def test_topk_sample_stays_in_topk_set(self):
+        x, w, logits = _head_problem()
+        R = x.shape[0]
+        temp = jnp.ones((R,), jnp.float32)
+        topk = jnp.array([1 << 30, 5, 1 << 30, 1 << 30, 3, 1 << 30],
+                         jnp.int32)
+        for seed in range(8):
+            out = fs.fused_sample(
+                jax.random.key(seed), x, w, temp,
+                jnp.zeros((R,), bool), topk=topk, block_size=64,
+                use_pallas=False,
+            )
+            tok = np.asarray(out["tokens"])
+            for r in (1, 4):
+                k = int(topk[r])
+                top_ids = np.argsort(-np.asarray(logits)[r])[:k]
+                assert tok[r] in top_ids
+
+    def test_gathered_lp_scores_requested_token(self):
+        x, w, logits = _head_problem()
+        R = x.shape[0]
+        temp = jnp.full((R,), 0.9, jnp.float32)
+        gids = jnp.arange(R, dtype=jnp.int32) * 3
+        out = fs.fused_sample(
+            jax.random.key(1), x, w, temp, jnp.zeros((R,), bool),
+            gather_ids=gids, block_size=33, use_pallas=False,
+        )
+        warped = np.asarray(logits) / 0.9
+        lse = np.asarray(
+            jax.scipy.special.logsumexp(jnp.asarray(warped), axis=-1)
+        )
+        np.testing.assert_allclose(
+            np.asarray(out["gathered_lp"]),
+            warped[np.arange(R), np.arange(R) * 3] - lse, atol=1e-4,
+        )
+
+    def test_pallas_interpret_matches_xla(self):
+        """The kernel (CPU interpret mode) agrees with the streamed XLA
+        path on everything deterministic: greedy tokens, argmax, and the
+        logprob formula for whatever token its own stream sampled."""
+        x, w, logits = _head_problem()
+        temp = jnp.array([0.0, 1.0, 0.7, 0.0, 1.3, 1.0], jnp.float32)
+        greedy = temp <= 0.0
+        R = x.shape[0]
+        out = fs.fused_sample(
+            jax.random.key(3), x, w, temp, greedy, block_size=128,
+            use_pallas=True,
+        )
+        g = np.asarray(greedy)
+        ref = np.asarray(jnp.argmax(logits, -1))
+        assert np.array_equal(np.asarray(out["tokens"])[g], ref[g])
+        assert np.array_equal(np.asarray(out["argmax"]), ref)
+        warped = np.asarray(logits) / np.maximum(
+            np.asarray(temp)[:, None], 1e-6
+        )
+        lse = np.asarray(
+            jax.scipy.special.logsumexp(jnp.asarray(warped), axis=-1)
+        )
+        tok = np.asarray(out["tokens"])
+        np.testing.assert_allclose(
+            np.asarray(out["logprobs"]),
+            warped[np.arange(R), tok] - lse, atol=1e-3,
+        )
+
+    def test_explicit_pallas_with_topk_or_mesh_raises(self):
+        x, w, _ = _head_problem()
+        R = x.shape[0]
+        temp = jnp.ones((R,), jnp.float32)
+        with pytest.raises(ValueError, match="top-k"):
+            fs.fused_sample(
+                jax.random.key(0), x, w, temp, jnp.zeros((R,), bool),
+                topk=jnp.full((R,), 4, jnp.int32), use_pallas=True,
+            )
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("model",))
+        with pytest.raises(ValueError, match="mesh"):
+            fs.fused_sample(
+                jax.random.key(0), x, w, temp, jnp.zeros((R,), bool),
+                use_pallas=True, mesh=mesh,
+            )
+
+
+class TestDistribution:
+    """Chi-square: the fused sampler's first-token marginal equals the
+    softmax of the (warped / restricted) head output."""
+
+    def _marginal(self, sample_fn, n=N_DRAWS, vocab=16):
+        keys = jax.random.split(jax.random.key(7), n)
+        toks = np.asarray(jax.vmap(sample_fn)(keys))
+        return np.bincount(toks, minlength=vocab)
+
+    def _chi2(self, counts, p):
+        n = counts.sum()
+        mask = p > 0
+        return float(
+            (((counts[mask] - n * p[mask]) ** 2) / (n * p[mask])).sum()
+        )
+
+    def test_temperature_marginal(self):
+        x, w, logits = _head_problem(R=1, E=8, V=16, seed=1)
+        p = np.asarray(jax.nn.softmax(logits[0]))
+        f = jax.jit(lambda k: fs.fused_sample(
+            k, x, w, jnp.ones((1,)), jnp.zeros((1,), bool),
+            block_size=7, use_pallas=False,
+        )["tokens"][0])
+        assert self._chi2(self._marginal(f), p) < CHI2_CRIT
+
+    def test_topk_marginal(self):
+        x, w, logits = _head_problem(R=1, E=8, V=16, seed=1)
+        k = 5
+        lg = np.asarray(logits[0])
+        keep = np.argsort(-lg)[:k]
+        p = np.zeros_like(lg)
+        p[keep] = np.exp(lg[keep] - lg[keep].max())
+        p /= p.sum()
+        f = jax.jit(lambda key: fs.fused_sample(
+            key, x, w, jnp.ones((1,)), jnp.zeros((1,), bool),
+            topk=jnp.full((1,), k, jnp.int32), block_size=7,
+            use_pallas=False,
+        )["tokens"][0])
+        counts = self._marginal(f)
+        assert counts[np.setdiff1d(np.arange(16), keep)].sum() == 0
+        assert self._chi2(counts, p) < CHI2_CRIT
+
+    @pytest.mark.slow
+    def test_excluded_token_marginal(self):
+        """The spec-residual distribution: p with one token removed,
+        renormalized — the excluded token must never appear."""
+        x, w, logits = _head_problem(R=1, E=8, V=16, seed=1)
+        p = np.asarray(jax.nn.softmax(logits[0]))
+        ex = int(np.argmax(p))
+        f = jax.jit(lambda k: fs.fused_sample(
+            k, x, w, jnp.ones((1,)), jnp.zeros((1,), bool),
+            exclude=jnp.array([ex]), block_size=16, use_pallas=False,
+        )["tokens"][0])
+        counts = self._marginal(f)
+        assert counts[ex] == 0
+        p2 = p.copy()
+        p2[ex] = 0.0
+        p2 /= p2.sum()
+        assert self._chi2(counts.astype(float), p2) < CHI2_CRIT
+
+    @pytest.mark.slow
+    def test_pallas_temperature_marginal(self):
+        x, w, logits = _head_problem(R=1, E=8, V=16, seed=1)
+        p = np.asarray(jax.nn.softmax(logits[0]))
+        f = jax.jit(lambda k: fs.fused_sample(
+            k, x, w, jnp.ones((1,)), jnp.zeros((1,), bool),
+            block_size=128, use_pallas=True,
+        )["tokens"][0])
+        assert self._chi2(self._marginal(f), p) < CHI2_CRIT
+
+
+class TestLogprobFastPath:
+    def test_warp_false_lp_equals_log_softmax(self):
+        """The gather-then-normalize fast path in sample_tokens(warp=False)
+        is EXACT vs the full log_softmax formulation (same reduction, so
+        bitwise-comparable at f32 tolerance ~0)."""
+        _, _, logits = _head_problem()
+        R = logits.shape[0]
+        temp = jnp.array([0.0, 1.0, 0.7, 0.0, 1.3, 1.0], jnp.float32)
+        sp = SamplingParams(
+            temperature=temp, top_p=jnp.ones((R,), jnp.float32),
+            top_k=jnp.full((R,), 1 << 30, jnp.int32),
+        )
+        tok, lp = sample_tokens(jax.random.key(11), logits, sp, warp=False)
+        warped = _plain_temperature(logits, sp)
+        full = jnp.take_along_axis(
+            jax.nn.log_softmax(warped, axis=-1), tok[:, None], axis=-1
+        )[:, 0]
+        np.testing.assert_allclose(
+            np.asarray(lp), np.asarray(full), atol=1e-5
+        )
+
+
+class TestEngineFused:
+    def test_greedy_fused_matches_reference(self, params, rng):
+        """The tentpole contract: AREAL_FUSED_SAMPLE greedy decode is
+        token- and logprob-exact vs the materialized reference through
+        the full engine."""
+        prompts = _prompts(rng)
+        outs = []
+        for fused in (False, True):
+            eng = _engine(params, fused=fused, max_slots=4)
+            for i, p in enumerate(prompts):
+                eng.submit(GenRequest(
+                    rid=f"r{i}", input_ids=p, max_new_tokens=10 + i,
+                    greedy=True,
+                ))
+            outs.append({
+                o.rid: o for o in eng.run_until_done(decode_steps=3)
+            })
+        assert set(outs[0]) == set(outs[1])
+        for rid in outs[0]:
+            assert outs[0][rid].output_ids == outs[1][rid].output_ids, rid
+            assert outs[0][rid].finish_reason == outs[1][rid].finish_reason
+            np.testing.assert_allclose(
+                outs[0][rid].output_logprobs, outs[1][rid].output_logprobs,
+                atol=1e-4,
+            )
+
+    def test_env_knob_enables_fused(self, params, monkeypatch):
+        monkeypatch.setenv("AREAL_FUSED_SAMPLE", "1")
+        eng = _engine(params, max_slots=1)
+        assert eng.fused is True
+        eng.submit(GenRequest(
+            rid="a", input_ids=[1, 2, 3], max_new_tokens=4, greedy=True,
+        ))
+        outs = eng.run_until_done(decode_steps=2)
+        assert len(outs[0].output_ids) == 4
+
+    def test_mixed_batch_fallback_rows_reproducible(self, params):
+        """A top-p slot routes through the sorted fallback while greedy /
+        top-k / plain-temperature slots stay fused — seeded runs are
+        reproducible and the greedy slot stays exact."""
+
+        def run(fused):
+            eng = _engine(params, fused=fused, max_slots=4, seed=3)
+            eng.submit(GenRequest(
+                rid="g", input_ids=[5, 6, 7], max_new_tokens=8,
+                greedy=True,
+            ))
+            eng.submit(GenRequest(
+                rid="p", input_ids=[5, 6, 7], max_new_tokens=8,
+                temperature=1.0, top_p=0.9,
+            ))
+            eng.submit(GenRequest(
+                rid="k", input_ids=[5, 6, 7], max_new_tokens=8,
+                temperature=1.0, top_k=8,
+            ))
+            eng.submit(GenRequest(
+                rid="t", input_ids=[5, 6, 7], max_new_tokens=8,
+                temperature=0.8,
+            ))
+            return {o.rid: o for o in eng.run_until_done(decode_steps=2)}
+
+        m1, m2 = run(True), run(True)
+        assert {r: o.output_ids for r, o in m1.items()} == \
+               {r: o.output_ids for r, o in m2.items()}
+        ref = run(False)
+        assert m1["g"].output_ids == ref["g"].output_ids
+        for o in m1.values():
+            assert len(o.output_ids) == 8
+            assert all(np.isfinite(o.output_logprobs))
+
+    def test_spec_over_fused_greedy_matches_vanilla(self, params, rng):
+        """Fused spec acceptance (streamed verify head) == vanilla greedy
+        decode == reference spec decode, token for token."""
+        prompts = _prompts(rng)
+
+        def run(spec, fused):
+            eng = _engine(params, spec=spec, fused=fused, max_slots=4,
+                          spec_k=3)
+            for i, p in enumerate(prompts):
+                eng.submit(GenRequest(
+                    rid=f"r{i}", input_ids=p, max_new_tokens=10 + i,
+                    greedy=True,
+                ))
+            return {o.rid: o for o in eng.run_until_done(decode_steps=3)}
+
+        ref = run(False, False)
+        sf = run(True, True)
+        assert set(ref) == set(sf)
+        for rid in ref:
+            assert ref[rid].output_ids == sf[rid].output_ids, rid
+            np.testing.assert_allclose(
+                ref[rid].output_logprobs, sf[rid].output_logprobs,
+                atol=1e-4,
+            )
+
+    def test_pause_resume_prefix_parity_fused(self, params, rng):
+        """Interruption composes: a fused engine paused mid-generation
+        yields a prefix of the uninterrupted chain and resubmission
+        completes it exactly (the partial-rollout protocol)."""
+        prompt = [int(x) for x in rng.integers(1, 128, size=5)]
+        ref_eng = _engine(params, fused=True)
+        ref_eng.submit(GenRequest(
+            rid="ref", input_ids=prompt, max_new_tokens=12, greedy=True,
+        ))
+        ref = ref_eng.run_until_done(decode_steps=4)[0].output_ids
+        eng = _engine(params, fused=True)
+        eng.submit(GenRequest(
+            rid="a", input_ids=prompt, max_new_tokens=12, greedy=True,
+        ))
+        eng.step(decode_steps=1)
+        parts = eng.pause()
+        assert len(parts) == 1 and parts[0].finish_reason == "interrupted"
+        got = parts[0].output_ids
+        assert 0 < len(got) < 12
+        assert got == ref[: len(got)]
+        eng.resume()
+        eng.submit(GenRequest(
+            rid="a2", input_ids=prompt + got,
+            max_new_tokens=12 - len(got), greedy=True,
+        ))
+        outs = eng.run_until_done(decode_steps=4)
+        assert got + outs[0].output_ids == ref
+
+    def test_tp2_fused_greedy_matches_single_device(self, params, rng):
+        """Fused sampling on a 2-way `model` mesh (streamed XLA epilogue
+        under GSPMD, hidden states replicated before sampling) matches
+        the unsharded fused engine token for token."""
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("model",))
+        prompts = _prompts(rng)
+        eng1 = _engine(params, fused=True, max_slots=4)
+        eng2 = GenerationEngine(
+            CFG, params, max_slots=4, max_seqlen=128,
+            fused_sample=True, mesh=mesh,
+        )
+        for eng in (eng1, eng2):
+            for i, p in enumerate(prompts):
+                eng.submit(GenRequest(
+                    rid=f"r{i}", input_ids=p, max_new_tokens=8,
+                    greedy=True,
+                ))
+        o1 = {o.rid: o for o in eng1.run_until_done(decode_steps=2)}
+        o2 = {o.rid: o for o in eng2.run_until_done(decode_steps=2)}
+        assert set(o1) == set(o2)
+        for rid in o1:
+            assert o1[rid].output_ids == o2[rid].output_ids, rid
+
+    def test_fused_bounded_compiles_and_counters(self, params, rng):
+        """Fused traffic obeys the n_compiles discipline (mixed fused
+        chunks + fallback buckets add a bounded set of programs, never
+        per-prompt) and ticks the fused/fallback counters."""
+        metrics_mod.counters.clear(metrics_mod.GEN_FUSED_SAMPLE_STEPS)
+        metrics_mod.counters.clear(metrics_mod.GEN_SAMPLER_FALLBACK_ROWS)
+        eng = _engine(params, fused=True, max_slots=4, max_seqlen=256,
+                      page_size=16)
+
+        def burst(tag, plens, **req_kw):
+            for i, plen in enumerate(plens):
+                eng.submit(GenRequest(
+                    rid=f"{tag}{i}",
+                    input_ids=[int(x) for x in rng.integers(1, 128, plen)],
+                    max_new_tokens=6, **req_kw,
+                ))
+            eng.run_until_done(decode_steps=3)
+
+        burst("g", [3, 9, 17, 33], greedy=True)         # warm greedy
+        burst("p", [3, 9], temperature=1.0, top_p=0.9)  # warm fallback
+        burst("k", [5, 21], temperature=1.0, top_k=8)   # warm online top-k
+        warmed = eng.n_compiles()
+        burst("g2", [11, 29, 60], greedy=True)
+        burst("p2", [7, 45], temperature=1.0, top_p=0.9)
+        burst("k2", [13, 80], temperature=1.0, top_k=8)
+        assert eng.n_compiles() == warmed
+        assert metrics_mod.counters.get(
+            metrics_mod.GEN_FUSED_SAMPLE_STEPS
+        ) > 0
+        assert metrics_mod.counters.get(
+            metrics_mod.GEN_SAMPLER_FALLBACK_ROWS
+        ) > 0
+
+
+class TestAdaptiveSpecK:
+    def test_retunes_up_under_predictable_traffic(self, params):
+        """A repetitive prompt (n-gram drafter accepts ~everything) must
+        drive K up through the choice set, update the gauge, and keep
+        compiles bounded by the visited-K set."""
+        eng = GenerationEngine(
+            CFG, params, max_slots=2, max_seqlen=512, spec_decode=True,
+            spec_k=1, spec_k_adapt=True,
+        )
+        assert eng.spec_k_adapt is True
+        pat = [7, 8, 9, 10] * 10
+        for rid in ("a", "b"):
+            eng.submit(GenRequest(
+                rid=rid, input_ids=pat, max_new_tokens=250, greedy=True,
+            ))
+        eng.run_until_done(decode_steps=4)
+        assert eng.spec_k > 1
+        assert eng.spec_k in eng._spec_k_choices
+        snap = metrics_mod.counters.snapshot()
+        assert snap.get(metrics_mod.GEN_SPEC_K_CURRENT) == float(eng.spec_k)
+        # one spec-chunk program per (chunk key, visited K): bounded
+        assert len(eng._jit_spec) <= 4 * len(eng._spec_k_choices)
+
+    def test_static_without_knob(self, params):
+        eng = _engine(params, spec=True, spec_k=3)
+        assert eng.spec_k_adapt is False
+        eng.submit(GenRequest(
+            rid="a", input_ids=[7, 8, 9] * 6, max_new_tokens=30,
+            greedy=True,
+        ))
+        eng.run_until_done(decode_steps=3)
+        assert eng.spec_k == 3
+
+    def test_env_knob_and_gauge_init(self, params, monkeypatch):
+        monkeypatch.setenv("AREAL_SPEC_K_ADAPT", "1")
+        eng = _engine(params, spec=True, spec_k=2)
+        assert eng.spec_k_adapt is True
+        snap = metrics_mod.counters.snapshot()
+        assert snap.get(metrics_mod.GEN_SPEC_K_CURRENT) == 2.0
+
+
+def _run_fused_stanza(B=8):
+    """Shared ``gen_sample_fused`` bench run for the tier-1 smoke and the
+    slow throughput-ordering pin: a tiny 2-layer model with a LARGE-ish
+    vocab (8192) so the ``[B, V]`` materialization the fused path removes
+    is actually visible on the CPU harness."""
+    import dataclasses
+
+    import bench as bench_mod
+
+    cfg = dataclasses.replace(CFG, vocab_size=8192)
+    return bench_mod._bench_gen_sample_fused(
+        819e9, 197e12, cfg=cfg, B=B, PLEN=64, D_STEPS=8, N_CHUNKS=3,
+    )
+
+
+def test_bench_gen_sample_fused_stanza_end_to_end():
+    """The ``gen_sample_fused`` A/B bench runs end-to-end on the CPU
+    harness: both arms decode, the sampled-logprob probe is exact (greedy
+    fused logprobs are token-exact, so the delta is float-associativity
+    noise), and throughput is floored against pathology only — the strict
+    >= 1.0 ordering is pinned by the slow variant below (CPU wall clock
+    on a loaded CI box must not flake tier-1); absolute ratios are judged
+    on chip (HBM-roofline economics)."""
+    out = _run_fused_stanza()
+    assert set(out) >= {
+        "tokens_per_s", "baseline_tokens_per_s", "vs_baseline",
+        "max_logprob_delta",
+    }
+    assert out["tokens_per_s"] > 0
+    assert out["baseline_tokens_per_s"] > 0
+    # pathology floor only: the 8-slot timed window is a few hundred ms,
+    # so scheduler noise on a busy CI box swings the ratio well below the
+    # real ~1.25x (measured cold); the ordering bar lives in the slow pin
+    assert out["vs_baseline"] > 0.5
+    assert out["max_logprob_delta"] < 1e-4
+
+
+@pytest.mark.slow
+def test_bench_gen_sample_fused_beats_baseline():
+    """The strict CPU-smoke speed ordering (the ISSUE 16 acceptance bar):
+    at the 64-slot smoke shape the fused epilogue beats the materialized
+    baseline (measured 1.59x on the CPU harness). Wall-clock comparison —
+    slow-marked so a loaded tier-1 CI box can't flake it."""
+    out = _run_fused_stanza(B=64)
+    assert out["vs_baseline"] >= 1.0
+    assert out["max_logprob_delta"] < 1e-4
+
+
+class TestGaugeKind:
+    def test_gauge_last_value_wins_and_delta_reports_as_is(self):
+        name = "test/fused_gauge"
+        metrics_mod.counters.clear(name)
+        base = metrics_mod.counters.snapshot()
+        metrics_mod.counters.gauge(name, 4.0)
+        metrics_mod.counters.gauge(name, 2.0)
+        assert metrics_mod.counters.get(name) == 2.0
+        assert metrics_mod.counters.kind(name) == metrics_mod.KIND_GAUGE
+        d = metrics_mod.counters.delta(base)
+        assert d[name] == 2.0
+        metrics_mod.counters.clear(name)
+
+    def test_telemetry_merges_gauges_with_max(self):
+        from areal_tpu.system.telemetry import FleetAggregate
+
+        agg = FleetAggregate()
+        for i, v in enumerate((2.0, 4.0, 1.0)):
+            agg.merge_snapshot({
+                "worker": f"w{i}",
+                "counters": {metrics_mod.GEN_SPEC_K_CURRENT: v},
+            })
+        # gauges merge via fleet max (the conservative view when workers
+        # retune at different times), not via sum
+        assert agg.counters[metrics_mod.GEN_SPEC_K_CURRENT] == 4.0
+        assert agg.kinds[metrics_mod.GEN_SPEC_K_CURRENT] == \
+            metrics_mod.KIND_GAUGE
