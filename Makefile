@@ -6,7 +6,7 @@ PYTHON ?= python
 # Diff base for lint-fast: any git ref (branch, SHA, HEAD~1, ...).
 SINCE ?= HEAD
 
-.PHONY: lint lint-fast lint-rules serve chaos bench-spec bench-fused
+.PHONY: lint lint-fast lint-rules serve chaos chaos-serve bench-spec bench-fused
 
 # Speculative-decoding bench only (docs/performance.md "Speculative
 # decoding"): the three-arm vanilla / n-gram / draft-model A/B at the
@@ -30,6 +30,15 @@ bench-fused:
 # tests/test_elastic_multihost.py`.
 chaos:
 	$(PYTHON) -m tools.chaos --seed 1 --faults 1 --steps 8 --ckpt-every 3
+
+# Serving-plane survivability soak (docs/serving.md "Survivability"):
+# two tiny identical-weight gen servers behind the real gateway, driven
+# through backend death mid-stream (token-exact resume), a pre-first-chunk
+# wedge (hedge wins), a deadline storm (in-queue shed, full refund), and
+# a brownout ladder walk — then asserts nothing leaked and arealint is
+# still clean.
+chaos-serve:
+	$(PYTHON) -m tools.chaos --serve
 
 # Local serving stack (docs/serving.md): one generation engine + gen
 # server + the OpenAI-compatible gateway in a single process. Pass a

@@ -348,6 +348,7 @@ def gateway_main(cfg):
             name: TenantSpec(
                 name=name, weight=w,
                 rate_tokens_per_s=rate, burst_tokens=burst,
+                default_deadline_s=gspec.default_deadline_s,
             )
             for name, w in gspec.tenant_weights.items()
         }
@@ -358,11 +359,13 @@ def gateway_main(cfg):
                 name=gspec.default_tenant,
                 rate_tokens_per_s=rate,
                 burst_tokens=burst,
+                default_deadline_s=gspec.default_deadline_s,
             ),
             max_queue=gspec.max_queue if gspec.max_queue >= 0 else None,
             admit_occupancy=(
                 gspec.admit_occupancy if gspec.admit_occupancy >= 0 else None
             ),
+            hedge_enabled=gspec.hedge,
         )
         await scheduler.start()
         tok_path = cfg.tokenizer_path or cfg.actor.path
@@ -474,6 +477,26 @@ def gateway_main(cfg):
                 autoscaler.run()
             )
 
+        brownout_task = None
+        if gspec.brownout:
+            from areal_tpu.gateway.brownout import (
+                BrownoutConfig,
+                wire_brownout,
+            )
+
+            controller = wire_brownout(
+                BrownoutConfig(
+                    interval_s=gspec.brownout_interval_s,
+                    min_hold_s=gspec.brownout_min_hold_s,
+                    clamp_max_tokens=gspec.brownout_clamp_max_tokens,
+                    weight_floor=gspec.brownout_weight_floor,
+                ),
+                scheduler, gw.config, scheduler._client,
+            )
+            brownout_task = asyncio.get_event_loop().create_task(
+                controller.run()
+            )
+
         watch = ExperimentStatusWatch(cfg.experiment_name, cfg.trial_name)
         hb = Heartbeat(cfg.experiment_name, cfg.trial_name, "gateway").start()
         tele = TelemetryExporter(
@@ -490,6 +513,8 @@ def gateway_main(cfg):
         hb.stop()
         if autoscaler_task is not None:
             autoscaler_task.cancel()
+        if brownout_task is not None:
+            brownout_task.cancel()
         await scheduler.stop()
         await runner.cleanup()
 

@@ -55,6 +55,8 @@ GATEWAY_RATE_TPS_ENV = "AREAL_GW_RATE_TPS"       # per-tenant token bucket
 GATEWAY_BURST_ENV = "AREAL_GW_BURST"             # token-bucket burst size
 GATEWAY_MAX_QUEUE_ENV = "AREAL_GW_MAX_QUEUE"     # gateway queue cap
 GATEWAY_ADMIT_OCC_ENV = "AREAL_GW_ADMIT_OCCUPANCY"  # KV-pool admit gate
+GATEWAY_HEDGE_ENV = "AREAL_GW_HEDGE"             # hedged dispatch on/off
+GATEWAY_DEADLINE_S_ENV = "AREAL_GW_DEADLINE_S"   # default request deadline
 
 
 # --------------------------------------------------------------------- #
@@ -334,6 +336,22 @@ def gateway_admit_occupancy() -> float:
     return env_float(GATEWAY_ADMIT_OCC_ENV, 0.95)
 
 
+def gateway_hedge() -> bool:
+    """``AREAL_GW_HEDGE`` (default on): hedge a still-unstarted request to
+    a second healthy backend once its time-to-first-token exceeds the live
+    ``gw/ttft_s`` p95 (docs/serving.md "Survivability"). The loser is
+    cancelled; hedge volume is capped per tenant."""
+    return env_flag(GATEWAY_HEDGE_ENV, True)
+
+
+def gateway_deadline_s() -> float:
+    """``AREAL_GW_DEADLINE_S`` (default 0 = none): default per-request
+    deadline in seconds for tenants without an explicit
+    ``default_deadline_s`` in their spec. Clients override per request via
+    the ``timeout`` body field or ``X-Request-Deadline`` header."""
+    return env_float(GATEWAY_DEADLINE_S_ENV, 0.0)
+
+
 def native_disabled() -> bool:
     """``AREAL_DISABLE_NATIVE``: skip building/loading the C packer
     extension (pure-python fallback)."""
@@ -560,6 +578,8 @@ def get_env_vars(**extra) -> dict:
         GATEWAY_BURST_ENV,
         GATEWAY_MAX_QUEUE_ENV,
         GATEWAY_ADMIT_OCC_ENV,
+        GATEWAY_HEDGE_ENV,
+        GATEWAY_DEADLINE_S_ENV,
         "JAX_PLATFORMS",
         "XLA_FLAGS",
         "TPU_VISIBLE_DEVICES",

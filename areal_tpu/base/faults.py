@@ -49,6 +49,17 @@ point                 where                                      kwargs
                       wedges forever without exiting)
 ``collective.timeout`` elastic CollectiveGuard (trip: treat the  label
                       in-flight collective as timed out now)
+``gw.backend_die_midstream`` gen server /generate_stream frame    rid
+                      write (fail: the backend drops the stream
+                      mid-generation -- server death as the
+                      gateway sees it)
+``gw.backend_wedge``  gen server /generate_stream frame write    rid
+                      (delay: the backend stalls before its
+                      first chunk -- the straggler the hedge
+                      path exists for)
+``gw.deadline_storm`` gateway scheduler _pick_server (trip:      (none)
+                      report zero dispatch capacity so queued
+                      requests age out against their deadlines)
 ====================  ========================================  ==========
 """
 
@@ -78,6 +89,9 @@ FAULT_POINTS = (
     "rank.kill",
     "rank.hang",
     "collective.timeout",
+    "gw.backend_die_midstream",
+    "gw.backend_wedge",
+    "gw.deadline_storm",
 )
 
 

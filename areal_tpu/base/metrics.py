@@ -478,6 +478,15 @@ GW_SCALE_UPS = "gw/scale_ups"             # autoscaler grew the routed set
 GW_SCALE_DOWNS = "gw/scale_downs"         # autoscaler shrank the routed set
 GW_TENANT_TOKENS_PREFIX = "gw/tenant_tokens/"  # + <tenant>: per-tenant sums
 
+# Survivability plane (docs/serving.md "Survivability"): deadline
+# propagation, hedged dispatch and the brownout ladder.
+GW_DEADLINE_SHED = "gw/deadline_shed"     # expired in queue / mid-stream
+GW_HEDGES = "gw/hedges"                   # hedge streams opened
+GW_HEDGE_WINS = "gw/hedge_wins"           # hedge beat the primary's 1st chunk
+GW_STREAM_RESUMES = "gw/stream_resumes"   # streams resumed after backend death
+GW_BROWNOUT_LEVEL = "gw/brownout_level"   # gauge: current degradation level
+GW_BROWNOUT_TRANSITIONS = "gw/brownout_transitions"  # ladder level changes
+
 # Fraction edges for the pool-occupancy histogram: occupancy lives in
 # [0, 1] and the log-spaced duration edges would put the whole range into
 # two buckets; 0.9+ gets finer edges because that is where admission
@@ -521,6 +530,7 @@ METRIC_KINDS: Dict[str, str] = {
     RECOVERY_TIME_S: KIND_HISTOGRAM,
     GW_QUEUE_WAIT_S: KIND_HISTOGRAM,
     GW_TTFT_S: KIND_HISTOGRAM,
+    GW_BROWNOUT_LEVEL: KIND_GAUGE,
 }
 
 # Non-default bucket edges per histogram key (default: the log-spaced

@@ -128,6 +128,18 @@ class GatewaySpec:
     min_servers: int = 1
     autoscale_interval_s: float = 10.0
     autoscale_cooldown_s: float = 30.0
+    # survivability plane (docs/serving.md "Survivability"):
+    # per-request deadline default for tenants without their own (0 = none)
+    default_deadline_s: float = 0.0
+    # hedged dispatch; None defers to the AREAL_GW_HEDGE env knob
+    hedge: Optional[bool] = None
+    # brownout ladder (gateway/brownout.py): graceful degradation under
+    # sustained saturation instead of uniform timeouts
+    brownout: bool = False
+    brownout_interval_s: float = 5.0
+    brownout_min_hold_s: float = 30.0
+    brownout_clamp_max_tokens: int = 256
+    brownout_weight_floor: float = 1.0
 
 
 @dataclasses.dataclass

@@ -69,6 +69,7 @@ async def _amain(args) -> int:
         default_tenant=TenantSpec(
             name="anonymous",
             rate_tokens_per_s=args.rate_tps,
+            default_deadline_s=args.deadline_s,
         ),
     )
     await scheduler.start()
@@ -83,6 +84,16 @@ async def _amain(args) -> int:
     )
     port = args.port or constants.gateway_port() or network.find_free_port()
     gw_runner = await serve_gateway(gw, "0.0.0.0", port)
+    brownout_task = None
+    if args.brownout:
+        from areal_tpu.gateway.brownout import BrownoutConfig, wire_brownout
+
+        controller = wire_brownout(
+            BrownoutConfig(), scheduler, gw.config, scheduler._client
+        )
+        brownout_task = asyncio.get_event_loop().create_task(
+            controller.run()
+        )
     print(f"gateway listening on http://127.0.0.1:{port}/v1 "
           f"(backend {gen_url})", flush=True)
     try:
@@ -91,6 +102,8 @@ async def _amain(args) -> int:
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
+        if brownout_task is not None:
+            brownout_task.cancel()
         await scheduler.stop()
         await gw_runner.cleanup()
         await gen_runner.cleanup()
@@ -111,6 +124,10 @@ def main(argv=None) -> int:
     p.add_argument("--max-seqlen", type=int, default=2048)
     p.add_argument("--rate-tps", type=float, default=0.0,
                    help="per-tenant token-bucket rate (0 = unlimited)")
+    p.add_argument("--deadline-s", type=float, default=0.0,
+                   help="default per-request deadline in seconds (0 = none)")
+    p.add_argument("--brownout", action="store_true",
+                   help="enable the brownout degradation ladder")
     args = p.parse_args(argv)
     try:
         return asyncio.run(_amain(args))

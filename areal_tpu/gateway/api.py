@@ -37,6 +37,7 @@ from areal_tpu.gateway.scheduler import (
     ContinuousBatchScheduler,
     GatewayRequest,
     RateLimited,
+    ServiceUnavailable,
 )
 
 logger = logging.getLogger("areal_tpu.gateway.api")
@@ -104,6 +105,9 @@ class GatewayConfig:
     require_api_key: bool = False
     max_tokens_cap: int = 2048
     default_max_tokens: int = 256
+    # brownout level >= 1 (gateway/brownout.py): a live clamp applied on
+    # top of the validated request — None when the ladder is at level 0
+    brownout_max_tokens: Optional[int] = None
 
 
 class BadRequest(Exception):
@@ -114,10 +118,10 @@ class BadRequest(Exception):
 
 
 def _error_response(message: str, status: int, code: str, **headers):
-    metrics_mod.counters.add(
-        metrics_mod.GW_REJECTED_4XX if status != 429 else
-        metrics_mod.GW_REJECTED_429
-    )
+    if status == 429:
+        metrics_mod.counters.add(metrics_mod.GW_REJECTED_429)
+    elif status < 500:
+        metrics_mod.counters.add(metrics_mod.GW_REJECTED_4XX)
     return web.json_response(
         {
             "error": {
@@ -153,6 +157,10 @@ def parse_sampling(d: dict, cfg: GatewayConfig) -> Tuple[Dict, bool]:
     _require(temperature >= 0.0, "temperature must be >= 0")
     _require(0.0 < top_p <= 1.0, "top_p must be in (0, 1]")
     _require(n == 1, "n > 1 is not supported")
+    if cfg.brownout_max_tokens is not None:
+        # degraded-but-correct: shorter answers for everyone beats 429s
+        # for some (the clamp is removed when the ladder steps back down)
+        max_tokens = min(max_tokens, max(int(cfg.brownout_max_tokens), 1))
     sp = {
         "max_new_tokens": max_tokens,
         "temperature": temperature,
@@ -160,6 +168,26 @@ def parse_sampling(d: dict, cfg: GatewayConfig) -> Tuple[Dict, bool]:
         "greedy": temperature == 0.0,
     }
     return sp, stream
+
+
+def parse_deadline(d: dict, request: web.Request) -> float:
+    """Per-request deadline intake: the body's ``timeout`` field (seconds,
+    OpenAI-client idiom) wins over an ``X-Request-Deadline`` header
+    (relative seconds); 0 = none (the tenant/fleet default applies)."""
+    raw = d.get("timeout")
+    if raw is None:
+        raw = request.headers.get("X-Request-Deadline")
+    if raw is None:
+        return 0.0
+    try:
+        deadline = float(raw)
+    except (TypeError, ValueError):
+        raise BadRequest("'timeout' must be a number of seconds")
+    _require(
+        deadline > 0 and deadline == deadline and deadline != float("inf"),
+        "'timeout' must be a positive finite number of seconds",
+    )
+    return deadline
 
 
 def encode_stop(stop, codec: TokenCodec) -> List[int]:
@@ -297,7 +325,10 @@ class GatewayServer:
             if stops:
                 sp["stop_token_ids"] = stops
             self._check_capacity(input_ids, sp)
-            req = GatewayRequest.build(tenant, input_ids, sp)
+            req = GatewayRequest.build(
+                tenant, input_ids, sp,
+                deadline_s=parse_deadline(d, request),
+            )
             self.scheduler.submit(req)
         except BadRequest as e:
             return _error_response(str(e), e.status, e.code)
@@ -306,6 +337,11 @@ class GatewayServer:
                 return _error_response(str(e), 400, "invalid_request_error")
             return _error_response(
                 str(e), 429, "rate_limit_exceeded",
+                Retry_After=max(1, int(e.retry_after_s + 0.999)),
+            )
+        except ServiceUnavailable as e:
+            return _error_response(
+                str(e), 503, "service_unavailable",
                 Retry_After=max(1, int(e.retry_after_s + 0.999)),
             )
         if stream:
@@ -340,7 +376,10 @@ class GatewayServer:
             if stops:
                 sp["stop_token_ids"] = stops
             self._check_capacity(input_ids, sp)
-            req = GatewayRequest.build(tenant, input_ids, sp)
+            req = GatewayRequest.build(
+                tenant, input_ids, sp,
+                deadline_s=parse_deadline(d, request),
+            )
             self.scheduler.submit(req)
         except BadRequest as e:
             return _error_response(str(e), e.status, e.code)
@@ -349,6 +388,11 @@ class GatewayServer:
                 return _error_response(str(e), 400, "invalid_request_error")
             return _error_response(
                 str(e), 429, "rate_limit_exceeded",
+                Retry_After=max(1, int(e.retry_after_s + 0.999)),
+            )
+        except ServiceUnavailable as e:
+            return _error_response(
+                str(e), 503, "service_unavailable",
                 Retry_After=max(1, int(e.retry_after_s + 0.999)),
             )
         if stream:
@@ -375,7 +419,11 @@ class GatewayServer:
 
     @staticmethod
     def _finish(reason: Optional[str]) -> str:
-        return "length" if reason == "length" else "stop"
+        # "deadline" passes through so streaming clients can tell a
+        # budget-truncated answer from a natural stop
+        if reason in ("length", "deadline"):
+            return reason
+        return "stop"
 
     async def _next_event(self, request: web.Request, req: GatewayRequest):
         """Next scheduler event, polling the transport while waiting: a
@@ -453,6 +501,10 @@ class GatewayServer:
             while reason is None:
                 ev = await self._next_event(request, req)
                 if "error" in ev:
+                    if ev.get("finish_reason") == "deadline":
+                        return _error_response(
+                            ev["error"], 504, "deadline_exceeded"
+                        )
                     return web.json_response(
                         {"error": {"message": ev["error"],
                                    "type": "server_error"}},
@@ -464,6 +516,11 @@ class GatewayServer:
         except (ConnectionResetError, asyncio.CancelledError):
             self.scheduler.cancel(req)
             raise
+        if reason == "deadline" and not tokens:
+            # expired before the first token: nothing useful to return
+            return _error_response(
+                "request deadline exceeded", 504, "deadline_exceeded"
+            )
         text = self.codec.decode(tokens)
         if chat:
             choice = {

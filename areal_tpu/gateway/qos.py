@@ -34,6 +34,10 @@ class TenantSpec:
     weight: float = 1.0
     rate_tokens_per_s: float = 0.0
     burst_tokens: float = 0.0
+    # default per-request deadline in seconds (<= 0 = none): applied when
+    # the client names no deadline of its own; the scheduler sheds queued
+    # requests whose remaining budget cannot cover estimated service
+    default_deadline_s: float = 0.0
 
     def resolved_burst(self) -> float:
         if self.rate_tokens_per_s <= 0:

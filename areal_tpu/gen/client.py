@@ -22,12 +22,21 @@ import asyncio
 import dataclasses
 import json
 import random
+import time
 from typing import Dict, List, Optional
 
 import aiohttp
 
 from areal_tpu.base import faults
 from areal_tpu.base import metrics as metrics_mod
+
+
+class DeadlineExceeded(asyncio.TimeoutError):
+    """The request's overall deadline expired before the stream opened.
+
+    Typed (instead of a generic timeout) so callers can tell "the client
+    gave up per the caller's own budget" apart from "the peer black-holed
+    the session total" — the former must NOT be retried anywhere."""
 
 # the request never completed: safe to retry even non-idempotent calls
 CONNECTION_ERRORS = (
@@ -198,6 +207,7 @@ class GenAPIClient:
         rid: str,
         input_ids: List[int],
         sampling_params: Dict,
+        deadline_s: Optional[float] = None,
     ):
         """Chunk-granular async iterator over ``/generate_stream``: yields
         one dict per SSE frame (``token_ids``/``logprobs`` deltas; the
@@ -208,14 +218,29 @@ class GenAPIClient:
         never reached the engine); once the response is open, a drop
         mid-stream surfaces to the caller — the server may have generated
         and the slot-cancel path owns cleanup, so re-sending here would
-        double-bill the rid (same posture as ``generate``)."""
+        double-bill the rid (same posture as ``generate``).
+
+        ``deadline_s`` is the request's REMAINING deadline budget in
+        seconds at call time: the connect-retry backoff never sleeps past
+        it (raising :class:`DeadlineExceeded` instead of burning the full
+        attempt budget on a request the caller will discard), and it is
+        forwarded in the body so the gen server sheds the slot when the
+        budget runs out mid-generation."""
         body = {
             "rid": rid,
             "input_ids": input_ids,
             "sampling_params": sampling_params,
         }
+        t_deadline = None
+        if deadline_s is not None and deadline_s > 0:
+            body["deadline_s"] = float(deadline_s)
+            t_deadline = time.monotonic() + deadline_s
         attempt = 0
         while True:
+            if t_deadline is not None and time.monotonic() >= t_deadline:
+                raise DeadlineExceeded(
+                    f"deadline expired before the stream for {rid} opened"
+                )
             try:
                 await faults.maybe_fail_async(
                     "gen.http", url=server_url, op="generate_stream"
@@ -231,8 +256,18 @@ class GenAPIClient:
                 attempt += 1
                 if not retryable or attempt >= self.retry.max_attempts:
                     raise
+                delay = self.retry.delay(attempt - 1, self._rng)
+                if (
+                    t_deadline is not None
+                    and time.monotonic() + delay >= t_deadline
+                ):
+                    # backing off past the deadline would hand the caller
+                    # a stream it must immediately discard
+                    raise DeadlineExceeded(
+                        f"deadline expired during connect backoff for {rid}"
+                    ) from e
                 metrics_mod.counters.add(metrics_mod.FT_CLIENT_RETRIES)
-                await asyncio.sleep(self.retry.delay(attempt - 1, self._rng))
+                await asyncio.sleep(delay)
         try:
             resp.raise_for_status()
             async for raw in resp.content:

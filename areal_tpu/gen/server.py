@@ -35,7 +35,7 @@ from typing import Dict, Optional
 
 from aiohttp import web
 
-from areal_tpu.base import constants, hbm
+from areal_tpu.base import constants, faults, hbm
 from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.gen.engine import GenerationEngine, GenOutput, GenRequest
 
@@ -296,20 +296,22 @@ class GenerationHTTPServer:
     # handlers
     # ------------------------------------------------------------------ #
 
-    async def _parse_request(self, request: web.Request) -> GenRequest:
+    async def _parse_request(self, request: web.Request):
         """Decode + validate one generate payload (raises
-        RequestValidationError with a field-naming message)."""
+        RequestValidationError with a field-naming message); returns the
+        GenRequest plus the raw body for transport-level fields the
+        engine request does not carry (``deadline_s``)."""
         try:
             d = await request.json()
         except (ValueError, TypeError):
             raise RequestValidationError("body is not valid JSON")
         return parse_generate_request(
             d, self.engine.cfg.vocab_size, self.engine.S, self.engine.G
-        )
+        ), d
 
     async def _generate(self, request: web.Request) -> web.Response:
         try:
-            req = await self._parse_request(request)
+            req, _ = await self._parse_request(request)
         except RequestValidationError as e:
             return web.json_response({"error": str(e)}, status=400)
         fut = asyncio.get_event_loop().create_future()
@@ -339,11 +341,27 @@ class GenerationHTTPServer:
         """SSE variant of /generate: per-chunk token deltas as they are
         harvested, a final frame carrying ``finish_reason``, then
         ``data: [DONE]``. A client disconnect cancels the request and
-        releases its engine slot immediately."""
+        releases its engine slot immediately.
+
+        An optional top-level ``deadline_s`` (remaining seconds of the
+        caller's budget, stamped at request time) is enforced HERE as well
+        as at the gateway: when it runs out mid-generation the server
+        emits a final ``finish_reason: "deadline"`` frame and cancels the
+        slot — the engine never burns chunks for an answer nobody is
+        waiting for, even if the gateway's own cancel is slow to land."""
         try:
-            req = await self._parse_request(request)
+            req, raw = await self._parse_request(request)
         except RequestValidationError as e:
             return web.json_response({"error": str(e)}, status=400)
+        deadline_t = None
+        try:
+            deadline_s = float(raw.get("deadline_s", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "'deadline_s' must be a number"}, status=400
+            )
+        if deadline_s > 0:
+            deadline_t = time.monotonic() + deadline_s
         loop = asyncio.get_event_loop()
         q: asyncio.Queue = asyncio.Queue()
         self._stream_subs[req.rid] = q
@@ -367,6 +385,22 @@ class GenerationHTTPServer:
             await resp.prepare(request)
             try:
                 while True:
+                    if (
+                        deadline_t is not None
+                        and time.monotonic() >= deadline_t
+                    ):
+                        # budget ran out mid-generation: final frame +
+                        # slot cancel (finished stays False -> the
+                        # finally below cancels the rid)
+                        await resp.write(
+                            b"data: " + json.dumps({
+                                "rid": req.rid, "token_ids": [],
+                                "logprobs": [],
+                                "finish_reason": "deadline",
+                            }).encode() + b"\n\n"
+                        )
+                        await resp.write(b"data: [DONE]\n\n")
+                        break
                     try:
                         ev = await asyncio.wait_for(q.get(), timeout=0.5)
                     except asyncio.TimeoutError:
@@ -376,6 +410,16 @@ class GenerationHTTPServer:
                         if tr is None or tr.is_closing():
                             raise ConnectionResetError("client went away")
                         continue
+                    # serving-plane chaos hooks (tools/chaos.py --serve):
+                    # a scripted backend death drops the stream without a
+                    # final frame (FaultInjected IS a ConnectionError —
+                    # the quiet-end path below cancels the slot exactly
+                    # like a real mid-stream crash); a scripted wedge
+                    # stalls the first chunk past the gateway's hedge delay
+                    faults.maybe_fail("gw.backend_die_midstream", rid=req.rid)
+                    await faults.maybe_fail_async(
+                        "gw.backend_wedge", rid=req.rid
+                    )
                     await resp.write(
                         b"data: " + json.dumps(ev).encode() + b"\n\n"
                     )
@@ -383,7 +427,8 @@ class GenerationHTTPServer:
                     if ev.get("finish_reason"):
                         finished = True
                         break
-                await resp.write(b"data: [DONE]\n\n")
+                if finished:
+                    await resp.write(b"data: [DONE]\n\n")
             except (ConnectionResetError, ConnectionError):
                 # client went away: not a server error — free the slot
                 # (in finally) and end the response quietly
@@ -579,6 +624,10 @@ class GenerationHTTPServer:
             "max_slots": self.engine.B,
             # per-slot token capacity: the gateway's prompt-size bound
             "slot_capacity": self.engine.S,
+            # weight-update pause flag: the gateway's hedge gate (a pause
+            # stalls EVERY backend the same way — hedging it would double
+            # the load for zero latency win)
+            "paused": bool(self.engine.paused),
             # paged KV pool + prefix cache observability: bytes, dtype and
             # occupancy are the per-server HBM-headroom gauges the fleet
             # aggregator / apps/obs watch (docs/observability.md)

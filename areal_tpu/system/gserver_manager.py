@@ -436,12 +436,21 @@ class GserverManager:
 
     def _pick_server(self, meta: dict) -> str:
         urls = [u for u in self.server_urls if self.fleet.is_healthy(u)]
-        if not urls:
-            # whole fleet evicted: route to any server rather than erroring
-            # the rollout worker — its retry plane handles the failure and
-            # the probe loop is working on re-admission
+        if not urls and self.server_urls:
+            # whole fleet evicted: answer 503 + Retry-After (the probe
+            # loop's re-admission cadence) instead of routing into a
+            # server the breaker just proved dead — the worker's retry
+            # plane backs off honestly instead of burning its attempt
+            # budget against open breakers
             metrics_mod.counters.add(metrics_mod.FT_ROUTE_NO_HEALTHY)
-            urls = self.server_urls
+            raise web.HTTPServiceUnavailable(
+                reason="no healthy generation server (all breakers open)",
+                headers={
+                    "Retry-After": str(
+                        max(1, int(self.fleet.probe_cooldown_s + 0.999))
+                    )
+                },
+            )
         if not urls:
             # routed set empty (discovery hasn't run / everything removed):
             # a clean error the caller's retry plane understands, not a

@@ -10,12 +10,14 @@ autoscaler decision table on synthetic ``fleet/`` aggregates.
 
 import asyncio
 import json
+import time
 
 import aiohttp
 import pytest
 
 import jax
 
+from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.base import network
 from areal_tpu.gateway.api import (
     ByteFallbackCodec,
@@ -29,13 +31,19 @@ from areal_tpu.gateway.autoscaler import (
     ScaleSignals,
     decide,
 )
+from areal_tpu.gateway.brownout import (
+    BrownoutConfig,
+    BrownoutController,
+)
+from areal_tpu.gateway.brownout import decide as brownout_decide
 from areal_tpu.gateway.qos import TenantSpec, TokenBucket, WeightedFairQueue
 from areal_tpu.gateway.scheduler import (
     ContinuousBatchScheduler,
     GatewayRequest,
     RateLimited,
+    ServiceUnavailable,
 )
-from areal_tpu.gen.client import GenAPIClient
+from areal_tpu.gen.client import DeadlineExceeded, GenAPIClient
 from areal_tpu.gen.engine import GenerationEngine, GenRequest
 from areal_tpu.gen.server import serve
 from areal_tpu.models import transformer as tfm
@@ -368,7 +376,7 @@ class _StubGenClient:
             "slot_capacity": 4096,
         }
 
-    async def generate_stream(self, url, rid, ids, sp):
+    async def generate_stream(self, url, rid, ids, sp, deadline_s=None):
         self.streams += 1
         yield {"token_ids": [], "logprobs": [], "finish_reason": "stop"}
 
@@ -658,3 +666,344 @@ def test_autoscaler_cooldown_and_callbacks():
     sig["cur"] = _signals()
     d = asc.step_once()
     assert d.action == "shrink" and shrunk == [1]
+
+
+# --------------------------------------------------------------------- #
+# survivability: deadlines, hedged dispatch, brownout, 503s
+# --------------------------------------------------------------------- #
+
+
+class _BlockedStubClient:
+    """Reports a pinned KV pool so dispatch never proceeds — requests
+    stay queued, which is where the deadline sweep must find them."""
+
+    def __init__(self):
+        self.streams = 0
+
+    async def metrics(self, url):
+        return {
+            "max_slots": 4,
+            "kv_pool_demand_occupancy": 1.0,
+            "slot_capacity": 4096,
+        }
+
+    async def generate_stream(self, url, rid, ids, sp, deadline_s=None):
+        self.streams += 1
+        yield {"token_ids": [], "logprobs": [], "finish_reason": "stop"}
+
+
+async def test_deadline_expire_in_queue_refunds_and_rolls_back():
+    """A queued request whose deadline lapses is shed IN QUEUE: full
+    token-bucket refund, fair-clock rollback, a final deadline event for
+    the waiting handler — and the backend never sees it."""
+    t = {"now": 0.0}
+    stub = _BlockedStubClient()
+    sched = ContinuousBatchScheduler(
+        ["http://stub:1"],
+        tenants={"t": TenantSpec(
+            name="t", rate_tokens_per_s=100.0, burst_tokens=10_000.0,
+        )},
+        client=stub,
+        clock=lambda: t["now"],
+    )
+    await sched.start()
+    try:
+        shed0 = metrics_mod.counters.get(metrics_mod.GW_DEADLINE_SHED)
+        bucket = sched._bucket("t")
+        before = bucket.available
+        req = GatewayRequest.build(
+            "t", [1, 2, 3], {"max_new_tokens": 8}, deadline_s=5.0,
+        )
+        sched.submit(req)
+        assert req.deadline_t == pytest.approx(5.0)
+        assert bucket.available < before  # charged on admit
+        t["now"] = 10.0
+        assert sched.sweep_deadlines() == 1
+        evs = []
+        async for ev in sched.events(req):
+            evs.append(ev)
+        assert evs[-1]["finish_reason"] == "deadline"
+        assert stub.streams == 0          # never dispatched
+        assert sched.queue_depth() == 0
+        assert bucket.available == pytest.approx(before)
+        assert sched._wfq._last_vft.get("t", 0.0) == pytest.approx(0.0)
+        assert (
+            metrics_mod.counters.get(metrics_mod.GW_DEADLINE_SHED) - shed0
+            == 1
+        )
+    finally:
+        await sched.stop()
+
+
+class _HedgeStubClient:
+    """One backend wedges pre-first-chunk, the other streams; records
+    every stream open/close so the test can assert the loser was torn
+    down and no slot is left bound."""
+
+    def __init__(self, slow_url):
+        self.slow_url = slow_url
+        self.streams = []
+        self.closed = []
+
+    async def metrics(self, url):
+        return {
+            "max_slots": 4,
+            "kv_pool_demand_occupancy": 0.0,
+            "slot_capacity": 4096,
+        }
+
+    async def generate_stream(self, url, rid, ids, sp, deadline_s=None):
+        self.streams.append((url, rid))
+        try:
+            if url == self.slow_url:
+                await asyncio.sleep(3600)
+            for _ in range(4):
+                yield {"token_ids": [7], "logprobs": [0.0],
+                       "finish_reason": None}
+                await asyncio.sleep(0.02)
+            yield {"token_ids": [], "logprobs": [], "finish_reason": "stop"}
+        finally:
+            self.closed.append((url, rid))
+
+
+async def test_cancel_during_hedge_settles_slots_and_bucket():
+    """Wedged primary -> the hedge wins; the client then cancels
+    mid-stream. Both backends' slot holds must come back, the loser's
+    stream must be closed, and the bucket must settle to exactly what
+    was consumed — the hedge must never double-charge."""
+    metrics_mod.counters.clear(metrics_mod.GW_TTFT_S)
+    urls = ["http://a:1", "http://b:1"]
+    stub = _HedgeStubClient(slow_url=urls[0])
+    sched = ContinuousBatchScheduler(
+        list(urls),
+        tenants={"t": TenantSpec(
+            # near-zero refill so the final balance shows REFUNDS, not
+            # the bucket quietly refilling behind the assertion
+            name="t", rate_tokens_per_s=0.01, burst_tokens=10_000.0,
+        )},
+        client=stub,
+        hedge_enabled=True,
+        hedge_min_delay_s=0.05,
+    )
+    await sched.start()
+    try:
+        hedges0 = metrics_mod.counters.get(metrics_mod.GW_HEDGES)
+        wins0 = metrics_mod.counters.get(metrics_mod.GW_HEDGE_WINS)
+        bucket = sched._bucket("t")
+        before = bucket.available
+        req = GatewayRequest.build("t", [1, 2, 3], {"max_new_tokens": 64})
+        sched.submit(req)
+        got = []
+        async for ev in sched.events(req):
+            got.extend(ev.get("token_ids", []))
+            if len(got) >= 2:
+                sched.cancel(req)
+                break
+        for _ in range(300):
+            await asyncio.sleep(0.01)
+            if sched.inflight() == 0:
+                break
+        assert sched.inflight() == 0
+        assert metrics_mod.counters.get(metrics_mod.GW_HEDGES) - hedges0 == 1
+        assert (
+            metrics_mod.counters.get(metrics_mod.GW_HEDGE_WINS) - wins0 == 1
+        )
+        # both backends were opened; the wedged loser was closed
+        assert {u for u, _ in stub.streams} == set(urls)
+        assert urls[0] in {u for u, _ in stub.closed}
+        # bucket settled at cost-of-what-ran, not the full budget and
+        # not a double (hedged) charge
+        used = 3 + req.n_generated
+        assert bucket.available == pytest.approx(before - used, abs=1.0)
+    finally:
+        await sched.stop()
+
+
+async def test_all_breakers_open_answers_503_with_retry_after(params):
+    """Every backend breaker open: submit raises ServiceUnavailable and
+    the HTTP surface turns it into 503 + an honest Retry-After — not a
+    silent hang, not a 429 blaming the client."""
+    st = await _stack(params, metrics_poll_interval=9999.0)
+    try:
+        for s in st.scheduler._servers.values():
+            s.healthy = False
+        with pytest.raises(ServiceUnavailable) as ei:
+            st.scheduler.submit(
+                GatewayRequest.build("t", PROMPT, {"max_new_tokens": 2})
+            )
+        assert ei.value.retry_after_s > 0
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{st.gw_url}/v1/completions",
+                json={"prompt": PROMPT, "max_tokens": 2},
+            )
+            assert r.status == 503
+            assert int(r.headers["Retry-After"]) >= 1
+            err = (await r.json())["error"]
+            assert err["code"] == "service_unavailable"
+    finally:
+        await st.close()
+
+
+def test_queue_full_retry_after_is_drain_estimate():
+    """The queue-full 429 hint tracks the live queue-wait p95 (clamped
+    to [1, 60]) instead of a made-up constant."""
+    sched = ContinuousBatchScheduler(
+        ["http://stub:1"], client=_BlockedStubClient(),
+    )
+    metrics_mod.counters.clear(metrics_mod.GW_QUEUE_WAIT_S)
+    assert sched._queue_retry_after_s() == pytest.approx(1.0)
+    for _ in range(20):
+        metrics_mod.counters.observe(metrics_mod.GW_QUEUE_WAIT_S, 5.0)
+    assert sched._queue_retry_after_s() == pytest.approx(5.0, rel=0.2)
+    for _ in range(200):
+        metrics_mod.counters.observe(metrics_mod.GW_QUEUE_WAIT_S, 120.0)
+    assert sched._queue_retry_after_s() == pytest.approx(60.0)
+    metrics_mod.counters.clear(metrics_mod.GW_QUEUE_WAIT_S)
+
+
+async def test_generate_stream_connect_retries_honor_deadline():
+    """Connect retries against a dead backend stop at the request
+    deadline with the typed DeadlineExceeded — not after the full
+    backoff ladder."""
+    from areal_tpu.gen.client import RetryPolicy
+
+    url = f"http://127.0.0.1:{network.find_free_port()}"  # nobody there
+    # backoff big enough that the attempt budget alone would outlive the
+    # deadline: only the deadline check can end the loop
+    async with GenAPIClient(
+        timeout=5.0,
+        retry=RetryPolicy(max_attempts=100, backoff_base_s=0.5, jitter=0.0),
+    ) as cl:
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            async for _ in cl.generate_stream(
+                url, "r-dead", [1, 2], {"max_new_tokens": 2},
+                deadline_s=0.4,
+            ):
+                pass
+        assert time.monotonic() - t0 < 4.0
+
+
+async def test_deadline_e2e_504_and_validation(params):
+    """A request whose budget can't be met answers 504 (its own typed
+    error, not a generic 500); a malformed deadline answers 400."""
+    st = await _stack(params)
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{st.gw_url}/v1/completions",
+                json={"prompt": PROMPT, "max_tokens": 8,
+                      "timeout": 0.0001},
+            )
+            assert r.status == 504, await r.text()
+            err = (await r.json())["error"]
+            assert err["code"] == "deadline_exceeded"
+            # header spelling of the same deadline
+            r = await s.post(
+                f"{st.gw_url}/v1/completions",
+                json={"prompt": PROMPT, "max_tokens": 8},
+                headers={"X-Request-Deadline": "0.0001"},
+            )
+            assert r.status == 504
+            for bad in (-1, "soon", float("inf")):
+                r = await s.post(
+                    f"{st.gw_url}/v1/completions",
+                    json={"prompt": PROMPT, "max_tokens": 2,
+                          "timeout": bad},
+                )
+                assert r.status == 400, bad
+            # a generous deadline changes nothing
+            r = await s.post(
+                f"{st.gw_url}/v1/completions",
+                json={"prompt": PROMPT, "max_tokens": 4, "timeout": 300,
+                      "temperature": 0},
+            )
+            assert r.status == 200
+            assert (await r.json())["usage"]["completion_tokens"] == 4
+    finally:
+        await st.close()
+
+
+def test_brownout_decide_table():
+    cfg = BrownoutConfig()
+
+    def sig(**kw):
+        return ScaleSignals(routed=4, healthy=4, **kw)
+
+    # healthy fleet holds at 0
+    assert brownout_decide(cfg, sig(), 0) == 0
+    # each signal kind can trip a rung on its own
+    assert brownout_decide(cfg, sig(kv_occupancy=0.91), 0) == 1
+    assert brownout_decide(cfg, sig(queue_wait_p95_s=16.0), 0) == 2
+    assert brownout_decide(cfg, sig(breaker_open=3), 0) == 3
+    # escalation jumps straight to the worst tripped rung
+    assert brownout_decide(cfg, sig(kv_occupancy=0.995), 0) == 4
+    assert brownout_decide(cfg, sig(kv_occupancy=0.995), 2) == 4
+    # hysteresis: below the entry bound but above entry*h holds the rung
+    assert brownout_decide(cfg, sig(kv_occupancy=0.80), 1) == 1
+    assert brownout_decide(cfg, sig(kv_occupancy=0.50), 1) == 0
+    # de-escalation is one rung at a time even from a silent fleet
+    assert brownout_decide(cfg, sig(), 4) == 3
+
+
+async def test_brownout_controller_dwell_and_levers():
+    calls = {"clamp": [], "spec": [], "shed": [], "pause": []}
+    t = {"now": 0.0}
+    sig = {"s": ScaleSignals(routed=2, healthy=2)}
+
+    async def spec_cb(enabled):
+        calls["spec"].append(enabled)
+
+    cfg = BrownoutConfig(min_hold_s=10.0, interval_s=1.0)
+    ctrl = BrownoutController(
+        cfg,
+        lambda: sig["s"],
+        lambda v: calls["clamp"].append(v),
+        spec_cb,
+        lambda floor, ra: calls["shed"].append(floor),
+        lambda paused, ra: calls["pause"].append(paused),
+        clock=lambda: t["now"],
+    )
+    sig["s"] = ScaleSignals(routed=2, healthy=2, kv_occupancy=0.96)
+    assert await ctrl.step_once() == 2
+    assert calls["clamp"][-1] == cfg.clamp_max_tokens
+    assert calls["spec"] == [False]
+    # recovery is dwell-gated...
+    sig["s"] = ScaleSignals(routed=2, healthy=2)
+    t["now"] = 5.0
+    assert await ctrl.step_once() == 2
+    # ...and one rung per pass once the hold lapses
+    t["now"] = 20.0
+    assert await ctrl.step_once() == 1
+    assert calls["spec"] == [False, True]
+    t["now"] = 40.0
+    assert await ctrl.step_once() == 0
+    assert calls["clamp"][-1] is None
+    # escalation is NEVER dwell-gated
+    sig["s"] = ScaleSignals(routed=2, healthy=2, kv_occupancy=0.995)
+    t["now"] = 40.5
+    assert await ctrl.step_once() == 4
+    assert calls["shed"][-1] == cfg.weight_floor
+    assert calls["pause"][-1] is True
+    # the Retry-After hint is at least one controller interval
+    assert ctrl.retry_after_s() >= cfg.interval_s
+
+
+async def test_brownout_clamp_applies_to_new_requests(params):
+    """Level-1 clamp: the gateway caps max_tokens fleet-wide without
+    erroring the request — shorter answers, not failures."""
+    gw_config = GatewayConfig(max_tokens_cap=256)
+    st = await _stack(params, gw_config=gw_config)
+    try:
+        gw_config.brownout_max_tokens = 3
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"{st.gw_url}/v1/completions",
+                json={"prompt": PROMPT, "max_tokens": 64,
+                      "temperature": 0},
+            )
+            assert r.status == 200, await r.text()
+            assert (await r.json())["usage"]["completion_tokens"] == 3
+    finally:
+        await st.close()

@@ -257,3 +257,21 @@ async def test_prune_respects_unacked_servers(cfg, tmp_path):
     m.fleet.evict("http://b", "test")
     m._prune_checkpoints()
     assert not (tmp_path / "v2b").exists()
+
+
+async def test_all_breakers_open_answers_503_with_retry_after(cfg):
+    """Every backend evicted/breaker-open: /schedule_request must answer
+    503 with an honest Retry-After (the probe cooldown) instead of
+    routing into a known-dead fleet."""
+    m = GserverManager(cfg, server_urls=["http://a", "http://b"])
+    for u in ["http://a", "http://b"]:
+        m.fleet.evict(u, "test: breaker open")
+    c = await _client(m)
+    r = await c.post(
+        "/schedule_request",
+        json={"qid": "q-dead", "prompt_len": 1, "group_size": 1,
+              "new_token_budget": 1},
+    )
+    assert r.status == 503
+    assert int(r.headers["Retry-After"]) >= 1
+    await c.close()

@@ -369,6 +369,422 @@ class GenFleetProbe(threading.Thread):
 
 
 # --------------------------------------------------------------------- #
+# Serving-plane soak (``make chaos-serve``)
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class ServeChaosConfig:
+    """Knobs for the serving-plane survivability soak (``--serve``)."""
+
+    max_new_tokens: int = 12
+    storm_requests: int = 5
+    storm_deadline_s: float = 0.5
+    wedge_delay_s: float = 6.0
+    drain_timeout_s: float = 30.0
+    run_arealint: bool = True
+
+
+def run_serve_scenario(cfg: ServeChaosConfig) -> Dict:
+    """Serving-plane survivability soak: two tiny identical-weight gen
+    servers behind the real gateway scheduler, driven through scripted
+    faults (docs/serving.md "Survivability"):
+
+    A. **backend death mid-stream** (``gw.backend_die_midstream``): the
+       stream resumes on the surviving backend and the final token
+       sequence is EXACTLY the unfaulted greedy reference.
+    B. **backend wedge pre-first-chunk** (``gw.backend_wedge``): the
+       hedge opens on the second backend, wins, and the tokens still
+       match the reference.
+    C. **deadline storm** (``gw.deadline_storm``): queued requests age
+       out against their deadlines and are shed IN QUEUE — zero engine
+       admissions, full token-bucket refund, fair-clock restored.
+    D. **brownout walk**: synthetic pressure drives the ladder up level
+       by level (clamp -> spec off -> shed light tenants -> admit
+       nothing) and hysteresis + dwell walk it back down, restoring
+       every lever.
+
+    End state must leak nothing: no running slots, no pending requests,
+    zero unaccounted KV pages, empty queue, settled buckets — and
+    ``tools.arealint`` still exits 0."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import asyncio
+
+    return asyncio.run(_serve_soak(cfg))
+
+
+async def _serve_soak(cfg: ServeChaosConfig) -> Dict:
+    import asyncio
+
+    import jax
+
+    from areal_tpu.base import faults, network
+    from areal_tpu.base import metrics as metrics_mod
+    from areal_tpu.gateway.autoscaler import ScaleSignals
+    from areal_tpu.gateway.brownout import BrownoutConfig, wire_brownout
+    from areal_tpu.gateway.qos import TenantSpec
+    from areal_tpu.gateway.scheduler import (
+        ContinuousBatchScheduler,
+        GatewayRequest,
+        RateLimited,
+    )
+    from areal_tpu.gen.client import GenAPIClient
+    from areal_tpu.gen.engine import GenerationEngine
+    from areal_tpu.gen.server import serve as serve_gen
+    from areal_tpu.models import transformer as tfm
+    from areal_tpu.models.config import ModelConfig
+
+    mcfg = ModelConfig(
+        n_layers=2, n_q_heads=4, n_kv_heads=2, head_dim=8,
+        hidden_dim=32, intermediate_dim=64, vocab_size=128,
+        dtype="float32",
+    )
+    # IDENTICAL weights on both backends: greedy decode is then
+    # deterministic across them, which is what makes "token-exact resume
+    # after backend death" a checkable invariant
+    params = tfm.init_params(mcfg, jax.random.key(7))
+    engines = [
+        GenerationEngine(mcfg, params, max_slots=2, max_seqlen=64)
+        for _ in range(2)
+    ]
+    runners, urls = [], []
+    for eng in engines:
+        port = network.find_free_port()
+        runners.append(
+            await serve_gen(eng, "127.0.0.1", port, decode_steps=2)
+        )
+        urls.append(f"http://127.0.0.1:{port}")
+
+    sched = ContinuousBatchScheduler(
+        list(urls),
+        tenants={
+            # near-zero refill: the post-shed bucket level proves REFUNDS,
+            # not refill, restored the balance
+            "lim": TenantSpec(
+                name="lim", rate_tokens_per_s=0.01, burst_tokens=10_000.0
+            ),
+            "cheap": TenantSpec(name="cheap", weight=0.5),
+        },
+        default_tenant=TenantSpec(name="anonymous"),
+        metrics_poll_interval=0.5,
+        hedge_min_delay_s=30.0,  # scenario B lowers it explicitly
+        deadline_sweep_interval_s=0.1,
+    )
+    await sched.start()
+
+    violations: List[str] = []
+    report: Dict = {"scenarios": {}}
+    prompt = [5, 6, 7]
+    sp = {"max_new_tokens": cfg.max_new_tokens, "greedy": True}
+
+    async def collect(req):
+        sched.submit(req)
+        toks: List[int] = []
+        last = {}
+        async for ev in sched.events(req):
+            toks.extend(ev.get("token_ids", []))
+            last = ev
+        return toks, last.get("finish_reason")
+
+    def counter(name) -> float:
+        return metrics_mod.counters.get(name)
+
+    async with GenAPIClient(timeout=60.0) as cl:
+        try:
+            # warm BOTH backends (absorb jit compile) so latency
+            # estimates and hedge timing are not dominated by the first
+            # request's compilation
+            for u in urls:
+                sched.set_servers([u])
+                await collect(
+                    GatewayRequest.build("anonymous", prompt, dict(sp))
+                )
+            sched.set_servers(list(urls))
+            # drop compile-dominated warmup TTFTs so the live p95 (the
+            # hedge-delay floor) reflects steady-state latency
+            metrics_mod.counters.clear(metrics_mod.GW_TTFT_S)
+
+            # unfaulted greedy reference
+            ref_toks, ref_fin = await collect(
+                GatewayRequest.build("anonymous", prompt, dict(sp))
+            )
+            if len(ref_toks) != cfg.max_new_tokens:
+                violations.append(
+                    f"reference run produced {len(ref_toks)} tokens, "
+                    f"expected {cfg.max_new_tokens}"
+                )
+
+            # A: kill the backend mid-stream -> token-exact resume
+            resumes0 = counter(metrics_mod.GW_STREAM_RESUMES)
+            faults.inject(
+                "gw.backend_die_midstream", action="fail", times=1, after=2
+            )
+            a_toks, a_fin = await collect(
+                GatewayRequest.build("anonymous", prompt, dict(sp))
+            )
+            faults.reset()
+            resumed = counter(metrics_mod.GW_STREAM_RESUMES) - resumes0
+            report["scenarios"]["die_midstream"] = {
+                "tokens_match": a_toks == ref_toks,
+                "finish": a_fin,
+                "stream_resumes": resumed,
+            }
+            if a_toks != ref_toks:
+                violations.append(
+                    f"resume after backend death not token-exact: "
+                    f"{a_toks} != {ref_toks}"
+                )
+            if resumed < 1:
+                violations.append("backend death triggered no stream resume")
+            await sched.poll_capacity()  # re-admit the 'dead' backend
+
+            # B: wedge the primary pre-first-chunk -> the hedge wins
+            hedges0 = counter(metrics_mod.GW_HEDGES)
+            wins0 = counter(metrics_mod.GW_HEDGE_WINS)
+            sched.hedge_min_delay_s = 1.0
+            faults.inject(
+                "gw.backend_wedge", action="delay",
+                delay_s=cfg.wedge_delay_s, times=1,
+            )
+            b_toks, b_fin = await collect(
+                GatewayRequest.build("anonymous", prompt, dict(sp))
+            )
+            faults.reset()
+            sched.hedge_min_delay_s = 30.0
+            hedged = counter(metrics_mod.GW_HEDGES) - hedges0
+            won = counter(metrics_mod.GW_HEDGE_WINS) - wins0
+            report["scenarios"]["wedge_hedge"] = {
+                "tokens_match": b_toks == ref_toks,
+                "finish": b_fin,
+                "hedges": hedged,
+                "hedge_wins": won,
+            }
+            if b_toks != ref_toks:
+                violations.append(
+                    f"hedged stream not token-exact: {b_toks} != {ref_toks}"
+                )
+            if hedged < 1 or won < 1:
+                violations.append(
+                    f"wedge did not produce a winning hedge "
+                    f"(hedges={hedged}, wins={won})"
+                )
+
+            # C: deadline storm — zero dispatch capacity, queued requests
+            # age out in the fair queue and never touch a backend
+            shed0 = counter(metrics_mod.GW_DEADLINE_SHED)
+            admitted0 = [eng.stats["admitted"] for eng in engines]
+            faults.inject("gw.deadline_storm", action="trip", times=100_000)
+            storm = [
+                GatewayRequest.build(
+                    "lim", prompt, dict(sp),
+                    deadline_s=cfg.storm_deadline_s,
+                )
+                for _ in range(cfg.storm_requests)
+            ]
+            results = await asyncio.gather(
+                *(collect(r) for r in storm), return_exceptions=True
+            )
+            faults.reset()
+            bad = [r for r in results if isinstance(r, BaseException)]
+            if bad:
+                violations.append(f"storm stream raised: {bad[0]!r}")
+                results = [
+                    r for r in results if not isinstance(r, BaseException)
+                ]
+            sched._wake.set()
+            shed = counter(metrics_mod.GW_DEADLINE_SHED) - shed0
+            admitted_delta = [
+                eng.stats["admitted"] - a0
+                for eng, a0 in zip(engines, admitted0)
+            ]
+            bucket = sched._bucket("lim")
+            report["scenarios"]["deadline_storm"] = {
+                "finishes": [fin for _, fin in results],
+                "deadline_shed": shed,
+                "backend_admissions": admitted_delta,
+                "bucket_available": bucket.available,
+            }
+            if any(fin != "deadline" for _, fin in results):
+                violations.append(
+                    f"storm finishes {[f for _, f in results]} "
+                    "(expected all 'deadline')"
+                )
+            if shed != cfg.storm_requests:
+                violations.append(
+                    f"gw/deadline_shed advanced {shed}, expected "
+                    f"{cfg.storm_requests}"
+                )
+            if any(admitted_delta):
+                violations.append(
+                    f"deadline-shed requests reached a backend: "
+                    f"admissions {admitted_delta}"
+                )
+            if bucket.available < bucket.burst - 1.0:
+                violations.append(
+                    f"token bucket not refunded after storm: "
+                    f"{bucket.available} / {bucket.burst}"
+                )
+            # rollback must leave 'lim' with NO residual service debt:
+            # its finish tag may not sit past the global virtual clock,
+            # so its next push starts exactly where an innocent tenant's
+            # would
+            vft = sched._wfq._last_vft.get("lim", 0.0)
+            if vft > sched._wfq._vtime + 1e-6:
+                violations.append(
+                    f"fair-queue clock not restored after storm: "
+                    f"lim vft {vft} > vtime {sched._wfq._vtime}"
+                )
+
+            # D: brownout walk — up the ladder level by level on synthetic
+            # pressure, back down under hysteresis + dwell
+            for u in urls:
+                await cl.set_spec_decode(u, True)
+            trans0 = counter(metrics_mod.GW_BROWNOUT_TRANSITIONS)
+            bcfg = BrownoutConfig(min_hold_s=5.0, clamp_max_tokens=8)
+            fake_t = [0.0]
+
+            class _GwCfg:
+                brownout_max_tokens = None
+
+            gw_cfg = _GwCfg()
+            ctrl = wire_brownout(
+                bcfg, sched, gw_cfg, cl, clock=lambda: fake_t[0]
+            )
+            sig = [ScaleSignals(routed=2, healthy=2)]
+            ctrl.fetch_signals = lambda: sig[0]
+
+            async def walk(kv, advance=6.0):
+                fake_t[0] += advance
+                sig[0] = dataclasses.replace(sig[0], kv_occupancy=kv)
+                return await ctrl.step_once()
+
+            levels = [await walk(kv) for kv in (0.92, 0.96, 0.975)]
+            # level 3: a below-floor tenant is shed with an honest hint
+            shed_ok = pause_ok = False
+            try:
+                sched.submit(
+                    GatewayRequest.build("cheap", prompt, dict(sp))
+                )
+            except RateLimited as e:
+                shed_ok = e.retry_after_s > 0
+            levels.append(await walk(0.995))
+            spec_off = [
+                bool((await cl.metrics(u)).get("spec_decode")) for u in urls
+            ]
+            clamp_at_top = gw_cfg.brownout_max_tokens
+            # level 4: nobody new gets in
+            try:
+                sched.submit(
+                    GatewayRequest.build("anonymous", prompt, dict(sp))
+                )
+            except RateLimited as e:
+                pause_ok = e.retry_after_s > 0
+            # hysteresis: barely below the level-4 entry is NOT enough to
+            # step down, even after the dwell
+            held = await walk(0.985)
+            down = [await walk(0.10) for _ in range(4)]
+            spec_back = [
+                bool((await cl.metrics(u)).get("spec_decode")) for u in urls
+            ]
+            transitions = counter(
+                metrics_mod.GW_BROWNOUT_TRANSITIONS
+            ) - trans0
+            report["scenarios"]["brownout_walk"] = {
+                "up": levels,
+                "held_at": held,
+                "down": down,
+                "spec_disabled_at_top": [not s for s in spec_off],
+                "spec_restored": spec_back,
+                "clamp_at_top": clamp_at_top,
+                "clamp_after": gw_cfg.brownout_max_tokens,
+                "shed_429": shed_ok,
+                "pause_429": pause_ok,
+                "transitions": transitions,
+            }
+            if levels != [1, 2, 3, 4]:
+                violations.append(f"brownout escalation walked {levels}")
+            if held != 4:
+                violations.append(
+                    f"hysteresis failed: stepped to {held} on a barely-"
+                    "recovered signal"
+                )
+            if down != [3, 2, 1, 0]:
+                violations.append(f"brownout de-escalation walked {down}")
+            if any(spec_off):
+                violations.append("level 2 left spec decode enabled")
+            if not all(spec_back):
+                violations.append("recovery did not restore spec decode")
+            if clamp_at_top != bcfg.clamp_max_tokens:
+                violations.append("level 1 did not clamp max_tokens")
+            if gw_cfg.brownout_max_tokens is not None:
+                violations.append("recovery did not remove the clamp")
+            if not shed_ok:
+                violations.append(
+                    "level 3 did not shed the below-floor tenant"
+                )
+            if not pause_ok:
+                violations.append("level 4 admitted a new request")
+            if transitions != 8:
+                violations.append(
+                    f"counted {transitions} brownout transitions, "
+                    "expected 8 (4 up + 4 down; the held step is free)"
+                )
+            if sched.admit_paused or sched.shed_weight_floor:
+                violations.append("brownout levers left engaged at level 0")
+        finally:
+            faults.reset()
+            # drain: every slot, page and charge must come home
+            deadline = time.monotonic() + cfg.drain_timeout_s
+            while time.monotonic() < deadline:
+                if all(
+                    eng.n_running() == 0 and eng.n_pending() == 0
+                    for eng in engines
+                ) and sched.inflight() == 0 and sched.queue_depth() == 0:
+                    break
+                await asyncio.sleep(0.2)
+            leaks = {
+                "slots_running": [eng.n_running() for eng in engines],
+                "pending": [eng.n_pending() for eng in engines],
+                "pages_leaked": [
+                    eng.n_pages - eng.pool.n_free
+                    - eng.prefix.n_reclaimable()
+                    for eng in engines
+                ],
+                "gateway_queue": sched.queue_depth(),
+                "gateway_inflight": sched.inflight(),
+            }
+            report["leaks"] = leaks
+            if any(leaks["slots_running"]) or any(leaks["pending"]):
+                violations.append(f"engine slots leaked: {leaks}")
+            if any(leaks["pages_leaked"]):
+                violations.append(
+                    f"KV pages leaked: {leaks['pages_leaked']}"
+                )
+            if leaks["gateway_queue"] or leaks["gateway_inflight"]:
+                violations.append(
+                    f"gateway queue/inflight not drained: {leaks}"
+                )
+            await sched.stop()
+            for r in runners:
+                await r.cleanup()
+
+    if cfg.run_arealint:
+        import subprocess
+
+        rc = subprocess.call(
+            [sys.executable, "-m", "tools.arealint"], cwd=_REPO_ROOT,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        report["arealint_rc"] = rc
+        if rc != 0:
+            violations.append(f"arealint exited {rc}")
+
+    report["violations"] = [v for v in violations if v]
+    report["ok"] = not report["violations"]
+    return report
+
+
+# --------------------------------------------------------------------- #
 # Scenario runner
 # --------------------------------------------------------------------- #
 
@@ -672,6 +1088,9 @@ def main(argv=None) -> int:
     p.add_argument("--timeout", type=float, default=900.0)
     p.add_argument("--no-gen", action="store_true",
                    help="skip the serving-side probe")
+    p.add_argument("--serve", action="store_true",
+                   help="run the serving-plane survivability soak instead "
+                        "of the training-world scenario")
     p.add_argument("--out", default=None, help="write the report JSON here")
     args = p.parse_args(argv)
 
@@ -679,6 +1098,22 @@ def main(argv=None) -> int:
         if not args.spec:
             p.error("--run-rank requires --spec")
         return run_rank(args.run_rank, args.spec)
+
+    if args.serve:
+        report = run_serve_scenario(ServeChaosConfig())
+        text = json.dumps(report, indent=2)
+        print(text)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text + "\n")
+        if report["ok"]:
+            print("CHAOS-SERVE OK: all invariants hold", file=sys.stderr)
+            return 0
+        print(
+            f"CHAOS-SERVE FAILED: {len(report['violations'])} violation(s)",
+            file=sys.stderr,
+        )
+        return 1
 
     cfg = ChaosConfig(
         seed=args.seed,
