@@ -160,19 +160,25 @@ class GenerationHTTPServer:
         self._hbm = hbm.HBMMonitor(tag="gen-server")
         self._lock = asyncio.Lock()
         self.app = web.Application()
-        self.app.router.add_post("/generate", self._generate)
-        self.app.router.add_post("/generate_stream", self._generate_stream)
-        self.app.router.add_post(
-            "/update_weights_from_disk", self._update_weights
-        )
-        self.app.router.add_post("/pause_generation", self._pause)
-        self.app.router.add_post("/continue_generation", self._continue)
-        self.app.router.add_post("/spec_decode", self._spec_decode)
-        self.app.router.add_get("/health", self._health)
-        self.app.router.add_get("/metrics_json", self._metrics)
+        self._bind_routes(self.app)
         self.app.on_startup.append(self._on_startup)
         self.app.on_cleanup.append(self._on_cleanup)
         self._loop_task: Optional[asyncio.Task] = None
+
+    def _bind_routes(self, app: web.Application) -> None:
+        """The route table in one place: the wire-contract catalog test
+        registers these on a bare Application (no engine construction)
+        and diffs them against the statically parsed endpoint table."""
+        app.router.add_post("/generate", self._generate)
+        app.router.add_post("/generate_stream", self._generate_stream)
+        app.router.add_post(
+            "/update_weights_from_disk", self._update_weights
+        )
+        app.router.add_post("/pause_generation", self._pause)
+        app.router.add_post("/continue_generation", self._continue)
+        app.router.add_post("/spec_decode", self._spec_decode)
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/metrics_json", self._metrics)
 
     # ------------------------------------------------------------------ #
     # engine loop
@@ -688,7 +694,10 @@ class GenerationHTTPServer:
             None, lambda: self._hbm.check(kill=False)
         )
         # gauges only on the pull path — a GET must never raise
-        return web.json_response({**self._metrics_dict(), **hbm_gauges})
+        return web.json_response(
+            # arealint: wire(/metrics_json, hbm gauge keys come from HBMMonitor.check at runtime)
+            {**self._metrics_dict(), **hbm_gauges}
+        )
 
 
 async def serve(engine: GenerationEngine, host: str, port: int, **kw):

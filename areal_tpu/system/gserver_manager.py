@@ -120,21 +120,27 @@ class GserverManager:
         self._last_heartbeat: Dict[str, float] = {}
         self._lock = asyncio.Lock()
         self.app = web.Application()
-        self.app.router.add_post("/schedule_request", self._schedule_request)
-        self.app.router.add_post("/allocate_rollout", self._allocate_rollout)
-        self.app.router.add_post("/finish_rollout", self._finish_rollout)
-        self.app.router.add_post("/report_failure", self._report_failure)
-        self.app.router.add_post("/add_server", self._add_server)
-        self.app.router.add_post("/remove_server", self._remove_server)
-        self.app.router.add_post("/get_model_version", self._get_version)
-        self.app.router.add_get("/health", self._health)
-        self.app.router.add_get("/metrics_json", self._metrics)
+        self._bind_routes(self.app)
         self.app.on_startup.append(self._on_startup)
         self.app.on_cleanup.append(self._on_cleanup)
         self._poll_task: Optional[asyncio.Task] = None
         self._probe_task: Optional[asyncio.Task] = None
         # one detached catch-up/probe task per server being re-admitted
         self._probe_tasks: Dict[str, asyncio.Task] = {}
+
+    def _bind_routes(self, app: web.Application) -> None:
+        """The route table in one place: the wire-contract catalog test
+        registers these on a bare Application (no manager construction)
+        and diffs them against the statically parsed endpoint table."""
+        app.router.add_post("/schedule_request", self._schedule_request)
+        app.router.add_post("/allocate_rollout", self._allocate_rollout)
+        app.router.add_post("/finish_rollout", self._finish_rollout)
+        app.router.add_post("/report_failure", self._report_failure)
+        app.router.add_post("/add_server", self._add_server)
+        app.router.add_post("/remove_server", self._remove_server)
+        app.router.add_post("/get_model_version", self._get_version)
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/metrics_json", self._metrics)
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -607,10 +613,15 @@ class GserverManager:
         generate against ``url`` failed after client-level retries."""
         d = await request.json()
         url = d.get("url", "")
+        reason = d.get("reason", "reported by rollout worker")
+        qid = d.get("qid")
+        if qid is not None:
+            # every reporter sends the failing rollout's qid; keep it in
+            # the breaker's last_failure_reason so evictions are
+            # attributable to a specific rollout in fleet state dumps
+            reason = f"{reason} (qid={qid})"
         async with self._lock:
-            evicted = self.fleet.observe_failure(
-                url, d.get("reason", "reported by rollout worker")
-            )
+            evicted = self.fleet.observe_failure(url, reason)
             if evicted:
                 self._remap_stickies()
             s = self.fleet.get(url)

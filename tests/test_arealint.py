@@ -756,6 +756,45 @@ class TestSarif:
                 "with '# arealint: owns(gateway.token-bucket, <reason>)'",
                 "error",
             ),
+            # one finding per v5 wire rule (docs/static_analysis.md
+            # "Wire rules")
+            Finding(
+                "areal_tpu/gateway/demo.py", 18, "unknown-endpoint",
+                "GenAPIClient.pause calls POST /pause, which no server "
+                "module registers — the request can only 404",
+                "error",
+            ),
+            Finding(
+                "areal_tpu/system/demo.py", 61, "request-field-drift",
+                "session.post posts /allocate_rollout without field "
+                "'qid', which the handler "
+                "(areal_tpu/system/gserver_manager.py:_allocate) reads "
+                "unconditionally — guaranteed KeyError -> 500",
+                "error",
+            ),
+            Finding(
+                "areal_tpu/gateway/demo.py", 74, "response-field-drift",
+                "GenAPIClient.metrics reads response key "
+                "'slot_capacity' from /metrics_json, which no producer "
+                "(areal_tpu/gen/server.py:_metrics) emits",
+                "error",
+            ),
+            Finding(
+                "areal_tpu/gen/demo.py", 92, "status-code-drift",
+                "_generate emits HTTP 429 for POST /generate, but no "
+                "caller branches on it or guards with raise_for_status "
+                "— it surfaces as an unhandled exception",
+                "warn",
+            ),
+            Finding(
+                "areal_tpu/system/demo.py", 130, "retry-unbounded-status",
+                "GenAPIClient.generate retries POST /generate on "
+                "transient HTTP statuses, but the endpoint is "
+                "non-idempotent — a timed-out request may still be "
+                "running server-side and a re-send double-executes it "
+                "(pass retry_connection_only=True)",
+                "error",
+            ),
         ]
         rendered = sarif.dumps(
             findings,
@@ -768,6 +807,9 @@ class TestSarif:
                 "leak-on-exception-path", "leak-on-cancellation",
                 "double-release", "release-without-acquire",
                 "charge-refund-asymmetry",
+                "unknown-endpoint", "request-field-drift",
+                "response-field-drift", "status-code-drift",
+                "retry-unbounded-status",
             ],
         ) + "\n"
         with open(self.GOLDEN, encoding="utf-8") as f:

@@ -275,3 +275,29 @@ async def test_all_breakers_open_answers_503_with_retry_after(cfg):
     assert r.status == 503
     assert int(r.headers["Retry-After"]) >= 1
     await c.close()
+
+
+async def test_report_failure_attributes_qid_in_breaker_reason(cfg):
+    """Every rollout worker sends the failing rollout's qid with
+    /report_failure; the manager must keep it in the breaker's
+    last_failure_reason so evictions in fleet state dumps are
+    attributable to a specific rollout (regression: the handler used to
+    drop the field on the floor)."""
+    m = GserverManager(cfg, server_urls=["http://a"])
+    c = await _client(m)
+    r = await c.post(
+        "/report_failure",
+        json={"url": "http://a", "reason": "connect timeout",
+              "qid": "q-42"},
+    )
+    assert r.status == 200
+    s = m.fleet.get("http://a")
+    assert "connect timeout" in s.last_failure_reason
+    assert "qid=q-42" in s.last_failure_reason
+    # reporters that predate the qid field still work
+    r = await c.post(
+        "/report_failure", json={"url": "http://a", "reason": "refused"}
+    )
+    assert r.status == 200
+    assert "qid=" not in m.fleet.get("http://a").last_failure_reason
+    await c.close()

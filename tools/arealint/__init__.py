@@ -52,6 +52,18 @@ from tools.arealint.resources import (  # noqa: F401
     ResourceSpec,
     parse_resources,
 )
+from tools.arealint.wiremodel import (  # noqa: F401
+    DEFAULT_WIRE_DEFS,
+    ClientCall,
+    Endpoint,
+    WireDefs,
+    WireModel,
+    WireSpec,
+    build_model,
+    parse_client_modules,
+    parse_server_module,
+    verify_defs,
+)
 from tools.arealint.project import Project  # noqa: F401
 from tools.arealint.callgraph import (  # noqa: F401
     CallGraph,
@@ -67,6 +79,7 @@ from tools.arealint import rules_concurrency  # noqa: E402,F401
 from tools.arealint import rules_dataflow  # noqa: E402,F401
 from tools.arealint import rules_spmd  # noqa: E402,F401
 from tools.arealint import rules_lifecycle  # noqa: E402,F401
+from tools.arealint import rules_wire  # noqa: E402,F401
 
 from tools.arealint.baseline import (  # noqa: F401
     DEFAULT_BASELINE,

@@ -148,12 +148,18 @@ class Config:
     # (tools.arealint.resources.ResourceCatalog); None disables the
     # lifecycle rule family (degrade, never guess).
     resources: Optional[object] = None
+    # HTTP/SSE wire spec (tools.arealint.wiremodel.WireSpec): the
+    # verified server/client module lists the wire-contract rules build
+    # their endpoint catalog from; None disables the wire rule family
+    # (degrade, never guess).
+    wire: Optional[object] = None
     repo_root: Optional[pathlib.Path] = None
 
     @classmethod
     def from_repo(cls, root: Optional[pathlib.Path] = None) -> "Config":
         from tools.arealint import meshmodel
         from tools.arealint import resources as resources_mod
+        from tools.arealint import wiremodel
 
         root = pathlib.Path(root) if root else default_repo_root()
         cfg = cls(repo_root=root)
@@ -167,6 +173,7 @@ class Config:
             cfg.fault_points = _fault_points(faults_py)
         cfg.mesh = meshmodel.from_repo(root)
         cfg.resources = resources_mod.from_repo(root)
+        cfg.wire = wiremodel.from_repo(root)
         return cfg
 
 
@@ -611,14 +618,19 @@ def run_project_rules(
 ) -> List[Finding]:
     out: List[Finding] = []
     for r in selected:
-        for path, lineno, message in r.check(pctx):
+        for item in r.check(pctx):
+            # (path, lineno, msg) or (path, lineno, msg, severity) — a
+            # rule family with a hard and a soft direction (wire drift)
+            # downgrades individual findings without a second rule id
+            path, lineno, message = item[0], item[1], item[2]
+            severity = item[3] if len(item) > 3 else r.severity
             posix = path.replace("\\", "/")
             if r.id in excluded_rules_for_path(posix):
                 continue
             ctx = pctx.file_ctx(posix)
             if ctx is not None and is_suppressed(ctx, r.id, lineno):
                 continue
-            out.append(Finding(posix, lineno, r.id, message, r.severity))
+            out.append(Finding(posix, lineno, r.id, message, severity))
     out.sort(key=lambda f: (f.path, f.line, f.rule))
     return out
 

@@ -1,0 +1,357 @@
+"""Wire-contract rules over the HTTP/SSE control and data plane.
+
+Every review round since PR 10 has caught wire-drift bugs by hand — a
+``/metrics_json`` key one side renamed, a status code the client never
+branched on, a payload field the handler stopped reading. This family
+machine-checks both sides of every HTTP/SSE seam against the endpoint
+catalog ``tools/arealint/wiremodel.py`` parses (with ``ast``, never
+imports) from the three route-registering server modules and the
+declared client modules:
+
+- ``unknown-endpoint`` — a client posts a literal path (or path+method
+  pair) no server module registers.
+- ``request-field-drift`` — a handler unconditionally subscripts a body
+  field some resolved call site never sends (**error**: a guaranteed
+  ``KeyError`` → 500); a client sends a field no handler for the
+  endpoint reads (**warn**: dead payload, usually a rename half done).
+- ``response-field-drift`` — a client reads a response-body or SSE
+  frame key no producer of that endpoint emits.
+- ``status-code-drift`` — a client branches on an HTTP status no
+  handler of the endpoint can produce (**error**: dead error handling);
+  a handler emits an explicit status none of the endpoint's callers
+  handle (**warn**: the status surfaces as an unhandled exception).
+- ``retry-unbounded-status`` — a status-retrying wrapper re-POSTs an
+  endpoint the catalog marks non-idempotent: a timed-out ``/generate``
+  may still be running server-side, so re-sending double-bills it.
+
+Degradation contract (v2/v3/v4): dynamic paths, computed field names,
+unresolvable payload dicts, and ``**splat`` response bodies all degrade
+to no-finding. Under ``--changed-only`` the catalog may be partial:
+rules that need the full server surface require every declared server
+module in the scanned set (``servers_present``); the caller-coverage
+warn additionally requires every client module (``clients_present``).
+
+Deliberate one-sided fields (forward-compat keys, fields kept for
+external dashboards) are annotated at the finding site::
+
+    body["schema_rev"] = 2  # arealint: wire(/generate, fwd-compat key)
+
+The annotation names the ENDPOINT (so a refactor that repoints the call
+invalidates it) and requires a reason, same as ``# arealint: ok``. A
+malformed or wrong-endpoint ``wire()`` does not suppress — the finding
+message says so.
+"""
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from tools.arealint.core import (
+    ProjectContext, SEVERITY_ERROR, SEVERITY_WARN, project_rule,
+)
+from tools.arealint import wiremodel
+from tools.arealint.wiremodel import (
+    ClientCall, Endpoint, IMPLICIT_STATUSES, WireModel, wire_annotation,
+)
+
+RULE_UNKNOWN = "unknown-endpoint"
+RULE_REQ_DRIFT = "request-field-drift"
+RULE_RESP_DRIFT = "response-field-drift"
+RULE_STATUS_DRIFT = "status-code-drift"
+RULE_RETRY = "retry-unbounded-status"
+
+_MALFORMED_NOTE = (
+    " (a malformed `# arealint: wire(<endpoint>, <reason>)` does not"
+    " suppress — it must name this endpoint and give a reason)"
+)
+
+FindingTuple = Tuple[str, int, str, str]
+
+
+def _model(ctx: ProjectContext) -> Optional[WireModel]:
+    """Build (once per scan) the wire model from the scanned subset of
+    the spec's declared modules. None disables the family.
+
+    ``project.by_path`` keys the paths exactly as the CLI passed them
+    (absolute or cwd-relative), so declared repo-relative module paths
+    are matched by suffix; the model keeps the canonical relative path
+    and ``_report_path`` maps it back to the indexed key so the driver's
+    suppression / file-context machinery finds the file."""
+    spec = getattr(ctx.config, "wire", None)
+    if spec is None:
+        return None
+    cached = getattr(ctx, "_wire_model", None)
+    if cached is not None:
+        return cached
+    declared = set(spec.servers) | set(spec.clients)
+    modules: Dict[str, tuple] = {}
+    paths: Dict[str, str] = {}
+    for posix, mod in ctx.project.by_path.items():
+        for rel in declared:
+            if posix == rel or posix.endswith("/" + rel):
+                modules[rel] = (mod.tree, mod.src)
+                paths[rel] = posix
+    model = wiremodel.build_model(spec, modules)
+    ctx._wire_model = model
+    ctx._wire_paths = paths
+    return model
+
+
+def _report_path(ctx: ProjectContext, rel: str) -> str:
+    return getattr(ctx, "_wire_paths", {}).get(rel, rel)
+
+
+def _wire_suppressed(
+    ctx: ProjectContext, path: str, lineno: int, endpoint: str
+) -> Tuple[bool, str]:
+    """(suppressed, message_suffix) for a candidate finding. A valid
+    annotation naming this endpoint suppresses; a malformed one or one
+    naming another endpoint fires the finding with a note."""
+    mod = ctx.project.by_path.get(_report_path(ctx, path))
+    if mod is None:
+        return False, ""
+    ann = wire_annotation(mod.src.splitlines(), lineno)
+    if ann is None:
+        return False, ""
+    ep, _reason = ann
+    if ep == endpoint:
+        return True, ""
+    return False, _MALFORMED_NOTE
+
+
+def _endpoint_names(eps: Sequence[Endpoint]) -> str:
+    return ", ".join(f"{ep.module}:{ep.handler}" for ep in eps)
+
+
+@project_rule(
+    RULE_UNKNOWN,
+    SEVERITY_ERROR,
+    "client calls a literal path/method no server module registers",
+)
+def check_unknown_endpoint(
+    ctx: ProjectContext,
+) -> Iterator[FindingTuple]:
+    model = _model(ctx)
+    if model is None or not model.servers_present:
+        return
+    for c in model.calls:
+        if model.lookup(c.method, c.path):
+            continue
+        if model.path_known(c.path):
+            methods = sorted(
+                m for (m, p) in model.endpoints if p == c.path
+            )
+            msg = (
+                f"{c.via} sends {c.method} {c.path}, but the servers "
+                f"register that path only for {'/'.join(methods)} — "
+                "method drift"
+            )
+        else:
+            msg = (
+                f"{c.via} calls {c.method} {c.path}, which no server "
+                "module registers — the request can only 404"
+            )
+        ok, note = _wire_suppressed(ctx, c.module, c.lineno, c.path)
+        if ok:
+            continue
+        yield (_report_path(ctx, c.module), c.lineno, msg + note, SEVERITY_ERROR)
+
+
+@project_rule(
+    RULE_REQ_DRIFT,
+    SEVERITY_ERROR,
+    "request body fields drift between a handler and its call sites",
+)
+def check_request_field_drift(
+    ctx: ProjectContext,
+) -> Iterator[FindingTuple]:
+    model = _model(ctx)
+    if model is None or not model.servers_present:
+        return
+    for c in model.calls:
+        eps = model.lookup(c.method, c.path)
+        if not eps or c.payload is None:
+            continue  # unknown endpoint / unresolvable payload: degrade
+        # error: a field EVERY handler of this (method, path) reads by
+        # subscript is missing from this resolved payload -> KeyError
+        required = set(eps[0].required)
+        for ep in eps[1:]:
+            required &= set(ep.required)
+        for k in sorted(required):
+            if k in c.payload:
+                continue
+            ok, note = _wire_suppressed(ctx, c.module, c.lineno, c.path)
+            if ok:
+                continue
+            yield (
+                _report_path(ctx, c.module),
+                c.lineno,
+                f"{c.via} posts {c.path} without field '{k}', which "
+                f"the handler ({_endpoint_names(eps)}) reads "
+                "unconditionally — guaranteed KeyError -> 500" + note,
+                SEVERITY_ERROR,
+            )
+        # warn: a sent field NO handler reads (skipped entirely when any
+        # handler's body escapes resolution: fields_open)
+        if any(ep.fields_open for ep in eps):
+            continue
+        for k, ln in sorted(c.payload.items()):
+            if any(
+                k in ep.required or k in ep.optional for ep in eps
+            ):
+                continue
+            ok, note = _wire_suppressed(ctx, c.module, ln, c.path)
+            if ok:
+                continue
+            yield (
+                _report_path(ctx, c.module),
+                ln,
+                f"{c.via} sends field '{k}' to {c.path}, but no "
+                f"handler ({_endpoint_names(eps)}) reads it — dead "
+                "payload, likely a half-done rename" + note,
+                SEVERITY_WARN,
+            )
+
+
+@project_rule(
+    RULE_RESP_DRIFT,
+    SEVERITY_ERROR,
+    "client reads a response/SSE key no producer of the endpoint emits",
+)
+def check_response_field_drift(
+    ctx: ProjectContext,
+) -> Iterator[FindingTuple]:
+    model = _model(ctx)
+    if model is None or not model.servers_present:
+        return
+    for c in model.calls:
+        eps = model.lookup(c.method, c.path)
+        if not eps:
+            continue
+        # response-body reads: provable only when every producer's key
+        # set resolved closed
+        if not any(ep.response.open for ep in eps):
+            for k, ln in sorted(c.reads.items()):
+                if any(ep.response.covers(k) for ep in eps):
+                    continue
+                ok, note = _wire_suppressed(ctx, c.module, ln, c.path)
+                if ok:
+                    continue
+                yield (
+                    _report_path(ctx, c.module),
+                    ln,
+                    f"{c.via} reads response key '{k}' from {c.path}, "
+                    f"which no producer ({_endpoint_names(eps)}) emits"
+                    + note,
+                    SEVERITY_ERROR,
+                )
+        # SSE frame reads: compare against the streaming producers only
+        frames = [ep.sse for ep in eps if ep.sse is not None]
+        if not c.sse_reads or not frames or any(f.open for f in frames):
+            continue
+        for k, ln in sorted(c.sse_reads.items()):
+            if any(f.covers(k) for f in frames):
+                continue
+            ok, note = _wire_suppressed(ctx, c.module, ln, c.path)
+            if ok:
+                continue
+            yield (
+                _report_path(ctx, c.module),
+                ln,
+                f"{c.via} reads SSE frame key '{k}' from {c.path}, "
+                f"which no frame producer ({_endpoint_names(eps)}) "
+                "writes" + note,
+                SEVERITY_ERROR,
+            )
+
+
+@project_rule(
+    RULE_STATUS_DRIFT,
+    SEVERITY_ERROR,
+    "HTTP status handling drifts between a handler and its callers",
+)
+def check_status_code_drift(
+    ctx: ProjectContext,
+) -> Iterator[FindingTuple]:
+    model = _model(ctx)
+    if model is None or not model.servers_present:
+        return
+    # error: a client branches on a status no handler can produce
+    for c in model.calls:
+        eps = model.lookup(c.method, c.path)
+        if not eps:
+            continue
+        for s, ln in sorted(c.status_branches.items()):
+            if any(ep.emits(s) for ep in eps):
+                continue
+            ok, note = _wire_suppressed(ctx, c.module, ln, c.path)
+            if ok:
+                continue
+            yield (
+                _report_path(ctx, c.module),
+                ln,
+                f"{c.via} branches on HTTP {s} from {c.method} "
+                f"{c.path}, but no handler "
+                f"({_endpoint_names(eps)}) can emit it — dead error "
+                "handling" + note,
+                SEVERITY_ERROR,
+            )
+    # warn: a handler emits an explicit status NO caller of the endpoint
+    # handles (needs the complete caller set to be provable)
+    if not model.clients_present:
+        return
+    for (method, path), eps in sorted(model.endpoints.items()):
+        callers = model.calls_to(method, path)
+        if not callers:
+            continue  # external-facing endpoint: nothing to compare
+        for ep in eps:
+            for s, ln in sorted(ep.statuses.items()):
+                if s in IMPLICIT_STATUSES:
+                    continue
+                if any(
+                    s in c.status_branches
+                    or c.generic_status_guard
+                    or c.retries_status
+                    for c in callers
+                ):
+                    continue
+                ok, note = _wire_suppressed(ctx, ep.module, ln, path)
+                if ok:
+                    continue
+                yield (
+                    _report_path(ctx, ep.module),
+                    ln,
+                    f"{ep.handler} emits HTTP {s} for {method} {path}, "
+                    "but no caller branches on it or guards with "
+                    "raise_for_status — it surfaces as an unhandled "
+                    "exception" + note,
+                    SEVERITY_WARN,
+                )
+
+
+@project_rule(
+    RULE_RETRY,
+    SEVERITY_ERROR,
+    "status-retrying wrapper re-sends a non-idempotent endpoint",
+)
+def check_retry_unbounded_status(
+    ctx: ProjectContext,
+) -> Iterator[FindingTuple]:
+    # Needs only the verified spec (non_idempotent is pinned against the
+    # full repo at config load), so it stays live under --changed-only.
+    model = _model(ctx)
+    if model is None:
+        return
+    for c in model.calls:
+        if not c.retries_status or c.path not in model.spec.non_idempotent:
+            continue
+        ok, note = _wire_suppressed(ctx, c.module, c.lineno, c.path)
+        if ok:
+            continue
+        yield (
+            _report_path(ctx, c.module),
+            c.lineno,
+            f"{c.via} retries {c.method} {c.path} on transient HTTP "
+            "statuses, but the endpoint is non-idempotent — a timed-out "
+            "request may still be running server-side and a re-send "
+            "double-executes it (pass retry_connection_only=True)" + note,
+            SEVERITY_ERROR,
+        )
