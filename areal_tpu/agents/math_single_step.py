@@ -27,6 +27,7 @@ from areal_tpu.api.agent import (
 from areal_tpu.api.data import SequenceSample
 from areal_tpu.api.env import EnvironmentService
 from areal_tpu.api.model import GenerationHyperparameters
+from areal_tpu.base import tracing
 
 
 @dataclasses.dataclass
@@ -81,7 +82,10 @@ class MathSingleStepAgent(Agent):
             # generation failed entirely (e.g. fleet unreachable): drop
             return []
         answers = self._decode(act.output_ids)
-        _, success, *_ = await env.step((qid, answers))
+        # the reward hop joins the trajectory's trace (this coroutine runs
+        # inside the rollout task's activated context)
+        with tracing.span("rollout/reward", qid=str(qid)):
+            _, success, *_ = await env.step((qid, answers))
         reward_time = time.time()  # lifecycle stamp: reward computed
         rewards = [
             ((float(s) - 0.5) * 2 - self.reward_bias) * self.reward_scaling

@@ -10,7 +10,7 @@ jsonl record is built from.
 Usage::
 
     python -m areal_tpu.apps.obs <fileroot> [--experiment E --trial T]
-        [--once] [--interval 2.0] [--json]
+        [--once] [--interval 2.0] [--json] [--trace <request-id|qid>]
 
 ``<fileroot>`` is the experiment fileroot (the launcher's ``fileroot``
 config); the file-backed name_resolve lives under ``<fileroot>/
@@ -18,6 +18,12 @@ name_resolve``. Without ``--experiment/--trial`` the trial with the newest
 snapshot is picked. ``--once`` renders a single frame (scripts/tests);
 the default loops until Ctrl-C. Workers only publish when
 ``AREAL_TELEMETRY_EXPORT`` is enabled on the trial.
+
+``--trace`` switches to the distributed-tracing view
+(docs/observability.md "Distributed tracing"): it joins the per-worker
+span flushes under ``<fileroot>/trace_spans/`` and renders one request's
+span tree. The needle may be a trace id (or ≥8-char prefix), a gateway
+request id (``gw-<16hex>``), or an RL ``qid``.
 """
 
 import argparse
@@ -28,7 +34,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from areal_tpu.base import name_resolve, names
-from areal_tpu.system import telemetry
+from areal_tpu.system import telemetry, tracejoin
 
 
 def _configure_name_resolve(fileroot: str):
@@ -186,6 +192,16 @@ def render_frame(experiment: str, trial: str, as_json: bool) -> Optional[str]:
     return header + "\n" + render(agg)
 
 
+def render_trace(fileroot: str, needle: str) -> Optional[str]:
+    """The ``--trace`` view: resolve the needle against the flushed spans
+    and render the request's span tree (None when nothing matches)."""
+    spans = tracejoin.scan(fileroot)
+    trace_id = tracejoin.resolve_trace_id(spans, needle)
+    if trace_id is None:
+        return None
+    return tracejoin.render_tree(spans, trace_id)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="areal_tpu.apps.obs", description=__doc__,
@@ -198,7 +214,23 @@ def main(argv=None) -> int:
     p.add_argument("--interval", type=float, default=2.0)
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit the flat fleet/ scalar dict as JSON")
+    p.add_argument("--trace", default=None, metavar="ID",
+                   help="render one request's span tree: trace id (or "
+                        "prefix), gateway rid, or RL qid")
     args = p.parse_args(argv)
+
+    if args.trace is not None:
+        tree = render_trace(args.fileroot, args.trace)
+        if tree is None:
+            print(
+                f"no trace matches {args.trace!r} under "
+                f"{args.fileroot}/trace_spans — are span flushes enabled "
+                "(AREAL_TRACE_SPANS) and has a flush interval elapsed?",
+                file=sys.stderr,
+            )
+            return 1
+        print(tree)
+        return 0
 
     _configure_name_resolve(args.fileroot)
     experiment, trial = args.experiment, args.trial

@@ -34,6 +34,12 @@ WATCHDOG_ABORT_ENV = "AREAL_WATCHDOG_ABORT"   # dump AND exit so the scheduler r
 # Fleet telemetry plane (docs/observability.md): per-worker counter/
 # histogram snapshot export interval.
 TELEMETRY_EXPORT_ENV = "AREAL_TELEMETRY_EXPORT"
+# Distributed request tracing + crash flight recorder
+# (docs/observability.md "Distributed tracing").
+TRACE_SPANS_ENV = "AREAL_TRACE_SPANS"        # span ring + trace-id propagation
+TRACE_RING_ENV = "AREAL_TRACE_RING"          # completed-span ring capacity
+TRACE_FLUSH_ENV = "AREAL_TRACE_FLUSH_S"      # dedicated span-flush period
+TRACE_LOG_TAIL_ENV = "AREAL_TRACE_LOG_TAIL"  # flight-recorder log-tail lines
 # Speculative decoding (docs/performance.md "Speculative decoding").
 SPEC_DECODE_ENV = "AREAL_SPEC_DECODE"   # draft-and-verify decode chunks
 SPEC_K_ENV = "AREAL_SPEC_K"             # draft tokens per slot per spec step
@@ -377,6 +383,44 @@ def telemetry_export_interval() -> float:
     return max(val, 0.0)
 
 
+DEFAULT_TRACE_RING = 4096
+
+
+def trace_spans_enabled() -> bool:
+    """``AREAL_TRACE_SPANS`` (default on): stamp every ``tracing.span``
+    with W3C-style trace/span IDs, record its completion into the bounded
+    per-process ring, and propagate trace context over the HTTP/SSE plane
+    (docs/observability.md "Distributed tracing"). "0"/"off" reverts
+    spans to bare counter accumulation — the bench ``tracing`` section
+    proves that disabled path is free (``vs_baseline ≈ 1.0``)."""
+    return env_flag(TRACE_SPANS_ENV, True)
+
+
+def trace_ring_size() -> int:
+    """``AREAL_TRACE_RING`` (default 4096): capacity of the per-process
+    completed-span ring. The oldest spans are overwritten (counted in
+    ``trace/dropped``); both the fileroot span flusher and the flight
+    recorder read this ring. Floored at 16 so a typo'd "0" cannot turn
+    the flight recorder's span evidence off silently."""
+    return max(16, env_int(TRACE_RING_ENV, DEFAULT_TRACE_RING))
+
+
+def trace_flush_interval() -> float:
+    """``AREAL_TRACE_FLUSH_S`` (default 0 = ride the telemetry exporter):
+    period of a dedicated span-flush thread draining the completed-span
+    ring to ``<fileroot>/trace_spans/<worker>.jsonl``. At the default 0
+    there is no dedicated thread — the ring is flushed on every telemetry
+    snapshot publish and once on worker stop."""
+    return max(0.0, env_float(TRACE_FLUSH_ENV, 0.0))
+
+
+def trace_log_tail() -> int:
+    """``AREAL_TRACE_LOG_TAIL`` (default 200): number of recent log lines
+    the flight recorder retains in memory for its crash dump (0 disables
+    the log-tail handler)."""
+    return max(0, env_int(TRACE_LOG_TAIL_ENV, 200))
+
+
 def watchdog_abort_enabled() -> bool:
     """``AREAL_WATCHDOG_ABORT``: a stale heartbeat dumps stacks AND exits
     (os._exit) so the scheduler restarts the world."""
@@ -532,6 +576,26 @@ def get_recover_root() -> str:
     return p
 
 
+def get_trace_span_root() -> str:
+    """Directory the per-worker span flushers append their jsonl rings
+    under — ``system/tracejoin.py`` merges every file here into one
+    Chrome-``trace_event`` timeline (docs/observability.md "Distributed
+    tracing"). Keyed by fileroot only (not experiment/trial): the span
+    records carry their own worker identity, and the obs CLI points at a
+    fileroot the same way."""
+    p = os.path.join(get_fileroot(), "trace_spans")
+    os.makedirs(p, exist_ok=True)
+    return p
+
+
+def get_flight_root() -> str:
+    """Directory flight-recorder crash dumps land in (one JSON per dump;
+    docs/fault_tolerance.md "Flight recorder")."""
+    p = os.path.join(get_fileroot(), "flight")
+    os.makedirs(p, exist_ok=True)
+    return p
+
+
 def get_env_vars(**extra) -> dict:
     """Env vars to forward to spawned workers."""
     keys = [
@@ -569,6 +633,10 @@ def get_env_vars(**extra) -> dict:
         WATCHDOG_TIMEOUT_ENV,
         WATCHDOG_ABORT_ENV,
         TELEMETRY_EXPORT_ENV,
+        TRACE_SPANS_ENV,
+        TRACE_RING_ENV,
+        TRACE_FLUSH_ENV,
+        TRACE_LOG_TAIL_ENV,
         ELASTIC_ENV,
         COLLECTIVE_TIMEOUT_ENV,
         ELASTIC_LEASE_INTERVAL_ENV,

@@ -487,6 +487,23 @@ GW_STREAM_RESUMES = "gw/stream_resumes"   # streams resumed after backend death
 GW_BROWNOUT_LEVEL = "gw/brownout_level"   # gauge: current degradation level
 GW_BROWNOUT_TRANSITIONS = "gw/brownout_transitions"  # ladder level changes
 
+# --------------------------------------------------------------------- #
+# Distributed tracing namespace (``trace/``, docs/observability.md
+# "Distributed tracing"): the span ring / flush plane plus flight-
+# recorder dumps. ``trace/span_s`` is a histogram over every recorded
+# span's duration (one distribution across names — per-name wall time
+# already rides the ``<name>_s`` sums ``tracing.span`` has always kept).
+# --------------------------------------------------------------------- #
+
+TRACE_SPANS = "trace/spans"                 # spans recorded into the ring
+TRACE_SPAN_ERRORS = "trace/span_errors"     # spans that exited via exception
+TRACE_DROPPED = "trace/dropped"             # ring overwrote an unflushed span
+TRACE_FLUSHES = "trace/flushes"             # ring drains to the fileroot
+TRACE_FLUSHED_SPANS = "trace/flushed_spans" # spans written by those drains
+TRACE_FLIGHT_DUMPS = "trace/flight_dumps"   # flight-recorder dumps written
+TRACE_SPAN_S = "trace/span_s"               # histogram: recorded span durations
+
+
 # Fraction edges for the pool-occupancy histogram: occupancy lives in
 # [0, 1] and the log-spaced duration edges would put the whole range into
 # two buckets; 0.9+ gets finer edges because that is where admission
@@ -531,6 +548,7 @@ METRIC_KINDS: Dict[str, str] = {
     GW_QUEUE_WAIT_S: KIND_HISTOGRAM,
     GW_TTFT_S: KIND_HISTOGRAM,
     GW_BROWNOUT_LEVEL: KIND_GAUGE,
+    TRACE_SPAN_S: KIND_HISTOGRAM,
 }
 
 # Non-default bucket edges per histogram key (default: the log-spaced

@@ -31,7 +31,7 @@ from typing import Dict, List, Optional, Tuple
 
 from aiohttp import web
 
-from areal_tpu.base import logging
+from areal_tpu.base import logging, tracing
 from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.gateway.scheduler import (
     ContinuousBatchScheduler,
@@ -314,6 +314,17 @@ class GatewayServer:
             )
 
     async def _completions(self, request: web.Request) -> web.StreamResponse:
+        # trace intake: honor an inbound W3C ``traceparent`` header (an
+        # external caller continuing its own trace), else root a fresh
+        # trace here — the gateway is the serving plane's trace origin
+        with tracing.activate(
+            request.headers.get("traceparent")
+        ), tracing.span("gw/request", endpoint="/v1/completions") as sa:
+            return await self._completions_body(request, sa)
+
+    async def _completions_body(
+        self, request: web.Request, span_attrs: Dict
+    ) -> web.StreamResponse:
         try:
             tenant = self._tenant_of(request)
             d = await self._json_body(request)
@@ -335,6 +346,8 @@ class GatewayServer:
                 tenant, input_ids, sp,
                 deadline_s=parse_deadline(d, request),
             )
+            span_attrs["rid"] = req.rid
+            span_attrs["tenant"] = tenant
             self.scheduler.submit(req)
         except BadRequest as e:
             return _error_response(str(e), e.status, e.code)
@@ -359,6 +372,14 @@ class GatewayServer:
 
     async def _chat_completions(
         self, request: web.Request
+    ) -> web.StreamResponse:
+        with tracing.activate(
+            request.headers.get("traceparent")
+        ), tracing.span("gw/request", endpoint="/v1/chat/completions") as sa:
+            return await self._chat_completions_body(request, sa)
+
+    async def _chat_completions_body(
+        self, request: web.Request, span_attrs: Dict
     ) -> web.StreamResponse:
         try:
             tenant = self._tenant_of(request)
@@ -386,6 +407,8 @@ class GatewayServer:
                 tenant, input_ids, sp,
                 deadline_s=parse_deadline(d, request),
             )
+            span_attrs["rid"] = req.rid
+            span_attrs["tenant"] = tenant
             self.scheduler.submit(req)
         except BadRequest as e:
             return _error_response(str(e), e.status, e.code)

@@ -34,7 +34,7 @@ from typing import AsyncIterator, Dict, List, Optional
 
 import aiohttp
 
-from areal_tpu.base import constants, faults, logging
+from areal_tpu.base import constants, faults, logging, tracing
 from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.gateway.qos import (
     TenantSpec,
@@ -68,6 +68,11 @@ class GatewayRequest:
     # all compare against ``deadline_t``
     deadline_s: float = 0.0
     deadline_t: float = math.inf
+    # trace propagation: the wire context captured in the API handler's
+    # task (``tracing.wire_context()``). The dispatch loop runs requests
+    # in tasks it spawns itself, so the handler's contextvars never reach
+    # ``_run_request`` — the context must ride the request object.
+    trace: Optional[Dict] = None
 
     @classmethod
     def build(
@@ -87,6 +92,7 @@ class GatewayRequest:
             ),
             enqueue_t=time.monotonic(),
             deadline_s=max(float(deadline_s), 0.0),
+            trace=tracing.wire_context(),
         )
 
 
@@ -579,6 +585,19 @@ class ContinuousBatchScheduler:
         self._wake.set()
 
     async def _run_request(self, req: GatewayRequest, srv: ServerState):
+        # re-activate the wire context the API handler captured onto the
+        # request (this task belongs to the dispatch loop, not the
+        # handler), so the GenAPIClient hops downstream re-propagate it
+        with tracing.activate(req.trace), tracing.span(
+            "gw/dispatch", rid=req.rid, tenant=req.tenant
+        ) as span_attrs:
+            try:
+                await self._stream_request(req, srv)
+            finally:
+                span_attrs["finish"] = req.finish_reason
+                span_attrs["tokens"] = req.n_generated
+
+    async def _stream_request(self, req: GatewayRequest, srv: ServerState):
         wait_s = self._clock() - req.enqueue_t
         metrics_mod.counters.add(metrics_mod.GW_ADMITTED)
         metrics_mod.counters.observe(metrics_mod.GW_QUEUE_WAIT_S, wait_s)

@@ -27,7 +27,7 @@ from typing import Dict, List, Optional
 
 import aiohttp
 
-from areal_tpu.base import faults
+from areal_tpu.base import faults, tracing
 from areal_tpu.base import metrics as metrics_mod
 
 
@@ -181,18 +181,26 @@ class GenAPIClient:
         input_ids: List[int],
         sampling_params: Dict,
     ) -> APIGenerateResult:
-        d = await self._request_json(
-            "POST",
-            server_url,
-            "/generate",
-            op="generate",
-            json_body={
+        with tracing.span("gen_client/generate", rid=rid):
+            body = {
                 "rid": rid,
                 "input_ids": input_ids,
                 "sampling_params": sampling_params,
-            },
-            retry_connection_only=True,
-        )
+            }
+            trace = tracing.wire_context()
+            if trace is not None:
+                # the hop's trace context (docs/observability.md
+                # "Distributed tracing") — the server activates it so its
+                # spans join this one as children
+                body["trace"] = trace
+            d = await self._request_json(
+                "POST",
+                server_url,
+                "/generate",
+                op="generate",
+                json_body=body,
+                retry_connection_only=True,
+            )
         return APIGenerateResult(
             rid=d["rid"],
             output_ids=d["output_ids"],
@@ -231,6 +239,9 @@ class GenAPIClient:
             "input_ids": input_ids,
             "sampling_params": sampling_params,
         }
+        trace = tracing.wire_context()
+        if trace is not None:
+            body["trace"] = trace
         t_deadline = None
         if deadline_s is not None and deadline_s > 0:
             body["deadline_s"] = float(deadline_s)

@@ -56,7 +56,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from areal_tpu.base import constants
+from areal_tpu.base import constants, tracing
 from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.gen.drafter import Drafter, NGramDrafter, TransformerDrafter
 from areal_tpu.gen.pages import OutOfPagesError, PagePool, PrefixRegistry
@@ -551,15 +551,21 @@ class GenerationEngine:
     # ------------------------------------------------------------------ #
 
     def submit(self, req: GenRequest):
-        need = len(req.input_ids) - 1 + min(req.max_new_tokens, self.G)
-        if need > self.S:
-            raise ValueError(
-                f"prompt {len(req.input_ids)} + max_new "
-                f"{req.max_new_tokens} exceeds per-slot capacity {self.S}"
-            )
-        with self._pending_lock:
-            self._pending.append(req)
-            self._req_meta[req.rid] = req
+        # runs on the server's asyncio thread, so the span inherits the
+        # request's activated trace context — the engine-layer hop of the
+        # distributed trace (chunk spans are batch-level and root their own)
+        with tracing.span(
+            "gen_engine/submit", rid=req.rid, prompt_len=len(req.input_ids)
+        ):
+            need = len(req.input_ids) - 1 + min(req.max_new_tokens, self.G)
+            if need > self.S:
+                raise ValueError(
+                    f"prompt {len(req.input_ids)} + max_new "
+                    f"{req.max_new_tokens} exceeds per-slot capacity {self.S}"
+                )
+            with self._pending_lock:
+                self._pending.append(req)
+                self._req_meta[req.rid] = req
 
     def free_slots(self) -> int:
         return sum(s is None for s in self._slots)
@@ -1797,46 +1803,57 @@ class GenerationEngine:
         with self._lock:
             if self.paused:
                 return []
-            if self._pipeline:
-                return self._step_pipelined(decode_steps)
-            self._admit_pending()
-            if self.n_running() == 0:
-                return []
-            # width-limit the chunk to the pages this chunk can touch
-            running = [b for b, s in enumerate(self._slots) if s is not None]
-            make, tok_bound, wb, warp_idx = self._decode_chunk_fn(
-                decode_steps, running
-            )
-            W = self._table_width(
-                int(self._lens_host[running].max()) + tok_bound
-            )
-            self._observe_occupancy()
-            chunk = make(decode_steps, W, wb)
-            # one host sync per chunk; the flag copy was enqueued at
-            # dispatch, so the resolve costs no extra round trip
-            flags = self._resolve_flags(
-                self._dispatch_chunk(chunk, W, warp_idx)
-            )
-            active, n_gen, max_gen, lens = flags[:4]
-            if len(flags) > 4:
-                self._fold_spec_stats(flags[4:])
-            self._lens_host[:] = lens
-            finished = [
-                b for b, info in enumerate(self._slots)
-                if info is not None and not active[b]
-            ]
-            if not finished:
-                return []
-            # one more pull serves EVERY finished slot's outputs; the chunk
-            # already deactivated them on device, so no scatter back
-            host_state = self._pull_outputs()
-            outs = []
-            for b in finished:
-                outs.append(self._harvest(
-                    b, _finish_reason(n_gen[b], max_gen[b]),
-                    host_state=host_state,
-                ))
-            return outs
+            # batch-level chunk span: runs on the executor thread, so it
+            # roots its own trace (per-request attribution joins at
+            # submit/harvest); attrs carry the chunk's slot census
+            with tracing.span(
+                "gen_engine/chunk", steps=decode_steps
+            ) as span_attrs:
+                if self._pipeline:
+                    return self._step_pipelined(decode_steps)
+                self._admit_pending()
+                if self.n_running() == 0:
+                    return []
+                # width-limit the chunk to the pages this chunk can touch
+                running = [
+                    b for b, s in enumerate(self._slots) if s is not None
+                ]
+                span_attrs["slots"] = len(running)
+                make, tok_bound, wb, warp_idx = self._decode_chunk_fn(
+                    decode_steps, running
+                )
+                W = self._table_width(
+                    int(self._lens_host[running].max()) + tok_bound
+                )
+                self._observe_occupancy()
+                chunk = make(decode_steps, W, wb)
+                # one host sync per chunk; the flag copy was enqueued at
+                # dispatch, so the resolve costs no extra round trip
+                flags = self._resolve_flags(
+                    self._dispatch_chunk(chunk, W, warp_idx)
+                )
+                active, n_gen, max_gen, lens = flags[:4]
+                if len(flags) > 4:
+                    self._fold_spec_stats(flags[4:])
+                self._lens_host[:] = lens
+                finished = [
+                    b for b, info in enumerate(self._slots)
+                    if info is not None and not active[b]
+                ]
+                span_attrs["finished"] = len(finished)
+                if not finished:
+                    return []
+                # one more pull serves EVERY finished slot's outputs; the
+                # chunk already deactivated them on device, so no scatter
+                # back
+                host_state = self._pull_outputs()
+                outs = []
+                for b in finished:
+                    outs.append(self._harvest(
+                        b, _finish_reason(n_gen[b], max_gen[b]),
+                        host_state=host_state,
+                    ))
+                return outs
 
     def _step_pipelined(self, decode_steps: int) -> List[GenOutput]:
         self._admit_pending()

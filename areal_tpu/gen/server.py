@@ -35,7 +35,7 @@ from typing import Dict, Optional
 
 from aiohttp import web
 
-from areal_tpu.base import constants, faults, hbm
+from areal_tpu.base import constants, faults, hbm, tracing
 from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.gen.engine import GenerationEngine, GenOutput, GenRequest
 
@@ -317,31 +317,39 @@ class GenerationHTTPServer:
 
     async def _generate(self, request: web.Request) -> web.Response:
         try:
-            req, _ = await self._parse_request(request)
+            req, raw = await self._parse_request(request)
         except RequestValidationError as e:
             return web.json_response({"error": str(e)}, status=400)
-        fut = asyncio.get_event_loop().create_future()
-        self._futures[req.rid] = fut
-        try:
-            # arealint: owns(gen.engine-slot, the engine loop harvests and releases the slot at finish; /generate serves RL rollout clients whose disconnects don't cancel by design — the sample is still wanted)
-            self.engine.submit(req)
-        except ValueError as e:
-            self._futures.pop(req.rid, None)
-            return web.json_response({"error": str(e)}, status=400)
-        out: GenOutput = await fut
-        # telemetry-plane activity counters (exported per worker; the
-        # /metrics_json gauges below remain the pull-path view)
-        metrics_mod.counters.add(metrics_mod.GEN_SERVED)
-        metrics_mod.counters.add(metrics_mod.GEN_TOKENS, len(out.output_ids))
-        return web.json_response(
-            {
-                "rid": out.rid,
-                "output_ids": out.output_ids,
-                "output_logprobs": out.output_logprobs,
-                "finish_reason": out.finish_reason,
-                "version": out.version,
-            }
-        )
+        # join the caller's distributed trace (or root a fresh one) — the
+        # optional 'trace' body field is the wire context every internal
+        # client attaches (docs/observability.md "Distributed tracing")
+        with tracing.activate(raw.get("trace")), tracing.span(
+            "gen_server/generate", rid=req.rid
+        ):
+            fut = asyncio.get_event_loop().create_future()
+            self._futures[req.rid] = fut
+            try:
+                # arealint: owns(gen.engine-slot, the engine loop harvests and releases the slot at finish; /generate serves RL rollout clients whose disconnects don't cancel by design — the sample is still wanted)
+                self.engine.submit(req)
+            except ValueError as e:
+                self._futures.pop(req.rid, None)
+                return web.json_response({"error": str(e)}, status=400)
+            out: GenOutput = await fut
+            # telemetry-plane activity counters (exported per worker; the
+            # /metrics_json gauges below remain the pull-path view)
+            metrics_mod.counters.add(metrics_mod.GEN_SERVED)
+            metrics_mod.counters.add(
+                metrics_mod.GEN_TOKENS, len(out.output_ids)
+            )
+            return web.json_response(
+                {
+                    "rid": out.rid,
+                    "output_ids": out.output_ids,
+                    "output_logprobs": out.output_logprobs,
+                    "finish_reason": out.finish_reason,
+                    "version": out.version,
+                }
+            )
 
     async def _generate_stream(self, request: web.Request) -> web.StreamResponse:
         """SSE variant of /generate: per-chunk token deltas as they are
@@ -368,87 +376,106 @@ class GenerationHTTPServer:
             )
         if deadline_s > 0:
             deadline_t = time.monotonic() + deadline_s
-        loop = asyncio.get_event_loop()
-        q: asyncio.Queue = asyncio.Queue()
-        self._stream_subs[req.rid] = q
-        self._stream_sent[req.rid] = 0
-        try:
-            # arealint: owns(gen.engine-slot, released by the engine's own harvest when 'finished', by the finally's _cancel_rid on disconnect/cancellation otherwise — the conditional is the protocol, not a gap)
-            self.engine.submit(req)
-        except ValueError as e:
-            self._stream_subs.pop(req.rid, None)
-            self._stream_sent.pop(req.rid, None)
-            return web.json_response({"error": str(e)}, status=400)
-        resp = web.StreamResponse(
-            headers={
-                "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache",
-            }
-        )
-        finished = False
-        n_tokens = 0
-        try:
-            await resp.prepare(request)
+        # join the caller's distributed trace for the whole stream; the
+        # riding RL qid (if any) lands in span attrs + disconnect logs so
+        # the breaker's last_failure_reason joins against trace ids
+        with tracing.activate(raw.get("trace")), tracing.span(
+            "gen_server/generate_stream", rid=req.rid
+        ) as span_attrs:
+            loop = asyncio.get_event_loop()
+            q: asyncio.Queue = asyncio.Queue()
+            self._stream_subs[req.rid] = q
+            self._stream_sent[req.rid] = 0
             try:
-                while True:
-                    if (
-                        deadline_t is not None
-                        and time.monotonic() >= deadline_t
-                    ):
-                        # budget ran out mid-generation: final frame +
-                        # slot cancel (finished stays False -> the
-                        # finally below cancels the rid)
-                        await resp.write(
-                            b"data: " + json.dumps({
-                                "rid": req.rid, "token_ids": [],
-                                "logprobs": [],
-                                "finish_reason": "deadline",
-                            }).encode() + b"\n\n"
+                # arealint: owns(gen.engine-slot, released by the engine's own harvest when 'finished', by the finally's _cancel_rid on disconnect/cancellation otherwise — the conditional is the protocol, not a gap)
+                self.engine.submit(req)
+            except ValueError as e:
+                self._stream_subs.pop(req.rid, None)
+                self._stream_sent.pop(req.rid, None)
+                return web.json_response({"error": str(e)}, status=400)
+            resp = web.StreamResponse(
+                headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                }
+            )
+            finished = False
+            n_tokens = 0
+            n_frames = 0
+            try:
+                await resp.prepare(request)
+                try:
+                    while True:
+                        if (
+                            deadline_t is not None
+                            and time.monotonic() >= deadline_t
+                        ):
+                            # budget ran out mid-generation: final frame +
+                            # slot cancel (finished stays False -> the
+                            # finally below cancels the rid)
+                            await resp.write(
+                                b"data: " + json.dumps({
+                                    "rid": req.rid, "token_ids": [],
+                                    "logprobs": [],
+                                    "finish_reason": "deadline",
+                                }).encode() + b"\n\n"
+                            )
+                            await resp.write(b"data: [DONE]\n\n")
+                            break
+                        try:
+                            ev = await asyncio.wait_for(q.get(), timeout=0.5)
+                        except asyncio.TimeoutError:
+                            # poll the transport so a silent disconnect
+                            # releases the slot promptly, not at next write
+                            tr = request.transport
+                            if tr is None or tr.is_closing():
+                                raise ConnectionResetError(
+                                    "client went away"
+                                )
+                            continue
+                        # serving-plane chaos hooks (tools/chaos.py
+                        # --serve): a scripted backend death drops the
+                        # stream without a final frame (FaultInjected IS a
+                        # ConnectionError — the quiet-end path below
+                        # cancels the slot exactly like a real mid-stream
+                        # crash); a scripted wedge stalls the first chunk
+                        # past the gateway's hedge delay
+                        faults.maybe_fail(
+                            "gw.backend_die_midstream", rid=req.rid
                         )
+                        await faults.maybe_fail_async(
+                            "gw.backend_wedge", rid=req.rid
+                        )
+                        await resp.write(
+                            b"data: " + json.dumps(ev).encode() + b"\n\n"
+                        )
+                        n_frames += 1
+                        n_tokens += len(ev.get("token_ids", ()))
+                        if ev.get("finish_reason"):
+                            finished = True
+                            break
+                    if finished:
                         await resp.write(b"data: [DONE]\n\n")
-                        break
-                    try:
-                        ev = await asyncio.wait_for(q.get(), timeout=0.5)
-                    except asyncio.TimeoutError:
-                        # poll the transport so a silent disconnect
-                        # releases the slot promptly, not at next write
-                        tr = request.transport
-                        if tr is None or tr.is_closing():
-                            raise ConnectionResetError("client went away")
-                        continue
-                    # serving-plane chaos hooks (tools/chaos.py --serve):
-                    # a scripted backend death drops the stream without a
-                    # final frame (FaultInjected IS a ConnectionError —
-                    # the quiet-end path below cancels the slot exactly
-                    # like a real mid-stream crash); a scripted wedge
-                    # stalls the first chunk past the gateway's hedge delay
-                    faults.maybe_fail("gw.backend_die_midstream", rid=req.rid)
-                    await faults.maybe_fail_async(
-                        "gw.backend_wedge", rid=req.rid
+                except (ConnectionResetError, ConnectionError):
+                    # client went away: not a server error — free the slot
+                    # (in finally) and end the response quietly
+                    logger.info(
+                        "stream %s (qid=%s): client disconnected",
+                        req.rid, tracing.current_qid(),
                     )
-                    await resp.write(
-                        b"data: " + json.dumps(ev).encode() + b"\n\n"
-                    )
-                    n_tokens += len(ev.get("token_ids", ()))
-                    if ev.get("finish_reason"):
-                        finished = True
-                        break
-                if finished:
-                    await resp.write(b"data: [DONE]\n\n")
-            except (ConnectionResetError, ConnectionError):
-                # client went away: not a server error — free the slot
-                # (in finally) and end the response quietly
-                logger.info("stream %s: client disconnected", req.rid)
-        finally:
-            self._stream_subs.pop(req.rid, None)
-            self._stream_sent.pop(req.rid, None)
-            if not finished:
-                # disconnect / handler cancellation mid-generation: free
-                # the slot (engine lock can wait out a chunk -> executor)
-                await self._cancel_rid(loop, req.rid)
-        metrics_mod.counters.add(metrics_mod.GEN_SERVED)
-        metrics_mod.counters.add(metrics_mod.GEN_TOKENS, n_tokens)
-        return resp
+            finally:
+                span_attrs["frames"] = n_frames
+                span_attrs["tokens"] = n_tokens
+                self._stream_subs.pop(req.rid, None)
+                self._stream_sent.pop(req.rid, None)
+                if not finished:
+                    # disconnect / handler cancellation mid-generation:
+                    # free the slot (engine lock can wait out a chunk ->
+                    # executor)
+                    await self._cancel_rid(loop, req.rid)
+            metrics_mod.counters.add(metrics_mod.GEN_SERVED)
+            metrics_mod.counters.add(metrics_mod.GEN_TOKENS, n_tokens)
+            return resp
 
     async def _cancel_rid(self, loop, rid: str):
         """Cancel with a short retry: a rid can transiently be in neither

@@ -24,6 +24,7 @@ import numpy as np
 
 from areal_tpu.api.data import SequenceSample
 from areal_tpu.base import metrics as metrics_mod
+from areal_tpu.base import tracing
 
 logger = logging.getLogger("areal_tpu.buffer")
 
@@ -66,31 +67,37 @@ def record_consumption(sample: SequenceSample, current_version: int) -> None:
     launcher; cross-host skew is NTP-bounded and dwarfed by the
     seconds-scale latencies being measured."""
     now = time.time()
-    v = sample_version_start(sample)
-    if v is not None:
-        metrics_mod.counters.observe(
-            metrics_mod.STALENESS_VERSIONS, max(current_version - v, 0)
-        )
-    submit = _meta_time(sample, "submit_time")
-    enqueue = _meta_time(sample, "enqueue_time")
-    first_chunk = _meta_time(sample, "first_chunk_time")
-    reward = _meta_time(sample, "reward_time")
-    if enqueue is not None:
-        metrics_mod.counters.observe(
-            metrics_mod.QUEUE_WAIT_S, max(now - enqueue, 0.0)
-        )
-    if submit is not None:
-        metrics_mod.counters.observe(
-            metrics_mod.E2E_LATENCY_S, max(now - submit, 0.0)
-        )
-        if first_chunk is not None:
+    # trace stamp: the trajectory's last hop — obs --trace joins it to the
+    # rollout's spans on qid (the consume side holds no wire context)
+    qid = str(sample.ids[0]) if sample.ids else ""
+    with tracing.span("buffer/consume", qid=qid) as span_attrs:
+        v = sample_version_start(sample)
+        if v is not None:
+            span_attrs["staleness"] = max(current_version - v, 0)
             metrics_mod.counters.observe(
-                metrics_mod.TTFC_S, max(first_chunk - submit, 0.0)
+                metrics_mod.STALENESS_VERSIONS, max(current_version - v, 0)
             )
-        if reward is not None:
+        submit = _meta_time(sample, "submit_time")
+        enqueue = _meta_time(sample, "enqueue_time")
+        first_chunk = _meta_time(sample, "first_chunk_time")
+        reward = _meta_time(sample, "reward_time")
+        if enqueue is not None:
+            span_attrs["queue_wait_s"] = round(max(now - enqueue, 0.0), 4)
             metrics_mod.counters.observe(
-                metrics_mod.REWARD_LAG_S, max(reward - submit, 0.0)
+                metrics_mod.QUEUE_WAIT_S, max(now - enqueue, 0.0)
             )
+        if submit is not None:
+            metrics_mod.counters.observe(
+                metrics_mod.E2E_LATENCY_S, max(now - submit, 0.0)
+            )
+            if first_chunk is not None:
+                metrics_mod.counters.observe(
+                    metrics_mod.TTFC_S, max(first_chunk - submit, 0.0)
+                )
+            if reward is not None:
+                metrics_mod.counters.observe(
+                    metrics_mod.REWARD_LAG_S, max(reward - submit, 0.0)
+                )
 
 
 def sample_version_start(sample: SequenceSample) -> Optional[int]:

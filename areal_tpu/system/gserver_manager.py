@@ -40,7 +40,7 @@ from typing import Dict, List, Optional
 
 from aiohttp import web
 
-from areal_tpu.base import name_resolve, names
+from areal_tpu.base import name_resolve, names, tracing
 from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.gen.client import GenAPIClient
 from areal_tpu.system.fleet import FleetHealth
@@ -474,6 +474,15 @@ class GserverManager:
 
     async def _schedule_request(self, request: web.Request) -> web.Response:
         meta = await request.json()
+        # join the caller's trace (the body's ``trace`` field carries the
+        # traceparent + qid over the wire — docs/observability.md); spans
+        # here attribute routing decisions to the rollout's trace tree
+        with tracing.activate(
+            meta.get("trace"), qid=str(meta.get("qid"))
+        ), tracing.span("manager/schedule", qid=str(meta.get("qid"))):
+            return await self._schedule_request_locked(meta)
+
+    async def _schedule_request_locked(self, meta: dict) -> web.Response:
         async with self._lock:
             metrics_mod.counters.add(metrics_mod.MANAGER_SCHEDULED)
             prev_url = meta.get("previous_server_url")
@@ -511,6 +520,12 @@ class GserverManager:
 
     async def _allocate_rollout(self, request: web.Request) -> web.Response:
         d = await request.json()
+        with tracing.activate(
+            d.get("trace"), qid=str(d.get("qid"))
+        ), tracing.span("manager/allocate", qid=str(d.get("qid"))):
+            return await self._allocate_rollout_locked(d)
+
+    async def _allocate_rollout_locked(self, d: dict) -> web.Response:
         async with self._lock:
             has_capacity = (
                 self.rollout_stat.running < self.config.max_concurrent_rollouts
@@ -539,6 +554,15 @@ class GserverManager:
 
     async def _finish_rollout(self, request: web.Request) -> web.Response:
         d = await request.json()
+        with tracing.activate(
+            d.get("trace"), qid=str(d.get("qid"))
+        ), tracing.span(
+            "manager/finish", qid=str(d.get("qid")),
+            accepted=bool(d.get("accepted")),
+        ):
+            return await self._finish_rollout_locked(d)
+
+    async def _finish_rollout_locked(self, d: dict) -> web.Response:
         async with self._lock:
             qid = str(d["qid"])
             # release everything this rollout accumulated — including

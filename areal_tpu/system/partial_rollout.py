@@ -20,6 +20,7 @@ import aiohttp
 from areal_tpu.api.agent import BundledGenerationOutputs, GenerationFailedError
 from areal_tpu.api.model import GenerationHyperparameters
 from areal_tpu.base import metrics as metrics_mod
+from areal_tpu.base import tracing
 from areal_tpu.gen.client import GenAPIClient
 
 logger = logging.getLogger("areal_tpu.partial_rollout")
@@ -57,19 +58,25 @@ class PartialRolloutManager:
         prev_url: Optional[str],
         prev_version: Optional[int],
     ):
-        async with session.post(
-            f"{self.manager_url}/schedule_request",
-            json={
+        with tracing.span("rollout/schedule", qid=qid):
+            body = {
                 "qid": qid,
                 "prompt_len": prompt_len,
                 "group_size": group_size,
                 "new_token_budget": budget,
                 "previous_server_url": prev_url,
                 "previous_version": prev_version,
-            },
-        ) as resp:
-            resp.raise_for_status()
-            d = await resp.json()
+            }
+            trace = tracing.wire_context(qid=qid)
+            if trace is not None:
+                # the hop's trace context (docs/observability.md) — the
+                # manager activates it so its routing span joins this tree
+                body["trace"] = trace
+            async with session.post(
+                f"{self.manager_url}/schedule_request", json=body
+            ) as resp:
+                resp.raise_for_status()
+                d = await resp.json()
         return d["url"], d["version"]
 
     async def _report_failure(
@@ -188,17 +195,25 @@ class PartialRolloutManager:
         error = None
         submit_time = time.time()  # lifecycle stamp: group submitted
         try:
-            async with GenAPIClient(timeout=self.timeout) as client:
-                async with aiohttp.ClientSession(
-                    timeout=aiohttp.ClientTimeout(total=self.timeout)
-                ) as session:
-                    results = await asyncio.gather(
-                        *(
-                            self._gen_one(session, client, qid, prompt_ids, gconfig)
-                            for _ in range(gconfig.n)
-                        ),
-                        return_exceptions=True,
-                    )
+            # this task is spawned by run_step (outside any rollout trace
+            # context), so the group roots its own trace here with the qid
+            # riding it — obs --trace joins the trajectory's traces on qid
+            with tracing.activate(qid=qid), tracing.span(
+                "rollout/group", qid=qid, group_size=gconfig.n
+            ):
+                async with GenAPIClient(timeout=self.timeout) as client:
+                    async with aiohttp.ClientSession(
+                        timeout=aiohttp.ClientTimeout(total=self.timeout)
+                    ) as session:
+                        results = await asyncio.gather(
+                            *(
+                                self._gen_one(
+                                    session, client, qid, prompt_ids, gconfig
+                                )
+                                for _ in range(gconfig.n)
+                            ),
+                            return_exceptions=True,
+                        )
             for r in results:
                 # one failed member fails the group: training on a partial
                 # group would bias the grouped-advantage baseline, and the

@@ -18,7 +18,7 @@ import aiohttp
 from areal_tpu.api.agent import Agent, make_agent
 from areal_tpu.api.data import SequenceSample
 from areal_tpu.api.env import EnvironmentService, make_env
-from areal_tpu.base import faults, name_resolve, names
+from areal_tpu.base import faults, name_resolve, names, tracing
 from areal_tpu.base import metrics as metrics_mod
 from areal_tpu.system.partial_rollout import PartialRolloutManager
 from areal_tpu.system.push_pull_stream import NameResolvingZmqPusher
@@ -101,22 +101,37 @@ class RolloutWorker:
         return None
 
     async def allocate_new_rollout(self, session, qid) -> bool:
-        async with session.post(
-            f"{self.manager_url}/allocate_rollout", json={"qid": str(qid)}
-        ) as resp:
-            resp.raise_for_status()
-            d = await resp.json()
-            return bool(d["success"])
+        with tracing.span("rollout/allocate", qid=str(qid)):
+            body = {"qid": str(qid)}
+            trace = tracing.wire_context(qid=str(qid))
+            if trace is not None:
+                # the hop's trace context (docs/observability.md) — the
+                # manager activates it so the gate decision joins the tree
+                body["trace"] = trace
+            async with session.post(
+                f"{self.manager_url}/allocate_rollout", json=body
+            ) as resp:
+                resp.raise_for_status()
+                d = await resp.json()
+                return bool(d["success"])
 
     async def finish_rollout(self, session, qid, accepted: bool):
-        async with session.post(
-            f"{self.manager_url}/finish_rollout",
-            json={"qid": str(qid), "accepted": accepted},
-        ) as resp:
-            resp.raise_for_status()
+        with tracing.span("rollout/finish", qid=str(qid)):
+            body = {"qid": str(qid), "accepted": accepted}
+            trace = tracing.wire_context(qid=str(qid))
+            if trace is not None:
+                body["trace"] = trace
+            async with session.post(
+                f"{self.manager_url}/finish_rollout", json=body
+            ) as resp:
+                resp.raise_for_status()
 
     async def _rollout_task(self, session, prompt: SequenceSample):
         qid = str(prompt.ids[0])
+        with tracing.span("rollout/trajectory", qid=qid):
+            await self._rollout_task_body(session, prompt, qid)
+
+    async def _rollout_task_body(self, session, prompt, qid: str):
         try:
             try:
                 trajs = await self.agent.collect_trajectory(
@@ -288,24 +303,37 @@ class RolloutWorker:
                                     # requeue next tick, don't lose it
                                     self._requeue.append(prompt)
                                 # else: duplicate in flight; move on
-                            elif await self.allocate_new_rollout(session, qid):
-                                # the manager slot is held from here on:
-                                # hand it to the rollout task (whose every
-                                # exit path reaches finish_rollout) FIRST —
-                                # bookkeeping between allocate and task
-                                # creation is a leak window on exceptions
-                                self._tasks[qid] = asyncio.get_event_loop().create_task(
-                                    self._rollout_task(session, prompt)
-                                )
-                                self._used_qids.add(f"{qid}@{self._epoch}")
-                                self._route_queue(qid)
                             else:
-                                # gate closed (capacity/staleness): keep this
-                                # sample and back off instead of spinning
-                                # through the dataset (≈ the reference's
-                                # retry-same-sample behavior)
-                                carry = prompt
-                                await asyncio.sleep(0.05)
+                                # one trace per trajectory attempt, rooted
+                                # here so the allocate hop and the rollout
+                                # task (task creation copies the active
+                                # context) share its trace id; the qid
+                                # rides the context into every span/hop
+                                with tracing.activate(qid=qid):
+                                    if await self.allocate_new_rollout(
+                                        session, qid
+                                    ):
+                                        # the manager slot is held from here
+                                        # on: hand it to the rollout task
+                                        # (whose every exit path reaches
+                                        # finish_rollout) FIRST — bookkeeping
+                                        # between allocate and task creation
+                                        # is a leak window on exceptions
+                                        self._tasks[qid] = asyncio.get_event_loop().create_task(
+                                            self._rollout_task(session, prompt)
+                                        )
+                                        self._used_qids.add(
+                                            f"{qid}@{self._epoch}"
+                                        )
+                                        self._route_queue(qid)
+                                    else:
+                                        # gate closed (capacity/staleness):
+                                        # keep this sample and back off
+                                        # instead of spinning through the
+                                        # dataset (≈ the reference's
+                                        # retry-same-sample behavior)
+                                        carry = prompt
+                                        await asyncio.sleep(0.05)
                     await self.prm.run_step()
         finally:
             dispatch.cancel()

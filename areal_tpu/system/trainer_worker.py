@@ -30,6 +30,7 @@ import numpy as np
 from areal_tpu.api.data import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model import PPOHyperparameters, make_interface
 from areal_tpu.experiments import graphs
+from areal_tpu.system import worker_base
 from areal_tpu.system.buffer import SequenceBuffer, record_batch_consumption
 from areal_tpu.system.function_executor import FunctionExecutor
 from areal_tpu.base import constants, hbm, name_resolve, names, recover, tracing
@@ -513,6 +514,14 @@ class AsyncPPOTrainerWorker:
         self.actor_engine.version = max(live_version, restored_version) + 1
         self._consec_anomalies = 0
         metrics_mod.counters.add(metrics_mod.GUARD_ROLLBACKS)
+        worker_base.flight_dump(
+            "train_guard_rollback",
+            {
+                "live_version": live_version,
+                "restored_version": restored_version,
+                "republished_version": self.actor_engine.version,
+            },
+        )
         logger.warning(
             "rolled back to committed checkpoint (engine step %d, restored "
             "v%d, republishing as v%d) after %d consecutive anomalous steps",

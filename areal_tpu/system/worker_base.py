@@ -28,8 +28,15 @@ Trainer survivability (docs/fault_tolerance.md "Trainer survivability"):
   env-gated via ``AREAL_WATCHDOG_ABORT``, exits :data:`EXIT_WATCHDOG` so
   the scheduler restarts the world instead of burning the slice on a hung
   collective).
+- :class:`FlightRecorder` (docs/observability.md "Crash flight
+  recorder") keeps a ring of recent span ends, counter deltas, and a log
+  tail, and dumps them atomically to ``<fileroot>/flight/`` on watchdog
+  trip, preemption, train-guard rollback, and unhandled crash — the
+  black box ``make chaos`` asserts exists for every injected fault.
 """
 
+import collections
+import json
 import logging
 import os
 import signal as signal_mod
@@ -267,6 +274,17 @@ class TelemetryExporter:
             self.experiment_name, self.trial_name, snap
         )
         self.published += 1
+        # The span ring rides the telemetry cadence: each publish also
+        # flushes completed distributed-tracing spans through the fileroot
+        # (tracejoin merges them across workers). Failure never breaks the
+        # snapshot publish.
+        if tracing.spans_enabled():
+            try:
+                tracing.flush(self.worker_name)
+            except Exception:
+                logger.warning(
+                    "span flush %s failed", self.worker_name, exc_info=True
+                )
         return snap
 
     def _loop(self):
@@ -302,6 +320,168 @@ class TelemetryExporter:
             self.publish_once()
         except Exception:
             logger.warning("final telemetry publish failed", exc_info=True)
+
+
+# --------------------------------------------------------------------- #
+# Crash flight recorder (docs/observability.md "Crash flight recorder")
+# --------------------------------------------------------------------- #
+
+
+class _LogTail(logging.Handler):
+    """Root-logger handler keeping the last N formatted log lines in a
+    bounded deque — the flight recorder's log-tail evidence."""
+
+    def __init__(self, capacity: int):
+        super().__init__()
+        self.lines: collections.deque = collections.deque(
+            maxlen=max(1, capacity)
+        )
+        self.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+
+    def emit(self, record):
+        try:
+            self.lines.append(self.format(record))
+        except Exception:  # a log record must never crash the worker
+            pass
+
+
+class FlightRecorder:
+    """Black box for a dying worker: on watchdog trip, train-guard
+    rollback, SIGTERM preemption, or unhandled crash, :meth:`dump` writes
+    one atomic JSON file to ``<fileroot>/flight/`` holding
+
+    - the most recent completed span ends (``tracing.recent_spans`` — a
+      ring the telemetry flush never drains),
+    - the spans still open at death (``tracing.live_spans``),
+    - counter deltas since the recorder was installed,
+    - the tail of the worker's log (``AREAL_TRACE_LOG_TAIL`` lines).
+
+    :meth:`install` registers the module-level recorder so any layer can
+    trigger a dump via :func:`flight_dump` without plumbing, attaches the
+    log-tail handler, and chains ``sys.excepthook`` so an unhandled
+    exception dumps before the traceback prints. Dumping is best-effort
+    and exception-safe — a failing dump logs, never masks the original
+    fault. ``make chaos`` asserts a dump exists per injected rank fault.
+    """
+
+    def __init__(
+        self,
+        worker_name: str,
+        root: Optional[str] = None,
+        span_tail: int = 128,
+        log_tail: Optional[int] = None,
+        registry=None,
+    ):
+        self.worker_name = worker_name
+        self._root = root
+        self.span_tail = span_tail
+        self._registry = (
+            registry if registry is not None else metrics_mod.counters
+        )
+        self._counters0 = self._registry.snapshot()
+        self._log = _LogTail(
+            log_tail if log_tail is not None else constants.trace_log_tail()
+        )
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._prev_excepthook = None
+        self.dumps = 0
+
+    # -- lifecycle ---------------------------------------------------- #
+
+    def install(self) -> "FlightRecorder":
+        global _flight
+        logging.getLogger().addHandler(self._log)
+        self._prev_excepthook = sys.excepthook
+        sys.excepthook = self._excepthook
+        _flight = self
+        return self
+
+    def uninstall(self):
+        global _flight
+        logging.getLogger().removeHandler(self._log)
+        if self._prev_excepthook is not None:
+            sys.excepthook = self._prev_excepthook
+            self._prev_excepthook = None
+        if _flight is self:
+            _flight = None
+
+    def _excepthook(self, exc_type, exc, tb):
+        try:
+            self.dump(
+                "crash",
+                extra={
+                    "exc": exc_type.__name__,
+                    "traceback": traceback.format_exception(
+                        exc_type, exc, tb
+                    ),
+                },
+            )
+        finally:
+            (self._prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+    # -- dumping ------------------------------------------------------ #
+
+    def _payload(self, reason: str, extra: Optional[dict]) -> dict:
+        return {
+            "schema": 1,
+            "worker": self.worker_name,
+            "pid": os.getpid(),
+            "reason": reason,
+            "time": time.time(),
+            "spans": tracing.recent_spans(self.span_tail),
+            "open_spans": tracing.live_spans(),
+            "counters": self._registry.delta(self._counters0),
+            "log_tail": list(self._log.lines),
+            "extra": extra or {},
+        }
+
+    def dump(self, reason: str, extra: Optional[dict] = None) -> Optional[str]:
+        """Write one flight dump; returns its path (None on failure)."""
+        try:
+            payload = self._payload(reason, extra)
+            root = self._root or constants.get_flight_root()
+            os.makedirs(root, exist_ok=True)
+            safe = self.worker_name.replace("/", "_") or "worker"
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
+            path = os.path.join(
+                root, f"{safe}-{os.getpid()}-{seq:03d}-{reason}.json"
+            )
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)  # atomic: a watcher never reads torn JSON
+            self.dumps += 1
+            metrics_mod.counters.add(metrics_mod.TRACE_FLIGHT_DUMPS)
+            logger.error(
+                "flight recorder: dumped %s (%d span(s), %d log line(s))",
+                path, len(payload["spans"]), len(payload["log_tail"]),
+            )
+            return path
+        except Exception:
+            logger.warning("flight dump (%s) failed", reason, exc_info=True)
+            return None
+
+
+# The installed recorder (one per process); flight_dump() is the no-plumbing
+# trigger any layer (watchdog, preemption, train guard, chaos rank body)
+# calls — a no-op until a worker installs a recorder.
+_flight: Optional[FlightRecorder] = None
+
+
+def flight_recorder() -> Optional[FlightRecorder]:
+    return _flight
+
+
+def flight_dump(reason: str, extra: Optional[dict] = None) -> Optional[str]:
+    """Dump the installed flight recorder (None / no-op when absent)."""
+    if _flight is None:
+        return None
+    return _flight.dump(reason, extra)
 
 
 # --------------------------------------------------------------------- #
@@ -373,9 +553,15 @@ class GracefulShutdown:
         self.request()
 
     def request(self):
-        if self.requested_at is None:
+        first = self.requested_at is None
+        if first:
             self.requested_at = time.monotonic()
         self._event.set()
+        if first:
+            # preemption evidence: what the worker was doing when the
+            # slice was reclaimed (covers real SIGTERM and the scripted
+            # signal.term fault point alike)
+            flight_dump("preempt", {"deadline_s": self.deadline_s})
 
     def should_stop(self) -> bool:
         if self._event.is_set():
@@ -490,5 +676,9 @@ class HangWatchdog:
         logger.error("\n".join(lines))
         self.dumps += 1
         metrics_mod.counters.add(metrics_mod.GUARD_WATCHDOG_DUMPS)
+        flight_dump(
+            "watchdog",
+            {"stalled_s": stalled, "timeout_s": self.timeout_s},
+        )
         if self._on_dump is not None:
             self._on_dump(stalled)

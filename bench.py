@@ -1165,6 +1165,155 @@ def _bench_telemetry(peak):
     }
 
 
+def _bench_tracing(peak):
+    """A/B the distributed-tracing span plane (AREAL_TRACE_SPANS,
+    docs/observability.md "Distributed tracing") on the REAL serving
+    stack: the gateway-section request loop (N concurrent streaming
+    clients through gateway -> scheduler -> gen server -> engine, every
+    hop instrumented) run once with spans recording and once with the
+    knob off. ``vs_baseline`` = spans_off / spans_on wall time should be
+    ~= 1.0 — per-request span cost (a handful of context stamps + ring
+    appends) is microseconds against a millisecond-scale request, and
+    the off path is a clock read + two counter adds per span. A
+    microbench of that per-span cost (disabled vs recording) rides
+    along."""
+    import asyncio
+
+    import aiohttp
+    import jax
+
+    from areal_tpu.base import constants as const
+    from areal_tpu.base import network, tracing
+    from areal_tpu.gateway.api import (
+        ByteFallbackCodec,
+        GatewayConfig,
+        GatewayServer,
+        serve_gateway,
+    )
+    from areal_tpu.gateway.scheduler import ContinuousBatchScheduler
+    from areal_tpu.gen.engine import GenerationEngine
+    from areal_tpu.gen.server import serve as serve_gen
+    from areal_tpu.models import transformer as tfm
+    from areal_tpu.models.config import ModelConfig
+
+    N, MAX_NEW, PLEN, ROUNDS = 8, 64, 32, 3
+    cfg = ModelConfig(
+        n_layers=2, n_q_heads=4, n_kv_heads=2, head_dim=16, hidden_dim=64,
+        intermediate_dim=128, vocab_size=256, dtype="float32",
+    )
+
+    async def run():
+        eng = GenerationEngine(
+            cfg, tfm.init_params(cfg, jax.random.key(0)),
+            max_slots=N, max_seqlen=256, admit_buckets=(N,),
+        )
+        gen_port = network.find_free_port()
+        gen_runner = await serve_gen(
+            eng, "127.0.0.1", gen_port, decode_steps=8
+        )
+        sched = ContinuousBatchScheduler(
+            [f"http://127.0.0.1:{gen_port}"], max_queue=256,
+        )
+        await sched.start()
+        gw = GatewayServer(
+            sched, ByteFallbackCodec(cfg.vocab_size),
+            GatewayConfig(max_tokens_cap=1024),
+        )
+        gw_port = network.find_free_port()
+        gw_runner = await serve_gateway(gw, "127.0.0.1", gw_port)
+        url = f"http://127.0.0.1:{gw_port}/v1/completions"
+        rng = np.random.default_rng(0)
+        prompts = [
+            [int(x) for x in rng.integers(1, cfg.vocab_size, PLEN)]
+            for _ in range(N)
+        ]
+
+        async def one(session, prompt):
+            async with session.post(
+                url,
+                json={
+                    "prompt": prompt, "max_tokens": MAX_NEW,
+                    "temperature": 1.0, "stream": True,
+                },
+            ) as resp:
+                resp.raise_for_status()
+                async for raw in resp.content:
+                    if raw.strip() == b"data: [DONE]":
+                        break
+
+        async def round_(session):
+            t0 = time.perf_counter()
+            res = await asyncio.gather(
+                *(one(session, p) for p in prompts),
+                return_exceptions=True,
+            )
+            errs = [r for r in res if isinstance(r, BaseException)]
+            if errs:
+                raise errs[0]
+            return time.perf_counter() - t0
+
+        timeout = aiohttp.ClientTimeout(total=600)
+        try:
+            async with aiohttp.ClientSession(timeout=timeout) as session:
+                for _ in range(2):                      # warm both arms
+                    await round_(session)
+                # interleave the arms so drift (page cache, allocator,
+                # CPU clocking) cancels instead of biasing one arm
+                t_on = t_off = 0.0
+                spans_recorded = 0
+                for _ in range(ROUNDS):
+                    with _env(const.TRACE_SPANS_ENV, "1"):
+                        t_on += await round_(session)
+                        spans_recorded += len(tracing.drain())
+                    with _env(const.TRACE_SPANS_ENV, "0"):
+                        t_off += await round_(session)
+        finally:
+            await sched.stop()
+            await gw_runner.cleanup()
+            await gen_runner.cleanup()
+            _free_engine(eng)
+        return t_on, t_off, spans_recorded
+
+    t_on, t_off, spans_recorded = asyncio.run(run())
+    n_req = N * ROUNDS
+
+    # per-span cost microbench: the two knob settings over a bare span
+    from areal_tpu.base import constants as const
+    from areal_tpu.base import tracing
+
+    def per_span(setting):
+        with _env(const.TRACE_SPANS_ENV, setting):
+            for _ in range(200):
+                with tracing.span("bench/span"):
+                    pass
+            t0 = time.perf_counter()
+            for _ in range(5000):
+                with tracing.span("bench/span"):
+                    pass
+            dt = time.perf_counter() - t0
+        tracing.drain()
+        return dt / 5000 * 1e6
+
+    span_off_us = per_span("0")
+    span_on_us = per_span("1")
+    spans_per_req = spans_recorded / max(n_req, 1)
+    # the literal "tracing-off overhead": the disabled span plane's cost
+    # per request as a fraction of the request itself
+    off_pct = (
+        span_off_us * 1e-6 * spans_per_req / max(t_off / n_req, 1e-9) * 100
+    )
+    return {
+        "clients": N, "rounds": ROUNDS, "max_tokens": MAX_NEW,
+        "spans_on_s_per_req": round(t_on / n_req, 5),
+        "spans_off_s_per_req": round(t_off / n_req, 5),
+        "spans_recorded_per_req": round(spans_per_req, 1),
+        "span_off_us": round(span_off_us, 3),
+        "span_on_us": round(span_on_us, 3),
+        "off_span_overhead_pct": round(off_pct, 3),
+        "vs_baseline": round(t_off / max(t_on, 1e-9), 4),
+    }
+
+
 def _bench_async_ppo(peak):
     """One complete async-PPO round on a single chip: generate a GRPO group
     per prompt on the paged engine, score, run the decoupled-PPO update,
@@ -1562,6 +1711,7 @@ def main():
          lambda: _bench_bwd_pipe(cfg_small, cfg_32k, peak), True),
         ("guard", lambda: _bench_guard(peak), True),
         ("telemetry", lambda: _bench_telemetry(peak), True),
+        ("tracing", lambda: _bench_tracing(peak), True),
     ):
         if not want(name):
             continue

@@ -1,0 +1,151 @@
+"""Crash flight recorder (docs/observability.md "Crash flight
+recorder"): dump payload schema + atomic file naming, every wired
+trigger — unhandled-crash excepthook, SIGTERM/preemption request,
+watchdog trip — and the module-level ``flight_dump`` no-plumbing hook."""
+
+import json
+import logging
+import sys
+import time
+
+import pytest
+
+from areal_tpu.base import metrics as metrics_mod
+from areal_tpu.base import tracing
+from areal_tpu.system import worker_base
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    """An installed recorder with a private counter registry, uninstalled
+    (and module state restored) on teardown."""
+    reg = metrics_mod.CounterRegistry()
+    rec = worker_base.FlightRecorder(
+        "trainer/0", root=str(tmp_path), span_tail=32, log_tail=50,
+        registry=reg,
+    )
+    rec.install()
+    yield rec
+    rec.uninstall()
+
+
+def _dumps(tmp_path):
+    return sorted(tmp_path.glob("*.json"))
+
+
+class TestFlightRecorder:
+    def test_dump_payload_and_naming(self, tmp_path, recorder):
+        recorder._registry.add("train/steps", 7)
+        logging.getLogger("areal_tpu.fr_test").warning("last words")
+        with tracing.span("t/fr_done"):
+            pass
+        with tracing.span("t/fr_open", rid="r1"):
+            path = recorder.dump("watchdog", extra={"stalled_s": 12.5})
+        assert path is not None
+        files = _dumps(tmp_path)
+        assert [f.name for f in files] == [
+            f"trainer_0-{recorder._payload('x', None)['pid']}-001-"
+            "watchdog.json"
+        ]
+        assert not list(tmp_path.glob("*.tmp"))  # atomic: no tmp left
+        d = json.loads(files[0].read_text())
+        assert d["schema"] == 1
+        assert d["worker"] == "trainer/0"
+        assert d["reason"] == "watchdog"
+        assert d["extra"] == {"stalled_s": 12.5}
+        assert d["time"] <= time.time()
+        # counter DELTA since install, from the recorder's own registry
+        assert d["counters"] == {"train/steps": 7.0}
+        assert any(s["name"] == "t/fr_done" for s in d["spans"])
+        assert any(s["name"] == "t/fr_open" for s in d["open_spans"])
+        assert any("last words" in l for l in d["log_tail"])
+
+    def test_dump_sequence_numbers(self, tmp_path, recorder):
+        recorder.dump("preempt")
+        recorder.dump("crash")
+        names = [f.name for f in _dumps(tmp_path)]
+        assert names[0].endswith("-001-preempt.json")
+        assert names[1].endswith("-002-crash.json")
+        assert recorder.dumps == 2
+
+    def test_excepthook_dumps_then_chains(self, tmp_path, monkeypatch):
+        seen = []
+        monkeypatch.setattr(
+            sys, "excepthook", lambda *a: seen.append(a[0])
+        )
+        rec = worker_base.FlightRecorder("gw/1", root=str(tmp_path))
+        rec.install()
+        try:
+            assert sys.excepthook == rec._excepthook
+            sys.excepthook(ValueError, ValueError("boom"), None)
+        finally:
+            rec.uninstall()
+        assert seen == [ValueError]  # prior hook still ran
+        (f,) = _dumps(tmp_path)
+        assert f.name.endswith("-crash.json")
+        d = json.loads(f.read_text())
+        assert d["extra"]["exc"] == "ValueError"
+        assert any("boom" in l for l in d["extra"]["traceback"])
+        # uninstall restored the monkeypatched hook
+        assert sys.excepthook is not rec._excepthook
+
+    def test_flight_dump_noop_without_recorder(self):
+        assert worker_base.flight_recorder() is None
+        assert worker_base.flight_dump("crash") is None
+
+    def test_install_registers_module_recorder(self, recorder, tmp_path):
+        assert worker_base.flight_recorder() is recorder
+        assert worker_base.flight_dump("train_guard_rollback",
+                                       {"live_version": 3}) is not None
+        d = json.loads(_dumps(tmp_path)[0].read_text())
+        assert d["reason"] == "train_guard_rollback"
+        assert d["extra"] == {"live_version": 3}
+
+
+class TestFlightTriggers:
+    def test_preempt_request_dumps_once(self, tmp_path, recorder):
+        gs = worker_base.GracefulShutdown(deadline_s=30.0, install=False)
+        assert not gs.should_stop()
+        assert not _dumps(tmp_path)
+        gs.request()
+        gs.request()  # idempotent: evidence from the FIRST request only
+        assert gs.should_stop()
+        files = _dumps(tmp_path)
+        assert len(files) == 1
+        d = json.loads(files[0].read_text())
+        assert d["reason"] == "preempt"
+        assert d["extra"] == {"deadline_s": 30.0}
+
+    def test_watchdog_trip_dumps(self, tmp_path, recorder, monkeypatch):
+        monkeypatch.delenv("AREAL_WATCHDOG_ABORT", raising=False)
+        tripped = []
+        wd = worker_base.HangWatchdog(
+            "unit", timeout_s=0.05, poll_interval=0.02,
+            on_dump=tripped.append,
+        )
+        wd.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while not tripped and time.monotonic() < deadline:
+                time.sleep(0.02)
+        finally:
+            wd.stop()
+        assert tripped and wd.dumps >= 1
+        d = json.loads(_dumps(tmp_path)[0].read_text())
+        assert d["reason"] == "watchdog"
+        assert d["extra"]["timeout_s"] == 0.05
+        assert d["extra"]["stalled_s"] >= 0.05
+
+    def test_watchdog_bump_prevents_dump(self, tmp_path, recorder):
+        wd = worker_base.HangWatchdog(
+            "unit", timeout_s=0.2, poll_interval=0.02
+        )
+        wd.start()
+        try:
+            for _ in range(10):
+                wd.bump()
+                time.sleep(0.03)
+        finally:
+            wd.stop()
+        assert wd.dumps == 0
+        assert not _dumps(tmp_path)

@@ -470,3 +470,42 @@ class TestObsCLI:
         assert d["workers"] == 2.0
         assert d[metrics_mod.TRAIN_STEPS] == 12.0
         assert obs.render_frame(self.EXP, "no-such-trial", False) is None
+
+    def test_main_once_and_json(self, tmp_path, capsys):
+        """The CLI entrypoint end to end against a synthetic 3-worker
+        aggregate: ``--once`` fleet table (trial auto-discovery too),
+        ``--json``, and the no-telemetry rc-1 path."""
+        from areal_tpu.apps import obs
+
+        prev = name_resolve.default_repository()
+        try:
+            name_resolve.reconfigure(name_resolve.NameResolveConfig(
+                type="file", root=str(tmp_path / "name_resolve")
+            ))
+            self._publish_world()
+            telemetry.publish_snapshot(self.EXP, self.TRIAL, _fake_snapshot(
+                "gen_server/0", "gen_server",
+                counters={metrics_mod.GEN_SERVED: 9}, pid=23,
+            ))
+            rc = obs.main([
+                str(tmp_path), "--experiment", self.EXP,
+                "--trial", self.TRIAL, "--once",
+            ])
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert "3 workers" in out
+            assert "trainer" in out and "gen_server/0" in out
+            assert "served=9" in out and "scheduled=40" in out
+            # trial auto-discovery (no --experiment/--trial) + --json
+            rc = obs.main([str(tmp_path), "--once", "--json"])
+            d = json.loads(capsys.readouterr().out)
+            assert rc == 0
+            assert d["workers"] == 3.0
+            assert d[metrics_mod.GEN_SERVED] == 9.0
+            # empty fileroot: honest rc 1 with a hint on stderr
+            empty = tmp_path / "empty"
+            empty.mkdir()
+            assert obs.main([str(empty), "--once"]) == 1
+            assert "no telemetry published" in capsys.readouterr().err
+        finally:
+            name_resolve.set_repository(prev)

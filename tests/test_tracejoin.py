@@ -1,0 +1,310 @@
+"""Distributed-tracing plane (docs/observability.md "Distributed
+tracing"): tracejoin's merge/resolve/render surface against synthetic
+multi-worker flushes, the ``obs --trace`` CLI, and the flagship
+end-to-end check — one streamed request through the real gateway → gen
+server → engine stack produces spans from three worker identities
+sharing one trace id, joined back into a single tree."""
+
+import asyncio
+import json
+import os
+
+import aiohttp
+import pytest
+
+import jax
+
+from areal_tpu.apps import obs
+from areal_tpu.base import network, tracing
+from areal_tpu.gateway.api import (
+    ByteFallbackCodec,
+    GatewayConfig,
+    GatewayServer,
+    serve_gateway,
+)
+from areal_tpu.gateway.scheduler import ContinuousBatchScheduler
+from areal_tpu.gen.engine import GenerationEngine
+from areal_tpu.gen.server import serve
+from areal_tpu.models import transformer as tfm
+from areal_tpu.models.config import ModelConfig
+from areal_tpu.system import tracejoin
+
+# --------------------------------------------------------------------- #
+# synthetic spans
+# --------------------------------------------------------------------- #
+
+
+def _span(
+    worker, name, trace_id, span_id, parent=None, start=1000.0, dur=0.01,
+    attrs=None, error=False, exc=None,
+):
+    s = {
+        "worker": worker, "name": name, "trace_id": trace_id,
+        "span_id": span_id, "parent_id": parent, "start": start,
+        "dur_s": dur, "thread": "MainThread", "pid": 1, "error": error,
+    }
+    if attrs:
+        s["attrs"] = attrs
+    if exc:
+        s["exc"] = exc
+    return s
+
+
+TID = "a" * 32
+OTHER = "b" * 32
+
+
+def _write_world(root):
+    """Three workers' flush files, one shared trace + one unrelated."""
+    d = os.path.join(root, "trace_spans")
+    os.makedirs(d, exist_ok=True)
+    by_worker = {
+        "gateway": [
+            _span("gateway", "gw/request", TID, "1" * 16, start=1000.0,
+                  dur=0.5, attrs={"rid": "gw-feedbeefcafe0123"}),
+        ],
+        "gen_server": [
+            _span("gen_server", "gen_server/generate_stream", TID,
+                  "2" * 16, parent="1" * 16, start=1000.1, dur=0.3,
+                  attrs={"rid": "gw-feedbeefcafe0123-c0"}),
+        ],
+        "rollout": [
+            _span("rollout", "rollout/group", OTHER, "3" * 16,
+                  start=999.0, dur=1.0, attrs={"qid": "q42"}),
+            _span("rollout", "rollout/reward", OTHER, "4" * 16,
+                  parent="3" * 16, start=999.5, dur=0.1,
+                  attrs={"qid": "q42"}, error=True, exc="TimeoutError"),
+            # parent never flushed (ring overwrite): promoted to a root
+            _span("rollout", "rollout/orphan", OTHER, "5" * 16,
+                  parent="f" * 16, start=999.8, dur=0.05),
+        ],
+    }
+    for worker, spans in by_worker.items():
+        with open(os.path.join(d, f"{worker}.jsonl"), "a") as f:
+            for s in spans:
+                f.write(json.dumps(s) + "\n")
+    # a torn final line (crashed worker mid-write) must be skipped
+    with open(os.path.join(d, "gateway.jsonl"), "a") as f:
+        f.write('{"worker": "gateway", "name": "torn', )
+
+
+class TestTracejoin:
+    def test_scan_merges_and_skips_torn_lines(self, tmp_path):
+        _write_world(str(tmp_path))
+        spans = tracejoin.scan(str(tmp_path))
+        assert len(spans) == 5
+        assert [s["start"] for s in spans] == sorted(
+            s["start"] for s in spans
+        )
+
+    def test_resolve_trace_id(self, tmp_path):
+        _write_world(str(tmp_path))
+        spans = tracejoin.scan(str(tmp_path))
+        assert tracejoin.resolve_trace_id(spans, TID) == TID
+        assert tracejoin.resolve_trace_id(spans, TID[:12]) == TID  # prefix
+        assert tracejoin.resolve_trace_id(
+            spans, "gw-feedbeefcafe0123"
+        ) == TID  # exact rid AND the -c0 chunk rid's base
+        assert tracejoin.resolve_trace_id(spans, "q42") == OTHER  # qid
+        assert tracejoin.resolve_trace_id(spans, "nope") is None
+        assert tracejoin.resolve_trace_id(spans, "") is None
+
+    def test_chrome_trace_structure(self, tmp_path):
+        _write_world(str(tmp_path))
+        spans = tracejoin.scan(str(tmp_path))
+        doc = tracejoin.chrome_trace(spans)
+        evs = doc["traceEvents"]
+        procs = [e for e in evs if e["name"] == "process_name"]
+        assert {p["args"]["name"] for p in procs} == {
+            "gateway", "gen_server", "rollout"
+        }
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert len(xs) == 5
+        # the shared trace's events span two distinct pids
+        tids = {e["pid"] for e in xs if e["args"]["trace_id"] == TID}
+        assert len(tids) == 2
+        err = [e for e in xs if e["name"] == "rollout/reward"][0]
+        assert err["cat"] == "span,error"
+        assert err["args"]["error"] is True
+        assert err["args"]["exc"] == "TimeoutError"
+        assert err["dur"] == pytest.approx(0.1 * 1e6)
+
+    def test_write_chrome_trace_atomic_and_filtered(self, tmp_path):
+        _write_world(str(tmp_path))
+        out = tmp_path / "trace.json"
+        n = tracejoin.write_chrome_trace(str(out), str(tmp_path))
+        assert n == 5 and out.exists()
+        assert not (tmp_path / "trace.json.tmp").exists()
+        n = tracejoin.write_chrome_trace(
+            str(out), str(tmp_path), trace_id=TID
+        )
+        assert n == 2
+        doc = json.loads(out.read_text())
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert {e["args"]["trace_id"] for e in xs} == {TID}
+
+    def test_span_tree_and_render(self, tmp_path):
+        _write_world(str(tmp_path))
+        spans = tracejoin.scan(str(tmp_path))
+        roots = tracejoin.span_tree(spans, OTHER)
+        # reward nests under group; the orphan is promoted, not dropped
+        assert [r["name"] for r in roots] == [
+            "rollout/group", "rollout/orphan"
+        ]
+        assert [c["name"] for c in roots[0]["children"]] == [
+            "rollout/reward"
+        ]
+        out = tracejoin.render_tree(spans, OTHER)
+        assert f"trace {OTHER}" in out and "1 worker(s)" in out
+        assert "ERROR(TimeoutError)" in out
+        assert "qid=q42" in out
+        # child indented under its parent
+        group_i = out.index("rollout/group")
+        reward_i = out.index("rollout/reward")
+        assert reward_i > group_i
+
+    def test_cli(self, tmp_path, capsys):
+        _write_world(str(tmp_path))
+        out_json = tmp_path / "merged.json"
+        assert tracejoin.main(
+            [str(tmp_path), "--out", str(out_json)]
+        ) == 0
+        assert out_json.exists()
+        assert tracejoin.main([str(tmp_path), "--trace", "q42"]) == 0
+        assert "rollout/group" in capsys.readouterr().out
+        assert tracejoin.main([str(tmp_path), "--trace", "zzz"]) == 1
+
+
+class TestObsTraceCLI:
+    def test_obs_trace_renders_tree(self, tmp_path, capsys):
+        _write_world(str(tmp_path))
+        assert obs.main([str(tmp_path), "--trace", "q42"]) == 0
+        out = capsys.readouterr().out
+        assert "rollout/group" in out and "rollout/reward" in out
+        assert obs.main(
+            [str(tmp_path), "--trace", "gw-feedbeefcafe0123"]
+        ) == 0
+        assert "gw/request" in capsys.readouterr().out
+
+    def test_obs_trace_no_match(self, tmp_path, capsys):
+        os.makedirs(tmp_path / "trace_spans", exist_ok=True)
+        assert obs.main([str(tmp_path), "--trace", "missing"]) == 1
+        assert "no trace matches" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------- #
+# end-to-end: one streamed request through the real serving stack
+# --------------------------------------------------------------------- #
+
+CFG = ModelConfig(
+    n_layers=2, n_q_heads=4, n_kv_heads=2, head_dim=8, hidden_dim=32,
+    intermediate_dim=64, vocab_size=128, dtype="float32",
+)
+
+# the per-hop span names, keyed by the worker identity each would flush
+# under in a real deployment (here everything shares one test process, so
+# the drained ring is partitioned by name prefix before flushing)
+WORKER_PREFIXES = {
+    "gateway": ("gw/",),
+    "gen_server": ("gen_server/", "gen_client/"),
+    "gen_engine": ("gen_engine/",),
+}
+
+
+async def test_stream_propagates_one_trace_across_three_workers(tmp_path):
+    """ISSUE acceptance: a streamed /v1/completions request yields merged
+    trace JSON with spans from >=3 distinct worker identities sharing one
+    trace id, and obs --trace renders the joined tree."""
+    tracing.drain()
+    params = tfm.init_params(CFG, jax.random.key(5))
+    eng = GenerationEngine(CFG, params, max_slots=4, max_seqlen=128)
+    gen_port = network.find_free_port()
+    gen_runner = await serve(eng, "127.0.0.1", gen_port, decode_steps=2)
+    scheduler = ContinuousBatchScheduler(
+        [f"http://127.0.0.1:{gen_port}"], {}, max_queue=16,
+    )
+    await scheduler.start()
+    gw = GatewayServer(
+        scheduler, ByteFallbackCodec(CFG.vocab_size),
+        GatewayConfig(max_tokens_cap=256),
+    )
+    gw_port = network.find_free_port()
+    gw_runner = await serve_gateway(gw, "127.0.0.1", gw_port)
+    try:
+        async with aiohttp.ClientSession() as sess:
+            resp = await sess.post(
+                f"http://127.0.0.1:{gw_port}/v1/completions",
+                json={
+                    "prompt": [1, 2, 3], "max_tokens": 4, "stream": True,
+                },
+            )
+            assert resp.status == 200
+            rid = None
+            async for raw in resp.content:
+                line = raw.strip()
+                if not line.startswith(b"data:"):
+                    continue
+                payload = line[len(b"data:"):].strip()
+                if payload == b"[DONE]":
+                    break
+                frame = json.loads(payload)
+                rid = frame["id"][len("cmpl-"):]
+            assert rid and rid.startswith("gw-")
+    finally:
+        await scheduler.stop()
+        await gw_runner.cleanup()
+        await gen_runner.cleanup()
+
+    spans = tracing.drain()
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    gw_req = [
+        s for s in by_name.get("gw/request", [])
+        if (s.get("attrs") or {}).get("rid") == rid
+    ]
+    assert gw_req, sorted(by_name)
+    tid = gw_req[0]["trace_id"]
+    # every serving hop joined THIS trace
+    for name in (
+        "gw/dispatch", "gen_server/generate_stream", "gen_engine/submit"
+    ):
+        assert any(
+            s["trace_id"] == tid for s in by_name.get(name, [])
+        ), (name, sorted(by_name))
+    # parenting: dispatch under request, server stream under dispatch
+    dispatch = next(
+        s for s in by_name["gw/dispatch"] if s["trace_id"] == tid
+    )
+    assert dispatch["parent_id"] == gw_req[0]["span_id"]
+    server_stream = next(
+        s for s in by_name["gen_server/generate_stream"]
+        if s["trace_id"] == tid
+    )
+    assert server_stream["parent_id"] == dispatch["span_id"]
+
+    # flush the ring partitioned into the three worker identities the
+    # spans would have come from in a real (multi-process) deployment
+    d = tmp_path / "trace_spans"
+    d.mkdir()
+    for worker, prefixes in WORKER_PREFIXES.items():
+        mine = [
+            s for s in spans if s["name"].startswith(prefixes)
+        ]
+        assert mine, worker
+        with open(d / f"{worker}.jsonl", "w") as f:
+            for s in mine:
+                f.write(json.dumps({"worker": worker, **s}) + "\n")
+
+    merged = tracejoin.scan(str(tmp_path))
+    assert tracejoin.resolve_trace_id(merged, rid) == tid
+    doc = tracejoin.chrome_trace(tracejoin.trace_spans(merged, tid))
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len({e["pid"] for e in xs}) >= 3  # >=3 distinct processes
+    assert {e["args"]["trace_id"] for e in xs} == {tid}
+
+    tree = obs.render_trace(str(tmp_path), rid)
+    assert tree is not None
+    assert f"trace {tid}" in tree
+    assert "3 worker(s)" in tree
+    assert "gw/request" in tree and "gen_server/generate_stream" in tree

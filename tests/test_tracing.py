@@ -1,11 +1,19 @@
-"""Env-gated profiler tracing (≈ the reference's REAL_DUMP_TRACE gating)."""
+"""Tracing plane: env-gated profiler tracing (≈ the reference's
+REAL_DUMP_TRACE gating) + the distributed span plane
+(docs/observability.md "Distributed tracing") — trace identity,
+wire-context propagation, exception-exit spans, the bounded completed-
+span ring, and the fileroot flush that feeds tracejoin."""
 
 import glob
+import json
 import os
+import threading
 
 import jax.numpy as jnp
+import pytest
 
 from areal_tpu.base import constants, tracing
+from areal_tpu.base import metrics as metrics_mod
 
 
 def test_disabled_is_free(monkeypatch):
@@ -30,3 +38,172 @@ def test_trace_dumps_profile(monkeypatch, tmp_path):
     dumped = glob.glob(str(tmp_path / "traces" / "unit" / "**" / "*"),
                        recursive=True)
     assert any(os.path.isfile(f) for f in dumped), dumped
+
+
+# --------------------------------------------------------------------- #
+# Span plane: identity + wire context
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture(autouse=True)
+def _clean_ring():
+    tracing.drain()
+    yield
+    tracing.drain()
+
+
+class TestTraceIdentity:
+    def test_id_formats(self):
+        tid, sid = tracing.new_trace_id(), tracing.new_span_id()
+        assert len(tid) == 32 and int(tid, 16) >= 0
+        assert len(sid) == 16 and int(sid, 16) >= 0
+
+    def test_traceparent_roundtrip(self):
+        with tracing.activate() as tid:
+            tp = tracing.traceparent()
+            assert tp == f"00-{tid}-{'0' * 16}-01"
+            assert tracing.parse_traceparent(tp) == (tid, None)
+            with tracing.span("t/x"):
+                tid2, psid = tracing.parse_traceparent(tracing.traceparent())
+                assert tid2 == tid and psid is not None
+        assert tracing.traceparent() is None
+
+    @pytest.mark.parametrize("bad", [
+        None, 7, "", "nonsense", "00-zz-ff-01",
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",   # short trace id
+        "00-" + "a" * 32 + "-" + "b" * 15 + "-01",   # short span id
+        "00-" + "a" * 32 + "-" + "b" * 16,           # missing flags
+    ])
+    def test_parse_tolerates_malformed(self, bad):
+        assert tracing.parse_traceparent(bad) is None
+
+    def test_wire_context_carries_qid(self):
+        assert tracing.wire_context() is None  # no active context
+        with tracing.activate(qid="q7") as tid:
+            w = tracing.wire_context()
+            assert w["qid"] == "q7"
+            assert tracing.parse_traceparent(w["traceparent"])[0] == tid
+            assert tracing.current_qid() == "q7"
+        assert tracing.current_qid() is None
+
+    def test_activate_continues_wire_context(self):
+        with tracing.activate(qid="q1") as tid:
+            with tracing.span("t/client"):
+                wire = tracing.wire_context()
+        # "server side": same trace id, parent = the client span, qid rides
+        with tracing.activate(wire) as tid2:
+            assert tid2 == tid
+            assert tracing.current_qid() == "q1"
+            with tracing.span("t/server"):
+                pass
+        spans = {s["name"]: s for s in tracing.drain()}
+        client, server = spans["t/client"], spans["t/server"]
+        assert server["trace_id"] == client["trace_id"] == tid
+        assert server["parent_id"] == client["span_id"]
+        assert server["attrs"]["qid"] == "q1"
+
+    def test_activate_degrades_to_fresh_root(self):
+        with tracing.activate({"traceparent": "garbage"}) as tid:
+            assert len(tid) == 32  # malformed wire → new trace, no crash
+
+
+class TestSpanRecords:
+    def test_span_nesting_and_attrs(self):
+        with tracing.activate() as tid:
+            with tracing.span("t/outer", rid="r1") as attrs:
+                attrs["late"] = 5
+                with tracing.span("t/inner"):
+                    pass
+        recs = {s["name"]: s for s in tracing.drain()}
+        outer, inner = recs["t/outer"], recs["t/inner"]
+        assert outer["trace_id"] == inner["trace_id"] == tid
+        assert inner["parent_id"] == outer["span_id"]
+        assert outer["attrs"] == {"rid": "r1", "late": 5}
+        assert outer["dur_s"] >= 0 and not outer["error"]
+
+    def test_exception_exit_recorded(self):
+        """The satellite regression: a span whose body raises must land in
+        the ring stamped error=True with the exception type — not vanish."""
+        before = metrics_mod.counters.get(metrics_mod.TRACE_SPAN_ERRORS)
+        with pytest.raises(ValueError):
+            with tracing.span("t/boom", rid="r9"):
+                raise ValueError("nope")
+        (rec,) = [s for s in tracing.drain() if s["name"] == "t/boom"]
+        assert rec["error"] is True and rec["exc"] == "ValueError"
+        assert rec["attrs"]["rid"] == "r9"
+        assert (
+            metrics_mod.counters.get(metrics_mod.TRACE_SPAN_ERRORS)
+            == before + 1
+        )
+        # the live registry must not leak the aborted span
+        assert all(s["name"] != "t/boom" for s in tracing.live_spans())
+
+    def test_span_counters_always_accumulate(self, monkeypatch):
+        monkeypatch.setenv(constants.TRACE_SPANS_ENV, "0")
+        before_s = metrics_mod.counters.get("t/off_s")
+        before_n = metrics_mod.counters.get("t/off_n")
+        with tracing.span("t/off"):
+            pass
+        assert metrics_mod.counters.get("t/off_s") >= before_s
+        assert metrics_mod.counters.get("t/off_n") == before_n + 1
+        assert tracing.drain() == []  # disabled: nothing recorded
+        assert tracing.wire_context(qid="q") is None
+        with tracing.activate() as tid:
+            assert tid is None
+
+    def test_ring_bounded_with_drop_counter(self, monkeypatch):
+        monkeypatch.setenv(constants.TRACE_RING_ENV, "16")
+        before = metrics_mod.counters.get(metrics_mod.TRACE_DROPPED)
+        for i in range(40):
+            with tracing.span("t/ring"):
+                pass
+        spans = tracing.drain()
+        assert len(spans) == 16
+        assert metrics_mod.counters.get(metrics_mod.TRACE_DROPPED) \
+            == before + 24
+
+    def test_recent_spans_survive_drain(self):
+        with tracing.span("t/recent"):
+            pass
+        tracing.drain()
+        assert any(
+            s["name"] == "t/recent" for s in tracing.recent_spans(50)
+        )
+
+
+class TestFlush:
+    def test_flush_appends_worker_stamped_jsonl(self, tmp_path):
+        with tracing.span("t/flush", rid="r1"):
+            pass
+        n = tracing.flush("gw/0", root=str(tmp_path))
+        assert n == 1
+        assert tracing.flush("gw/0", root=str(tmp_path)) == 0  # drained
+        path = tmp_path / "gw_0.jsonl"
+        recs = [json.loads(l) for l in path.read_text().splitlines()]
+        assert recs[0]["worker"] == "gw/0"
+        assert recs[0]["name"] == "t/flush"
+        assert recs[0]["pid"] == os.getpid()
+        # append, not truncate: a second flush adds lines
+        with tracing.span("t/flush2"):
+            pass
+        tracing.flush("gw/0", root=str(tmp_path))
+        assert len(path.read_text().splitlines()) == 2
+
+    def test_span_flusher_gated_off_by_default(self, monkeypatch):
+        monkeypatch.delenv(constants.TRACE_FLUSH_ENV, raising=False)
+        assert tracing.SpanFlusher.maybe_start("w") is None
+
+    def test_span_flusher_final_drain(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("AREAL_FILEROOT", str(tmp_path))
+        monkeypatch.setenv(constants.TRACE_FLUSH_ENV, "30")
+        t = tracing.SpanFlusher.maybe_start("bg/1")
+        assert isinstance(t, threading.Thread)
+        with tracing.span("t/bg"):
+            pass
+        t.stop()  # final drain flushes without waiting out the interval
+        path = tmp_path / "trace_spans" / "bg_1.jsonl"
+        assert path.exists()
+        assert any(
+            json.loads(l)["name"] == "t/bg"
+            for l in path.read_text().splitlines()
+        )

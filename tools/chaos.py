@@ -32,7 +32,9 @@ trip points armed per (rank, epoch, step)); the supervisor is
 
 import argparse
 import dataclasses
+import glob
 import json
+import logging
 import os
 import random
 import signal
@@ -40,6 +42,8 @@ import sys
 import threading
 import time
 from typing import Dict, List, Optional
+
+logger = logging.getLogger("tools.chaos")
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -152,14 +156,22 @@ def run_rank(rank: int, spec_path: str) -> int:
         jax.device_count(), n, local_devices
     )
 
+    from areal_tpu.base import tracing
+    from areal_tpu.system import worker_base
     from areal_tpu.system.worker_base import Heartbeat
 
+    worker_name = (
+        elastic.rank_worker_name(rank) if elastic_on else f"baseline/rank{rank}"
+    )
     hb = None
     if elastic_on:
         hb = Heartbeat(
-            spec["experiment"], spec["trial"],
-            elastic.rank_worker_name(rank), interval=1.0,
+            spec["experiment"], spec["trial"], worker_name, interval=1.0,
         ).start()
+    # the black box the scenario runner asserts exists per injected fault
+    flight = worker_base.FlightRecorder(
+        worker_name, root=spec.get("flight_root")
+    ).install()
 
     import jax.numpy as jnp
     import numpy as np
@@ -234,17 +246,36 @@ def run_rank(rank: int, spec_path: str) -> int:
     reforms = 0
 
     while True:
-        eng = build_engine()
-        try:
-            eng.load_checkpoint(ckpt_path)
-        except (FileNotFoundError, ValueError):
-            pass  # nothing committed yet: every rank starts fresh
+        # spanned so even an incarnation that trips its fault before the
+        # first train step leaves span evidence in the flight dump
+        with tracing.span("chaos/restore", rank=rank):
+            eng = build_engine()
+            try:
+                eng.load_checkpoint(ckpt_path)
+            except (FileNotFoundError, ValueError):
+                pass  # nothing committed yet: every rank starts fresh
         try:
             for step in range(eng._step, steps):
                 epoch = mgr.world.epoch if mgr is not None else 0
                 if faults.maybe_trip("rank.kill", step=step, epoch=epoch):
+                    logger.warning(
+                        "chaos: rank.kill tripped (rank %d step %d epoch %d)",
+                        rank, step, epoch,
+                    )
+                    flight.dump(
+                        "rank.kill",
+                        {"rank": rank, "step": step, "epoch": epoch},
+                    )
                     os.kill(os.getpid(), signal.SIGKILL)  # hard death
                 if faults.maybe_trip("rank.hang", step=step, epoch=epoch):
+                    logger.warning(
+                        "chaos: rank.hang tripped (rank %d step %d epoch %d)",
+                        rank, step, epoch,
+                    )
+                    flight.dump(
+                        "rank.hang",
+                        {"rank": rank, "step": step, "epoch": epoch},
+                    )
                     while True:  # wedged, not dead: lease keeps beating
                         time.sleep(60)
                 stats = eng.train_batch(
@@ -835,7 +866,8 @@ def run_scenario(cfg: ChaosConfig) -> Dict:
     out_root = os.path.join(root, "out")
     ckpt_root = os.path.join(root, "ckpt")
     log_dir = os.path.join(root, "logs")
-    for d in (nr_root, out_root, ckpt_root, log_dir):
+    flight_root = os.path.join(root, "flight")
+    for d in (nr_root, out_root, ckpt_root, log_dir, flight_root):
         os.makedirs(d, exist_ok=True)
 
     schedule = (
@@ -861,6 +893,7 @@ def run_scenario(cfg: ChaosConfig) -> Dict:
         "collective_timeout_s": cfg.collective_timeout_s,
         "lease_interval_s": cfg.lease_interval_s,
         "schedule": schedule,
+        "flight_root": flight_root,
     }
     spec_path = os.path.join(root, "spec.json")
     with open(spec_path, "w") as f:
@@ -951,6 +984,14 @@ def run_scenario(cfg: ChaosConfig) -> Dict:
     finally:
         name_resolve.set_repository(prev_repo)
 
+    flight_dumps: List[Dict] = []
+    for p in sorted(glob.glob(os.path.join(flight_root, "*.json"))):
+        try:
+            with open(p) as f:
+                flight_dumps.append(json.load(f))
+        except (OSError, ValueError):
+            flight_dumps.append({"reason": "unreadable", "path": p})
+
     report = {
         "root": root,
         "seed": cfg.seed,
@@ -963,6 +1004,16 @@ def run_scenario(cfg: ChaosConfig) -> Dict:
         "world_epochs": sup.epoch,
         "recovery_times_s": [round(t, 1) for t in sup.recovery_times],
         "ranks_reported": sorted(ranks),
+        "flight_dumps": [
+            {
+                "worker": d.get("worker"),
+                "reason": d.get("reason"),
+                "extra": d.get("extra"),
+                "spans": len(d.get("spans") or []),
+                "log_lines": len(d.get("log_tail") or []),
+            }
+            for d in flight_dumps
+        ],
         "gen": probe.result if probe is not None else None,
         "counters": {
             "ft/rank_restarts": metrics_mod.counters.get(
@@ -975,14 +1026,15 @@ def run_scenario(cfg: ChaosConfig) -> Dict:
     }
     report["violations"] = _violations(
         cfg, schedule, baseline, ranks, leases, status_keys, sup,
-        rc_world, probe,
+        rc_world, probe, flight_dumps,
     )
     report["ok"] = rc_world == 0 and not report["violations"]
     return report
 
 
 def _violations(
-    cfg, schedule, baseline, ranks, leases, status_keys, sup, rc_world, probe
+    cfg, schedule, baseline, ranks, leases, status_keys, sup, rc_world,
+    probe, flight_dumps=(),
 ) -> List[str]:
     v: List[str] = []
     if rc_world != 0:
@@ -1031,6 +1083,27 @@ def _violations(
     slow = [t for t in sup.recovery_times if t > cfg.recovery_bound_s]
     if slow:
         v.append(f"recovery times over bound {cfg.recovery_bound_s}s: {slow}")
+    # flight recorder: every injected rank fault must leave a black box
+    # with span, counter-delta, and log-tail evidence
+    # (docs/observability.md "Crash flight recorder")
+    for ev in schedule:
+        reason = f"rank.{ev['kind']}"
+        match = [
+            d for d in flight_dumps
+            if d.get("reason") == reason
+            and (d.get("extra") or {}).get("rank") == ev["rank"]
+            and (d.get("extra") or {}).get("epoch") == ev["epoch"]
+        ]
+        if not match:
+            v.append(f"no flight-recorder dump for injected fault {ev}")
+            continue
+        d = match[0]
+        if not d.get("spans"):
+            v.append(f"flight dump for {ev} has no span evidence")
+        if not d.get("counters"):
+            v.append(f"flight dump for {ev} has no counter deltas")
+        if not d.get("log_tail"):
+            v.append(f"flight dump for {ev} has no log tail")
     # lease/heartbeat hygiene: exactly one lease per rank, all at the
     # final epoch; no ghost heartbeat keys from dead incarnations
     if sorted(leases) != list(range(cfg.num_ranks)):
